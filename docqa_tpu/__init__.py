@@ -1,0 +1,19 @@
+"""docqa_tpu — TPU-native clinical document QA framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+``benlaktibyassine/DocQA-MS-Clinical-Document-QA-Assistant-LLM-Microservices-``
+(see SURVEY.md): document ingestion, PHI de-identification, semantic indexing,
+retrieval-augmented QA, and patient synthesis — with the entire hot path
+(encoder, vector search, NER, decoding, summarization) running on a TPU mesh
+instead of CPU microservices glued by RabbitMQ/HTTP/shared files.
+
+Two planes:
+  * **device plane** (``ops/``, ``models/``, ``index/``, ``parallel/``):
+    jit-compiled JAX programs over a ``jax.sharding.Mesh``.
+  * **service plane** (``pipeline/``, ``services/``): async Python — broker
+    with at-least-once semantics, ingest/QA/synthesis APIs, metadata registry.
+"""
+
+from docqa_tpu.version import __version__
+
+__all__ = ["__version__"]

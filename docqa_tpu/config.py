@@ -1,0 +1,315 @@
+"""Typed configuration tree for the whole framework.
+
+The reference scatters ``os.getenv`` calls with inline defaults across every
+service (``deid-service/anonymizer.py:20-24``, ``doc-ingestor/database.py:7-8``,
+``llm-qa/main.py:66``) and centralizes config in only one service
+(``synthese-comparative/core/config.py:5-23``).  Here the whole framework has a
+single typed tree of frozen dataclasses with one env overlay, and fake-mode
+flags are *injectable* (constructor arguments) rather than read-at-import —
+the reference's read-at-import flags made its own tests awkward
+(``synthese-comparative/tests/test_llm_client.py:45-47``).
+
+Env overlay convention: ``DOCQA_<SECTION>__<FIELD>`` (double underscore), e.g.
+``DOCQA_STORE__SHARD_CAPACITY=65536``, ``DOCQA_FLAGS__USE_FAKE_LLM=false``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Mapping, Optional, Tuple
+
+
+def _env_bool(value: str) -> bool:
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """TPU mesh topology.  Axis names follow the scaling-book convention:
+    ``data`` (batch/DP), ``model`` (TP over ICI).  A v5e-8 slice defaults to
+    (data=1, model=8) for serving and (data=2, model=4) for training."""
+
+    data_axis: str = "data"
+    model_axis: str = "model"
+    # -1 means "use all available devices on that axis product".
+    data_parallel: int = 1
+    model_parallel: int = -1
+    # Force a platform for tests ("cpu") or leave None for auto.
+    platform: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """MiniLM-class sentence encoder (replaces ``indexer.py:21-22`` and
+    ``llm-qa/main.py:25`` — all-MiniLM-L6-v2, 384-d)."""
+
+    vocab_size: int = 30522
+    hidden_dim: int = 384
+    num_layers: int = 6
+    num_heads: int = 12
+    mlp_dim: int = 1536
+    max_seq_len: int = 512
+    embed_dim: int = 384  # pooled output dim
+    dtype: str = "bfloat16"
+    normalize: bool = True  # cosine == L2 on normalized vectors (SURVEY appendix)
+
+
+@dataclass(frozen=True)
+class NERConfig:
+    """Token-classification PHI tagger (replaces Presidio/spaCy,
+    ``anonymizer.py:29-35``).  Labels follow the reference's 6-entity contract
+    (``anonymizer.py:43``) in BIO scheme."""
+
+    vocab_size: int = 30522
+    hidden_dim: int = 256
+    num_layers: int = 4
+    num_heads: int = 8
+    mlp_dim: int = 1024
+    max_seq_len: int = 512
+    entities: Tuple[str, ...] = (
+        "PERSON",
+        "PHONE_NUMBER",
+        "EMAIL_ADDRESS",
+        "DATE_TIME",
+        "NRP",
+        "LOCATION",
+    )
+    dtype: str = "bfloat16"
+
+    @property
+    def num_labels(self) -> int:
+        return 1 + 2 * len(self.entities)  # O + B-/I- per entity
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    """Decoder-only generator (replaces Ollama/Mistral, ``llm-qa/main.py:66-69``).
+    Defaults are a small smoke-size model; ``mistral_7b()`` gives the
+    target-scale config."""
+
+    vocab_size: int = 32000
+    hidden_dim: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 2  # GQA
+    head_dim: int = 64
+    mlp_dim: int = 1408
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    sliding_window: Optional[int] = None
+
+    @staticmethod
+    def mistral_7b() -> "DecoderConfig":
+        return DecoderConfig(
+            vocab_size=32000,
+            hidden_dim=4096,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            mlp_dim=14336,
+            max_seq_len=4096,
+            rope_theta=1000000.0,
+            sliding_window=4096,
+        )
+
+    @staticmethod
+    def llama3_8b() -> "DecoderConfig":
+        return DecoderConfig(
+            vocab_size=128256,
+            hidden_dim=4096,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            mlp_dim=14336,
+            max_seq_len=8192,
+            rope_theta=500000.0,
+        )
+
+
+@dataclass(frozen=True)
+class SummarizerConfig:
+    """Clinical summarizer (BART-class role per BASELINE.json config 4).
+    Implemented as instruction-prompted decoding on the generator; this config
+    bounds the prompt/summary budget (the reference truncated instead:
+    ``llm_client.py:26-30``)."""
+
+    max_input_tokens: int = 3072
+    max_summary_tokens: int = 512
+    max_chunks: int = 5
+
+
+@dataclass(frozen=True)
+class StoreConfig:
+    """HBM-resident sharded vector store (replaces FAISS IndexFlatL2 +
+    on-disk handoff, ``indexer.py:17-18,39`` / ``llm-qa/main.py:35-38``)."""
+
+    dim: int = 384
+    # Rows per device shard bucket.  Append buffer is shape-bucketed so adds
+    # never trigger recompilation (SURVEY §7 hard part (a)).
+    shard_capacity: int = 16384
+    dtype: str = "bfloat16"
+    score: str = "cosine"  # normalized dot == cosine == L2 ranking
+    default_k: int = 3  # reference fan-in, llm-qa/main.py:101
+
+
+@dataclass(frozen=True)
+class ChunkConfig:
+    """Chunking policy.  Reference: fixed 500 chars, no overlap
+    (``indexer.py:120``).  We keep that default and add overlap support."""
+
+    chunk_chars: int = 500
+    overlap_chars: int = 0
+
+
+@dataclass(frozen=True)
+class BrokerConfig:
+    """Service-plane bus (replaces RabbitMQ queues ``raw_documents_queue`` /
+    ``clean_documents_queue``, ``processing.py:8``, ``anonymizer.py:21-22``)."""
+
+    backend: str = "memory"  # "memory" | "amqp"
+    raw_queue: str = "raw_documents_queue"
+    clean_queue: str = "clean_documents_queue"
+    prefetch: int = 8  # reference forced 1 (anonymizer.py:97); we batch
+    max_redelivery: int = 3  # reference dropped poison messages; we DLQ
+    amqp_host: str = "localhost"
+    amqp_port: int = 5672
+
+
+@dataclass(frozen=True)
+class RegistryConfig:
+    """Document-metadata registry (replaces Postgres ``documents`` table,
+    ``doc-ingestor/models.py:5-12``).  SQLite default, URL override for
+    Postgres.  No credentials in code (reference committed them,
+    ``database.py:10``)."""
+
+    url: str = "sqlite://"  # in-memory default; "sqlite:///path.db" for disk
+    table: str = "documents"
+
+
+@dataclass(frozen=True)
+class ServiceConfig:
+    """HTTP surface.  Ports mirror the reference deployment
+    (``start_all.bat:18-35``) with the synthese port fixed to match reality
+    (the reference's default pointed at :8004 while llm-qa served :8001 —
+    ``core/config.py:16-19`` vs ``start_all.bat:31``)."""
+
+    ingest_port: int = 8000
+    qa_port: int = 8001
+    synthesis_port: int = 8005
+    host: str = "0.0.0.0"
+    request_timeout_s: float = 60.0
+
+
+@dataclass(frozen=True)
+class FlagsConfig:
+    """Fake-mode flags (kept from ``core/config.py:22-23`` but injectable)."""
+
+    use_fake_llm: bool = False
+    use_fake_retrieval: bool = False
+    use_fake_encoder: bool = False
+
+
+@dataclass(frozen=True)
+class GenerateConfig:
+    """Decode-loop policy."""
+
+    max_new_tokens: int = 256
+    temperature: float = 0.0  # reference used temperature=0 (llm-qa/main.py:69)
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: int = 2
+    pad_id: int = 0
+    # decode-step bucketing: prefill lengths are padded to these buckets so a
+    # handful of compiled programs cover all requests.
+    prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
+    max_concurrent: int = 16  # continuous batching lanes (QPS 16 target)
+
+
+@dataclass(frozen=True)
+class Config:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    encoder: EncoderConfig = field(default_factory=EncoderConfig)
+    ner: NERConfig = field(default_factory=NERConfig)
+    decoder: DecoderConfig = field(default_factory=DecoderConfig)
+    summarizer: SummarizerConfig = field(default_factory=SummarizerConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
+    chunk: ChunkConfig = field(default_factory=ChunkConfig)
+    broker: BrokerConfig = field(default_factory=BrokerConfig)
+    registry: RegistryConfig = field(default_factory=RegistryConfig)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
+    flags: FlagsConfig = field(default_factory=FlagsConfig)
+    generate: GenerateConfig = field(default_factory=GenerateConfig)
+
+
+_SECTIONS = {f.name: f.type for f in fields(Config)}
+
+
+def _coerce(raw: str, target_type: Any) -> Any:
+    if target_type is bool:
+        return _env_bool(raw)
+    if target_type is int:
+        return int(raw)
+    if target_type is float:
+        return float(raw)
+    if target_type in (str,):
+        return raw
+    # Optional[...] / tuple — try int, float, then raw string.
+    for cast in (int, float):
+        try:
+            return cast(raw)
+        except ValueError:
+            continue
+    if raw.lower() in ("none", "null", ""):
+        return None
+    if raw.lower() in ("true", "false"):
+        return _env_bool(raw)
+    return raw
+
+
+def load_config(
+    env: Optional[Mapping[str, str]] = None,
+    overrides: Optional[Mapping[str, Any]] = None,
+) -> Config:
+    """Build a Config from defaults + env overlay + explicit overrides.
+
+    ``overrides`` maps dotted paths to values, e.g.
+    ``{"store.shard_capacity": 1024, "flags.use_fake_llm": True}``.
+    """
+    env = os.environ if env is None else env
+    cfg = Config()
+    sections = {name: getattr(cfg, name) for name in _SECTIONS}
+
+    prefix = "DOCQA_"
+    for key, raw in env.items():
+        if not key.startswith(prefix) or "__" not in key:
+            continue
+        section_name, _, field_name = key[len(prefix):].partition("__")
+        section_name = section_name.lower()
+        field_name = field_name.lower()
+        section = sections.get(section_name)
+        if section is None:
+            continue
+        by_name = {f.name: f for f in fields(section)}
+        if field_name not in by_name:
+            continue
+        current = getattr(section, field_name)
+        target_type = type(current) if current is not None else str
+        sections[section_name] = dataclasses.replace(
+            section, **{field_name: _coerce(raw, target_type)}
+        )
+
+    if overrides:
+        for path, value in overrides.items():
+            section_name, _, field_name = path.partition(".")
+            section = sections[section_name]
+            sections[section_name] = dataclasses.replace(
+                section, **{field_name: value}
+            )
+
+    return Config(**sections)

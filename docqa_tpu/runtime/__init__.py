@@ -1,0 +1,11 @@
+from docqa_tpu.runtime.mesh import MeshContext, make_mesh
+from docqa_tpu.runtime.metrics import Counter, Histogram, MetricsRegistry, span
+
+__all__ = [
+    "MeshContext",
+    "make_mesh",
+    "Counter",
+    "Histogram",
+    "MetricsRegistry",
+    "span",
+]

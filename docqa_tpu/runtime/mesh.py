@@ -1,0 +1,136 @@
+"""Device-mesh bootstrap.
+
+The reference has no device layer at all (SURVEY §2c: no DP/TP, no collective
+backend — transport is AMQP/HTTP/files).  Here every device-plane program runs
+over a named :class:`jax.sharding.Mesh` with axes ``("data", "model")``:
+
+* ``data`` — batch-axis data parallelism (encoder/NER/summarizer forwards).
+* ``model`` — tensor parallelism over ICI (decoder weights + KV cache, and
+  the vector-store row shards).
+
+Multi-host extends the same mesh over DCN via ``jax.distributed`` — the mesh
+abstraction is identical, only device enumeration changes.
+
+Tests run this on a virtual CPU mesh via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (see tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from docqa_tpu.config import MeshConfig
+
+
+@dataclass(frozen=True)
+class MeshContext:
+    """A mesh plus its canonical shardings."""
+
+    mesh: Mesh
+    data_axis: str
+    model_axis: str
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape[self.model_axis]
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_data * self.n_model
+
+    # ---- canonical shardings -------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    @property
+    def batch_sharded(self) -> NamedSharding:
+        """Leading axis split over data."""
+        return self.sharding(self.data_axis)
+
+    @property
+    def row_sharded(self) -> NamedSharding:
+        """Leading axis split over model — used for vector-store shards."""
+        return self.sharding(self.model_axis)
+
+
+def _factor(n_devices: int, data: int, model: int) -> tuple[int, int]:
+    if data == -1 and model == -1:
+        return 1, n_devices
+    if data == -1:
+        if n_devices % model:
+            raise ValueError(f"{n_devices} devices not divisible by model={model}")
+        return n_devices // model, model
+    if model == -1:
+        if n_devices % data:
+            raise ValueError(f"{n_devices} devices not divisible by data={data}")
+        return data, n_devices // data
+    if data * model != n_devices:
+        raise ValueError(
+            f"mesh {data}x{model} != device count {n_devices}"
+        )
+    return data, model
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshContext:
+    """Build the framework mesh from available devices.
+
+    On a v5e-8 the default is a (1, 8) serving mesh (all-TP); training
+    typically uses (2, 4).  On a single chip this degenerates to (1, 1) and
+    every sharding becomes a no-op — same code path, no special-casing.
+    """
+    cfg = cfg or MeshConfig()
+    if devices is None:
+        if cfg.platform is not None:
+            devices = jax.devices(cfg.platform)
+        else:
+            devices = jax.devices()
+    devices = list(devices)
+    data, model = _factor(len(devices), cfg.data_parallel, cfg.model_parallel)
+    grid = np.asarray(devices).reshape(data, model)
+    mesh = Mesh(grid, (cfg.data_axis, cfg.model_axis))
+    return MeshContext(mesh=mesh, data_axis=cfg.data_axis, model_axis=cfg.model_axis)
+
+
+def host_cpu_mesh(n_devices: int = 8, data: int = 1) -> MeshContext:
+    """A virtual CPU mesh for tests/dryruns.  Requires
+    ``xla_force_host_platform_device_count`` to have been set before the first
+    jax import (conftest / __graft_entry__ handle this)."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} cpu devices, have {len(cpus)}; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n_devices} before jax import"
+        )
+    return make_mesh(
+        MeshConfig(data_parallel=data, model_parallel=n_devices // data),
+        devices=cpus[:n_devices],
+    )
+
+
+def multihost_init() -> None:
+    """Initialize the JAX distributed runtime for multi-host (DCN) operation.
+
+    Single-process if no coordinator is configured — the service plane calls
+    this unconditionally at startup.  (Replaces the reference's absent
+    multi-node story, SURVEY §2c.)
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()

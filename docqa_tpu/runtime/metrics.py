@@ -1,0 +1,163 @@
+"""Metrics, latency histograms, and tracing spans.
+
+The reference has **no** metrics or tracing (SURVEY §5: the only timestamp in
+the whole system is ``processed_at`` stamped at ``anonymizer.py:65``; most
+services log via bare ``print``).  This module supplies the per-stage
+wall-clock spans and p50/p95 request histograms the benchmark contract
+(BASELINE.md) requires, plus optional ``jax.profiler`` trace hooks.
+
+Thread-safe; lock-per-registry.  No global state except a default registry.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Structured logger (the reference used print + emoji in 4 of 5 services,
+    e.g. ``llm-qa/main.py:23``; real logging only in deid,
+    ``anonymizer.py:13-17``)."""
+    logger = logging.getLogger(name)
+    if not logging.getLogger().handlers and not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Sorted-sample histogram with exact percentiles.
+
+    Keeps at most ``max_samples`` (reservoir of the most recent); exact for
+    bench-scale sample counts, bounded memory for long-running services.
+    """
+
+    def __init__(self, name: str, max_samples: int = 65536):
+        self.name = name
+        self._samples: List[float] = []
+        self._recent: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._max_samples = max_samples
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            bisect.insort(self._samples, value)
+            if len(self._samples) > self._max_samples:
+                # drop an extreme alternately to stay bounded but unbiased-ish
+                self._samples.pop(0 if self._count % 2 else -1)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            idx = min(
+                len(self._samples) - 1, max(0, round(q / 100 * (len(self._samples) - 1)))
+            )
+            return self._samples[idx]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else float("nan")
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+@dataclass
+class MetricsRegistry:
+    counters: Dict[str, Counter] = field(default_factory=dict)
+    histograms: Dict[str, Histogram] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self.counters:
+                self.counters[name] = Counter(name)
+            return self.counters[name]
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            if name not in self.histograms:
+                self.histograms[name] = Histogram(name)
+            return self.histograms[name]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counters = dict(self.counters)
+            histograms = dict(self.histograms)
+        return {
+            "counters": {k: c.value for k, c in counters.items()},
+            "histograms": {k: h.summary() for k, h in histograms.items()},
+        }
+
+
+DEFAULT_REGISTRY = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    registry: Optional[MetricsRegistry] = None,
+    profile: bool = False,
+) -> Iterator[None]:
+    """Wall-clock span recorded as ``<name>_ms`` histogram; optionally wraps a
+    ``jax.profiler.TraceAnnotation`` so the stage shows up in TPU traces."""
+    registry = registry or DEFAULT_REGISTRY
+    start = time.perf_counter()
+    if profile:
+        import jax.profiler
+
+        ctx: contextlib.AbstractContextManager = jax.profiler.TraceAnnotation(name)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        try:
+            yield
+        finally:
+            registry.histogram(f"{name}_ms").observe(
+                (time.perf_counter() - start) * 1000.0
+            )

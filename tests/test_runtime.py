@@ -1,0 +1,92 @@
+"""Core runtime: config tree, mesh bootstrap, metrics."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from docqa_tpu.config import Config, load_config
+from docqa_tpu.runtime.mesh import MeshContext, host_cpu_mesh, make_mesh
+from docqa_tpu.runtime.metrics import Histogram, MetricsRegistry, span
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = Config()
+        assert cfg.encoder.embed_dim == 384  # reference parity: MiniLM dim
+        assert cfg.store.default_k == 3  # llm-qa/main.py:101
+        assert cfg.chunk.chunk_chars == 500  # indexer.py:120
+        assert cfg.ner.num_labels == 13  # O + B/I x 6 entities
+        assert not cfg.flags.use_fake_llm  # real by default, unlike reference
+
+    def test_env_overlay(self):
+        cfg = load_config(
+            env={
+                "DOCQA_STORE__SHARD_CAPACITY": "1024",
+                "DOCQA_FLAGS__USE_FAKE_LLM": "true",
+                "DOCQA_BROKER__BACKEND": "amqp",
+                "UNRELATED": "x",
+            }
+        )
+        assert cfg.store.shard_capacity == 1024
+        assert cfg.flags.use_fake_llm is True
+        assert cfg.broker.backend == "amqp"
+
+    def test_overrides_beat_env(self):
+        cfg = load_config(
+            env={"DOCQA_STORE__DIM": "128"},
+            overrides={"store.dim": 64, "decoder.num_layers": 2},
+        )
+        assert cfg.store.dim == 64
+        assert cfg.decoder.num_layers == 2
+
+    def test_mistral_7b_preset(self):
+        cfg = Config().decoder.mistral_7b()
+        assert cfg.hidden_dim == 4096
+        assert cfg.num_kv_heads == 8
+
+
+class TestMesh:
+    def test_virtual_8(self):
+        ctx = host_cpu_mesh(8, data=2)
+        assert ctx.n_data == 2 and ctx.n_model == 4
+        assert ctx.n_devices == 8
+
+    def test_single_device_degenerates(self):
+        ctx = make_mesh(devices=jax.devices("cpu")[:1])
+        assert ctx.n_devices == 1
+
+    def test_sharded_put(self, mesh8: MeshContext):
+        x = jnp.zeros((16, 8))
+        y = jax.device_put(x, mesh8.batch_sharded)
+        assert y.sharding.is_equivalent_to(mesh8.batch_sharded, ndim=2)
+
+    def test_bad_factorization(self):
+        from docqa_tpu.config import MeshConfig
+
+        with pytest.raises(ValueError):
+            make_mesh(
+                MeshConfig(data_parallel=3, model_parallel=-1),
+                devices=jax.devices("cpu")[:8],
+            )
+
+
+class TestMetrics:
+    def test_histogram_percentiles(self):
+        h = Histogram("x")
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.percentile(50) == pytest.approx(50, abs=1)
+        assert h.percentile(95) == pytest.approx(95, abs=1)
+        assert h.count == 100
+
+    def test_span_records(self):
+        reg = MetricsRegistry()
+        with span("stage", registry=reg):
+            pass
+        snap = reg.snapshot()
+        assert snap["histograms"]["stage_ms"]["count"] == 1
+
+    def test_counter(self):
+        reg = MetricsRegistry()
+        reg.counter("docs").inc(3)
+        assert reg.snapshot()["counters"]["docs"] == 3
