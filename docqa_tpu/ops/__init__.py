@@ -1,0 +1,15 @@
+from docqa_tpu.ops.norms import layer_norm, rms_norm
+from docqa_tpu.ops.rope import apply_rope, rope_angles
+from docqa_tpu.ops.attention import attention, flash_attention
+from docqa_tpu.ops.topk import merge_topk, sharded_topk
+
+__all__ = [
+    "layer_norm",
+    "rms_norm",
+    "apply_rope",
+    "rope_angles",
+    "attention",
+    "flash_attention",
+    "merge_topk",
+    "sharded_topk",
+]

@@ -1,0 +1,295 @@
+"""Attention ops: XLA reference path + Pallas TPU flash kernel.
+
+Replaces what the reference outsourced entirely (attention lived inside
+Ollama/llama.cpp and torch sentence-transformers — ``llm-qa/main.py:66-69``,
+``semantic-indexer/indexer.py:21``).  Design per SURVEY §5 "long-context":
+the kernel is blockwise over the KV axis with online softmax, so the sequence
+axis can shard across devices — ``parallel/ring_attention.py`` reuses the
+same blockwise accumulation over an ICI ring.
+
+Layouts:
+  q        [batch, q_len, num_q_heads, head_dim]
+  k, v     [batch, kv_len, num_kv_heads, head_dim]   (GQA: q_heads % kv_heads == 0)
+  lengths  [batch] int32 — valid KV prefix per example (padding mask)
+
+The dispatcher :func:`attention` picks the Pallas kernel on TPU and the pure
+XLA path elsewhere (CPU tests run the kernel in interpret mode explicitly).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------
+# Reference XLA implementation (also the CPU path and the golden model)
+# --------------------------------------------------------------------------
+
+def attention_reference(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    lengths: Optional[jax.Array] = None,
+    q_offset: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+):
+    """Plain XLA attention.  f32 softmax, bf16 matmuls via preferred type.
+
+    ``q_offset`` [batch]: absolute position of q[:, 0] (decode steps where
+    q_len << kv_len).  Defaults to aligning the *ends* of q and kv when
+    causal (standard prefill/decode convention).
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if groups > 1:
+        kf = jnp.repeat(kf, groups, axis=2)
+        vf = jnp.repeat(vf, groups, axis=2)
+
+    # [b, h, sq, skv]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+
+    kv_pos = jnp.arange(skv)[None, None, None, :]
+    mask = jnp.ones((b, 1, sq, skv), dtype=bool)
+    if lengths is not None:
+        mask &= kv_pos < lengths[:, None, None, None]
+    if causal:
+        if q_offset is None:
+            q_abs = jnp.arange(sq)[None, :] + (
+                (lengths[:, None] if lengths is not None else skv) - sq
+            )
+        else:
+            q_abs = jnp.arange(sq)[None, :] + q_offset[:, None]
+        q_abs = q_abs[:, None, :, None]  # [b,1,sq,1]
+        mask &= kv_pos <= q_abs
+        if sliding_window is not None:
+            mask &= kv_pos > q_abs - sliding_window
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # a row with no valid kv position (can only happen on padding rows)
+    # outputs zeros, matching the flash kernel
+    probs = jnp.where(jnp.any(mask, axis=-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas flash kernel
+# --------------------------------------------------------------------------
+
+def _flash_kernel(
+    # scalar prefetch
+    lengths_ref,  # [b] int32 valid kv length
+    qoff_ref,  # [b] int32 absolute position of q row 0
+    # blocks
+    q_ref,  # [1, bq, d]
+    k_ref,  # [1, bkv, d]
+    v_ref,  # [1, bkv, d]
+    o_ref,  # [1, bq, d]
+    # scratch
+    acc_ref,  # [bq, d] f32
+    m_ref,  # [bq, 128] f32 running max (lane-replicated)
+    l_ref,  # [bq, 128] f32 running denom
+    *,
+    causal: bool,
+    sliding_window: Optional[int],
+    scale: float,
+    block_kv: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    # grid dim 0 is batch*q_heads; recover the batch index for scalars
+    batch = pl.program_id(0) // (pl.num_programs(0) // lengths_ref.shape[0])
+    kv_len = lengths_ref[batch]
+    q_off = qoff_ref[batch]
+
+    bq = q_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    kv_start = ki * block_kv
+    q_rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
+    kv_cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
+    q_abs = q_rows + q_off
+
+    mask = kv_cols < kv_len
+    if causal:
+        mask &= kv_cols <= q_abs
+        if sliding_window is not None:
+            mask &= kv_cols > q_abs - sliding_window
+
+    # Skip fully-masked blocks (beyond causal frontier or past kv_len).
+    block_live = jnp.logical_and(
+        kv_start < kv_len,
+        (not causal) or (kv_start <= qi * bq + bq - 1 + q_off),
+    )
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit re-mask: in a fully-masked block m_new == NEG_INF and
+        # exp(s - m_new) would be 1, not 0
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [bq, bkv]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, d]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == n_kv - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    lengths: Optional[jax.Array] = None,
+    q_offset: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 512,
+    interpret: bool = False,
+):
+    """Blockwise flash attention as a Pallas TPU kernel.
+
+    Grid: (batch*q_heads, q_blocks, kv_blocks) — the kv axis is innermost so
+    the online-softmax scratch carries across kv steps on one core.  GQA is
+    handled by indexing the kv head as ``q_head // group``.
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    groups = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+
+    # Pad seq lengths up to block multiples (static shapes; masked out).
+    pq = (-sq) % block_q
+    pkv = (-skv) % block_kv
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, pq), (0, 0), (0, 0)))
+    if pkv:
+        k = jnp.pad(k, ((0, 0), (0, pkv), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pkv), (0, 0), (0, 0)))
+    sq_p, skv_p = sq + pq, skv + pkv
+
+    if lengths is None:
+        lengths = jnp.full((b,), skv, jnp.int32)
+    if q_offset is None:
+        q_offset = lengths - sq if causal else jnp.zeros((b,), jnp.int32)
+
+    # [b, s, h, d] -> [b*h, s, d]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * hq, sq_p, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, skv_p, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, skv_p, d)
+
+    grid = (b * hq, sq_p // block_q, skv_p // block_kv)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        sliding_window=sliding_window,
+        scale=scale,
+        block_kv=block_kv,
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            # index maps receive (grid..., *scalar_prefetch_refs)
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_q, d), lambda h, qi, ki, *_: (h, qi, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_kv, d),
+                    lambda h, qi, ki, *_, groups=groups: (h // groups, ki, 0),
+                ),
+                pl.BlockSpec(
+                    (1, block_kv, d),
+                    lambda h, qi, ki, *_, groups=groups: (h // groups, ki, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda h, qi, ki, *_: (h, qi, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q_offset.astype(jnp.int32), qr, kr, vr)
+
+    out = out.reshape(b, hq, sq_p, d).transpose(0, 2, 1, 3)
+    return out[:, :sq]
+
+
+# --------------------------------------------------------------------------
+# Dispatcher
+# --------------------------------------------------------------------------
+
+_FLASH_ONLY_KWARGS = ("block_q", "block_kv", "interpret")
+
+
+def attention(q, k, v, **kwargs):
+    """Use the Pallas kernel on TPU, the XLA path elsewhere.
+
+    Platform is resolved from the default backend (a host-side constant), not
+    from the arrays — this function is called from inside ``jit`` where the
+    inputs are tracers.
+    """
+    if jax.default_backend() == "tpu" and q.shape[-1] % 64 == 0:
+        return flash_attention(q, k, v, **kwargs)
+    for kw in _FLASH_ONLY_KWARGS:
+        kwargs.pop(kw, None)
+    return attention_reference(q, k, v, **kwargs)

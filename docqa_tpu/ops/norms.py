@@ -1,0 +1,30 @@
+"""Normalization ops.
+
+Computed in float32 regardless of input dtype (bfloat16 activations lose too
+much precision in the variance), cast back on exit — the standard TPU recipe.
+XLA fuses these into neighboring matmuls; no Pallas needed here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x, gamma, beta, eps: float = 1e-12):
+    """BERT-style LayerNorm over the last axis (encoder/NER stacks)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma.astype(jnp.float32) + beta.astype(jnp.float32)).astype(dtype)
+
+
+def rms_norm(x, gamma, eps: float = 1e-5):
+    """RMSNorm over the last axis (decoder stack, Llama/Mistral-style)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + eps)
+    return (y * gamma.astype(jnp.float32)).astype(dtype)

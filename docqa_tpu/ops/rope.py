@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE) for the decoder stack.
+
+Angles are precomputed once per model (host) and passed in as an array; the
+application is a pure elementwise op XLA fuses into the QK projections.
+Uses the split-halves convention (Llama/Mistral style, matching HF weights).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(head_dim: int, max_len: int, theta: float = 10000.0):
+    """Return (cos, sin), each [max_len, head_dim/2], float32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [max_len, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin, positions):
+    """Rotate q or k.
+
+    Args:
+      x: [batch, seq, heads, head_dim]
+      cos, sin: [max_len, head_dim/2] tables from :func:`rope_angles`
+      positions: [batch, seq] int32 absolute positions (supports ragged
+        decode — each lane carries its own offset)
+    """
+    dtype = x.dtype
+    c = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

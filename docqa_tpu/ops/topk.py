@@ -1,0 +1,60 @@
+"""Exact top-k over device-sharded score rows.
+
+This is the device-plane replacement for FAISS ``IndexFlatL2.search``
+(``semantic-indexer/indexer.py:39``, ``llm-qa/main.py:35``): each device
+holds a row shard of the corpus matrix, computes local scores with one MXU
+matmul, takes a local ``lax.top_k``, and the k-candidate (score, id) pairs
+are merged globally — k*n_shards candidates per query instead of the full
+row, so the ICI all-gather is tiny (SURVEY §7 hard part (c)).
+
+Two merge flavors:
+  * :func:`merge_topk` — pure function of stacked per-shard results
+    (used by the serving path after a gather).
+  * :func:`sharded_topk` — runs *inside* ``shard_map``: local top-k then
+    ``all_gather`` over the mesh axis + global top-k.  Exact.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def local_topk(scores, k: int):
+    """Per-shard top-k.  scores [q, n_local] -> (vals [q,k], idx [q,k])."""
+    k = min(k, scores.shape[-1])
+    return jax.lax.top_k(scores, k)
+
+
+def merge_topk(shard_vals, shard_ids, k: int):
+    """Merge per-shard candidates.
+
+    Args:
+      shard_vals: [n_shards, q, k_local] scores
+      shard_ids:  [n_shards, q, k_local] *global* ids
+    Returns (vals [q, k], ids [q, k]) globally exact.
+    """
+    n_shards, q, k_local = shard_vals.shape
+    flat_vals = shard_vals.transpose(1, 0, 2).reshape(q, n_shards * k_local)
+    flat_ids = shard_ids.transpose(1, 0, 2).reshape(q, n_shards * k_local)
+    vals, pos = jax.lax.top_k(flat_vals, min(k, flat_vals.shape[-1]))
+    ids = jnp.take_along_axis(flat_ids, pos, axis=-1)
+    return vals, ids
+
+
+def sharded_topk(scores_local, shard_offset, k: int, axis_name: str):
+    """Inside ``shard_map``: local scores -> global exact top-k.
+
+    Args:
+      scores_local: [q, n_local] this shard's scores
+      shard_offset: scalar int32 — global id of this shard's row 0
+      k: fan-in
+      axis_name: mesh axis the corpus rows are sharded over
+    Returns replicated (vals [q, k], global_ids [q, k]).
+    """
+    vals, idx = local_topk(scores_local, k)
+    gids = idx + shard_offset
+    # [n_shards, q, k] on every member after the gather (rides ICI)
+    all_vals = jax.lax.all_gather(vals, axis_name)
+    all_ids = jax.lax.all_gather(gids, axis_name)
+    return merge_topk(all_vals, all_ids, k)
