@@ -1,0 +1,3 @@
+from docqa_tpu.engines.encoder import EncoderEngine
+
+__all__ = ["EncoderEngine"]

@@ -1,0 +1,7 @@
+from docqa_tpu.models.encoder import (
+    encode_batch,
+    encoder_forward,
+    init_encoder_params,
+)
+
+__all__ = ["init_encoder_params", "encoder_forward", "encode_batch"]

@@ -54,6 +54,8 @@ def attention_reference(
     _, skv, hkv, _ = k.shape
     groups = hq // hkv
     scale = scale if scale is not None else d ** -0.5
+    if sliding_window is not None and not causal:
+        raise ValueError("sliding_window requires causal=True (bidirectional local attention is not implemented)")
 
     qf = q.astype(jnp.float32) * scale
     kf = k.astype(jnp.float32)
@@ -140,11 +142,15 @@ def _flash_kernel(
         if sliding_window is not None:
             mask &= kv_cols > q_abs - sliding_window
 
-    # Skip fully-masked blocks (beyond causal frontier or past kv_len).
-    block_live = jnp.logical_and(
-        kv_start < kv_len,
-        (not causal) or (kv_start <= qi * bq + bq - 1 + q_off),
-    )
+    # Skip fully-masked blocks: past kv_len, beyond the causal frontier, or
+    # entirely before the sliding window of every q row in this block.
+    block_live = kv_start < kv_len
+    if causal:
+        q_abs_max = qi * bq + bq - 1 + q_off
+        block_live &= kv_start <= q_abs_max
+        if sliding_window is not None:
+            q_abs_min = qi * bq + q_off
+            block_live &= kv_start + block_kv > q_abs_min - sliding_window
 
     @pl.when(block_live)
     def _compute():
@@ -202,6 +208,8 @@ def flash_attention(
     _, skv, hkv, _ = k.shape
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if sliding_window is not None and not causal:
+        raise ValueError("sliding_window requires causal=True (bidirectional local attention is not implemented)")
     groups = hq // hkv
     scale = scale if scale is not None else d ** -0.5
 

@@ -27,7 +27,11 @@ def apply_rope(x, cos, sin, positions):
       x: [batch, seq, heads, head_dim]
       cos, sin: [max_len, head_dim/2] tables from :func:`rope_angles`
       positions: [batch, seq] int32 absolute positions (supports ragged
-        decode — each lane carries its own offset)
+        decode — each lane carries its own offset).  Contract: positions
+        MUST be < max_len — JAX gather clamps out-of-bounds indices, so a
+        position past the table silently reuses the last row's angles.
+        Size tables to the model's max_seq_len (the decode engine bounds
+        positions accordingly).
     """
     dtype = x.dtype
     c = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]

@@ -1,0 +1,3 @@
+from docqa_tpu.text.tokenizer import HashTokenizer, Tokenizer, WordPieceTokenizer
+
+__all__ = ["Tokenizer", "WordPieceTokenizer", "HashTokenizer"]

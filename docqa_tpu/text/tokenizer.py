@@ -1,0 +1,161 @@
+"""Tokenizers for the encoder / NER / decoder stacks.
+
+The reference delegated tokenization to sentence-transformers / Ollama
+internals (``semantic-indexer/indexer.py:21``, ``llm-qa/main.py:66-69``).
+Here tokenization is first-class and host-side:
+
+* :class:`WordPieceTokenizer` — BERT-style greedy longest-match-first over a
+  ``vocab.txt``; used when real model vocabularies are available on disk
+  (zero-egress environment — no downloads).
+* :class:`HashTokenizer` — deterministic fallback with the same API: word →
+  stable FNV-1a hash bucket.  Retrieval and pipeline tests don't need a real
+  vocabulary, only a deterministic text → ids map.
+
+Output contract everywhere: right-padded ``ids [batch, max_len]`` plus
+``lengths [batch]`` — the padding convention the device-plane masks
+(``ops/attention.py`` ``lengths`` argument) expect.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PAD, UNK, CLS, SEP, MASK = 0, 1, 2, 3, 4
+_SPECIALS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+
+_WORD_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+
+
+def _fnv1a(s: str) -> int:
+    h = 0xCBF29CE484222325
+    for byte in s.encode("utf-8"):
+        h ^= byte
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class Tokenizer:
+    """Base: whitespace/punct pre-tokenization + subclass word→ids."""
+
+    pad_id = PAD
+    unk_id = UNK
+    cls_id = CLS
+    sep_id = SEP
+
+    def __init__(self, vocab_size: int, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.lowercase = lowercase
+
+    def pre_tokenize(self, text: str) -> List[str]:
+        if self.lowercase:
+            text = text.lower()
+        return _WORD_RE.findall(text)
+
+    def word_to_ids(self, word: str) -> List[int]:
+        raise NotImplementedError
+
+    def encode(
+        self, text: str, max_len: Optional[int] = None, add_specials: bool = True
+    ) -> List[int]:
+        ids: List[int] = [self.cls_id] if add_specials else []
+        budget = None if max_len is None else max_len - (2 if add_specials else 0)
+        for word in self.pre_tokenize(text):
+            wids = self.word_to_ids(word)
+            if budget is not None and len(ids) - (1 if add_specials else 0) + len(
+                wids
+            ) > budget:
+                break
+            ids.extend(wids)
+        if add_specials:
+            ids.append(self.sep_id)
+        return ids
+
+    def batch(
+        self,
+        texts: Sequence[str],
+        max_len: int,
+        add_specials: bool = True,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Right-padded [batch, max_len] int32 ids + [batch] lengths."""
+        rows = [self.encode(t, max_len, add_specials) for t in texts]
+        out = np.full((len(rows), max_len), self.pad_id, np.int32)
+        lengths = np.zeros((len(rows),), np.int32)
+        for i, row in enumerate(rows):
+            row = row[:max_len]
+            out[i, : len(row)] = row
+            lengths[i] = len(row)
+        return out, lengths
+
+
+class HashTokenizer(Tokenizer):
+    """Deterministic hash-bucket tokenizer (no vocabulary file needed)."""
+
+    def __init__(self, vocab_size: int = 30522, lowercase: bool = True):
+        super().__init__(vocab_size, lowercase)
+        self._n_reserved = len(_SPECIALS)
+
+    def word_to_ids(self, word: str) -> List[int]:
+        bucket = self._n_reserved + _fnv1a(word) % (
+            self.vocab_size - self._n_reserved
+        )
+        return [int(bucket)]
+
+
+class WordPieceTokenizer(Tokenizer):
+    """Greedy longest-match-first WordPiece over a BERT ``vocab.txt``."""
+
+    def __init__(
+        self,
+        vocab: Sequence[str],
+        lowercase: bool = True,
+        max_word_chars: int = 100,
+    ):
+        super().__init__(len(vocab), lowercase)
+        self.vocab = {tok: i for i, tok in enumerate(vocab)}
+        self.max_word_chars = max_word_chars
+        for name, attr in (
+            ("[PAD]", "pad_id"),
+            ("[UNK]", "unk_id"),
+            ("[CLS]", "cls_id"),
+            ("[SEP]", "sep_id"),
+        ):
+            if name in self.vocab:
+                setattr(self, attr, self.vocab[name])
+
+    @classmethod
+    def from_file(cls, path: str, **kwargs) -> "WordPieceTokenizer":
+        with open(path, encoding="utf-8") as f:
+            vocab = [line.rstrip("\n") for line in f]
+        return cls(vocab, **kwargs)
+
+    def word_to_ids(self, word: str) -> List[int]:
+        if len(word) > self.max_word_chars:
+            return [self.unk_id]
+        ids: List[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece_id = None
+            while end > start:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    piece_id = self.vocab[piece]
+                    break
+                end -= 1
+            if piece_id is None:
+                return [self.unk_id]
+            ids.append(piece_id)
+            start = end
+        return ids
+
+
+def default_tokenizer(vocab_size: int = 30522, vocab_path: Optional[str] = None):
+    """WordPiece if a vocab file is supplied/present, hash fallback otherwise."""
+    if vocab_path:
+        return WordPieceTokenizer.from_file(vocab_path)
+    return HashTokenizer(vocab_size)
