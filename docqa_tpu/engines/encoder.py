@@ -74,6 +74,10 @@ class EncoderEngine:
             _bucket(int(lengths.max()) if n else 1, SEQ_BUCKETS), ids.shape[1]
         )
         batch_b = _bucket(n, BATCH_BUCKETS)
+        if self.mesh is not None:
+            # batch axis must divide evenly over the data axis
+            nd = self.mesh.n_data
+            batch_b = -(-batch_b // nd) * nd
         ids_p = np.zeros((batch_b, seq_b), np.int32)
         len_p = np.zeros((batch_b,), np.int32)
         ids_p[:n] = ids[:, :seq_b]

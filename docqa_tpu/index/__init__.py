@@ -1,0 +1,3 @@
+from docqa_tpu.index.store import SearchResult, VectorStore
+
+__all__ = ["VectorStore", "SearchResult"]

@@ -1,0 +1,336 @@
+"""HBM-resident sharded vector store.
+
+Replaces FAISS ``IndexFlatL2`` + pickle metadata + the shared-filesystem
+handoff (``semantic-indexer/indexer.py:17-48,26-30``; ``llm-qa/main.py:35-58``).
+Reference defects fixed by design (SURVEY §5 "race detection"):
+
+* the indexer rewrote the whole index to disk after **every** message while
+  the QA service read the same files unlocked → here both planes share one
+  in-process store; snapshots are atomic (write-temp + rename) and versioned;
+* the QA service loaded the index **once at startup** → here every search
+  sees the current device buffer (device-side append, no restart);
+* metadata recorded only a source string (``indexer.py:123``) so
+  patient-level retrieval was unimplementable (SURVEY appendix) → here
+  metadata carries first-class ``patient_id`` / ``doc_type`` / ``date``.
+
+Device layout: one [capacity, dim] bf16 buffer, rows sharded over the
+``model`` mesh axis.  Search = one MXU matmul + per-shard ``lax.top_k`` +
+tiny all-gather merge (``ops/topk.py``) under ``shard_map``.  Appends write
+into preallocated capacity via donated ``dynamic_update_slice`` — no
+reallocation, no recompilation until capacity doubles (shape bucketing,
+SURVEY §7 hard part (a)).
+
+Scores are dot products over L2-normalized embeddings == cosine; identical
+ranking to the reference's L2-over-MiniLM (SURVEY appendix).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from docqa_tpu.config import StoreConfig
+from docqa_tpu.ops.topk import sharded_topk
+from docqa_tpu.runtime.mesh import MeshContext
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+
+log = get_logger("docqa.store")
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SearchResult:
+    score: float
+    row_id: int
+    metadata: Dict[str, Any]
+
+
+def _search_kernel(vectors, queries, count, filter_mask, k: int, axis: str):
+    """Runs inside shard_map.  vectors [n_local, d], queries [q, d] replicated,
+    count/filter replicated; returns replicated (vals [q,k], global ids)."""
+    n_local = vectors.shape[0]
+    shard = jax.lax.axis_index(axis)
+    offset = shard * n_local
+    scores = jax.lax.dot_general(
+        queries,
+        vectors,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [q, n_local]
+    rows = offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    live = rows < count
+    mask_local = jax.lax.dynamic_slice_in_dim(filter_mask, offset, n_local, 0)
+    scores = jnp.where(live & mask_local[None, :], scores, NEG_INF)
+    return sharded_topk(scores, offset, k, axis)
+
+
+def _search_single(vectors, queries, count, filter_mask, k: int):
+    scores = jax.lax.dot_general(
+        queries, vectors, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where((rows < count) & filter_mask[None, :], scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+def _append_kernel(buf, rows, offset):
+    return jax.lax.dynamic_update_slice_in_dim(buf, rows, offset, 0)
+
+
+class VectorStore:
+    """Append + exact-search over device-sharded vectors with host metadata."""
+
+    def __init__(
+        self,
+        cfg: StoreConfig,
+        mesh: Optional[MeshContext] = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self._lock = threading.RLock()
+        self._meta: List[Dict[str, Any]] = []
+        self._host = np.zeros((0, cfg.dim), np.float32)  # durable master copy
+        self._count = 0
+        self._version = 0
+        self._n_shards = mesh.n_model if mesh is not None else 1
+        self._capacity = self._round_capacity(cfg.shard_capacity)
+        self._dtype = jnp.dtype(cfg.dtype)
+        self._dev = self._alloc(self._capacity)
+        self._search_fns: Dict[Tuple[int, int, int], Callable] = {}
+        self._append_jit = jax.jit(_append_kernel, donate_argnums=(0,))
+
+    # ---- capacity management -------------------------------------------------
+
+    def _round_capacity(self, n: int) -> int:
+        """Round up to a multiple of 128*n_shards (MXU sublane + even shards)."""
+        quantum = 128 * self._n_shards
+        return max(quantum, -(-n // quantum) * quantum)
+
+    def _alloc(self, capacity: int) -> jax.Array:
+        buf = jnp.zeros((capacity, self.cfg.dim), self._dtype)
+        if self.mesh is not None:
+            buf = jax.device_put(buf, self.mesh.row_sharded)
+        return buf
+
+    def _grow_to(self, needed: int) -> None:
+        new_cap = self._capacity
+        while new_cap < needed:
+            new_cap *= 2
+        if new_cap == self._capacity:
+            return
+        log.info("store grow %d -> %d rows", self._capacity, new_cap)
+        self._capacity = new_cap
+        buf = np.zeros((new_cap, self.cfg.dim), np.float32)
+        buf[: self._count] = self._host[: self._count]
+        self._dev = jnp.asarray(buf, self._dtype)
+        if self.mesh is not None:
+            self._dev = jax.device_put(self._dev, self.mesh.row_sharded)
+
+    # ---- public API ----------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.dim
+
+    def add(
+        self, vectors: np.ndarray, metadata: Sequence[Dict[str, Any]]
+    ) -> List[int]:
+        """Append normalized vectors + metadata rows; returns global row ids.
+
+        Visible to searches immediately (device-side append — the reference
+        required a service restart, ``llm-qa/main.py:35``).
+        """
+        vectors = np.asarray(vectors, np.float32)
+        if vectors.ndim != 2 or vectors.shape[1] != self.cfg.dim:
+            raise ValueError(f"expected [n, {self.cfg.dim}] vectors, got {vectors.shape}")
+        if len(vectors) != len(metadata):
+            raise ValueError("vectors/metadata length mismatch")
+        norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+        vectors = vectors / np.maximum(norms, 1e-9)
+
+        with self._lock, span("store_add", DEFAULT_REGISTRY):
+            start = self._count
+            n = len(vectors)
+            if self._host.shape[0] < start + n:
+                grow = max(start + n, 2 * max(1, self._host.shape[0]))
+                host = np.zeros((grow, self.cfg.dim), np.float32)
+                host[:start] = self._host[:start]
+                self._host = host
+            self._host[start : start + n] = vectors
+            # pad the appended block to a 64-row bucket so repeated adds of
+            # varying sizes reuse a handful of compiled programs; the padding
+            # lands beyond count (zeros over zeros) and capacity is grown to
+            # keep the padded write in bounds
+            n_pad = -(-n // 64) * 64
+            self._grow_to(start + n_pad)
+            rows = np.zeros((n_pad, self.cfg.dim), np.float32)
+            rows[:n] = vectors
+            self._dev = self._append_jit(
+                self._dev, jnp.asarray(rows, self._dtype), start
+            )
+            self._meta.extend(dict(m) for m in metadata)
+            self._count = start + n
+            self._version += 1
+            return list(range(start, start + n))
+
+    def _get_search_fn(self, q: int, k: int) -> Callable:
+        key = (self._capacity, q, k)
+        fn = self._search_fns.get(key)
+        if fn is not None:
+            return fn
+        if self.mesh is not None and self._n_shards > 1:
+            kernel = functools.partial(
+                _search_kernel, k=k, axis=self.mesh.model_axis
+            )
+            fn = jax.jit(
+                shard_map(
+                    kernel,
+                    mesh=self.mesh.mesh,
+                    in_specs=(
+                        P(self.mesh.model_axis, None),  # vectors row-sharded
+                        P(),  # queries replicated
+                        P(),  # count
+                        P(),  # filter mask
+                    ),
+                    out_specs=(P(), P()),
+                    check_vma=False,
+                )
+            )
+        else:
+            fn = jax.jit(functools.partial(_search_single, k=k))
+        self._search_fns[key] = fn
+        return fn
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: Optional[int] = None,
+        where: Optional[Callable[[Dict[str, Any]], bool]] = None,
+    ) -> List[List[SearchResult]]:
+        """Exact top-k over the live buffer.
+
+        ``where``: optional host-side metadata predicate compiled into a
+        device-side mask — scoring stays on the MXU, selection stays exact.
+        """
+        k = k or self.cfg.default_k
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        qn = queries / np.maximum(
+            np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+        )
+        # Dispatch under the lock: add() donates the device buffer, so the
+        # buffer reference must not be used for a new dispatch after an add
+        # replaced it.  The enqueued computation holds its own runtime
+        # reference, so only the dispatch (not the result fetch) needs the
+        # lock.  _meta is append-only, so rows < count are stable to read
+        # outside the lock.
+        with self._lock:
+            count = self._count
+            capacity = self._capacity
+            if count == 0:
+                return [[] for _ in queries]
+            k_eff = min(k, count)
+            if where is None:
+                mask = np.ones((capacity,), bool)
+            else:
+                mask = np.zeros((capacity,), bool)
+                for i in range(count):
+                    mask[i] = bool(where(self._meta[i]))
+            fn = self._get_search_fn(len(qn), k_eff)
+            with span("store_search", DEFAULT_REGISTRY):
+                vals, ids = fn(
+                    self._dev,
+                    jnp.asarray(qn, self._dtype),
+                    jnp.int32(count),
+                    jnp.asarray(mask),
+                )
+        vals = np.asarray(vals)
+        ids = np.asarray(ids)
+
+        out: List[List[SearchResult]] = []
+        for qi in range(len(qn)):
+            row: List[SearchResult] = []
+            for score, rid in zip(vals[qi], ids[qi]):
+                if score <= NEG_INF / 2:
+                    continue  # filtered / dead row
+                row.append(
+                    SearchResult(float(score), int(rid), self._meta[int(rid)])
+                )
+            out.append(row)
+        return out
+
+    # ---- versioned snapshot (checkpoint/resume parity, SURVEY §5) -----------
+
+    def snapshot(self, directory: str) -> str:
+        """Atomic versioned publish: vectors + metadata + manifest.
+
+        Write-temp + rename — a reader never sees a half-written index
+        (the reference's save had no such guarantee, ``indexer.py:26-30``).
+        """
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            count, version = self._count, self._version
+            vectors = self._host[:count].copy()
+            meta = list(self._meta)
+        base = os.path.join(directory, f"index_v{version}")
+        tmp = tempfile.mkdtemp(dir=directory)
+        np.save(os.path.join(tmp, "vectors.npy"), vectors)
+        with open(os.path.join(tmp, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(
+                {"version": version, "count": count, "dim": self.cfg.dim}, f
+            )
+        if os.path.exists(base):  # re-publishing an unchanged version
+            import shutil
+
+            shutil.rmtree(tmp)
+        else:
+            os.replace(tmp, base)
+        latest = os.path.join(directory, "LATEST")
+        with open(latest + ".tmp", "w") as f:
+            f.write(f"index_v{version}")
+        os.replace(latest + ".tmp", latest)
+        return base
+
+    @classmethod
+    def restore(
+        cls,
+        directory: str,
+        cfg: StoreConfig,
+        mesh: Optional[MeshContext] = None,
+    ) -> "VectorStore":
+        with open(os.path.join(directory, "LATEST")) as f:
+            base = os.path.join(directory, f.read().strip())
+        with open(os.path.join(base, "manifest.json")) as f:
+            manifest = json.load(f)
+        vectors = np.load(os.path.join(base, "vectors.npy"))
+        with open(os.path.join(base, "metadata.json")) as f:
+            meta = json.load(f)
+        store = cls(cfg, mesh=mesh)
+        if len(vectors):
+            store.add(vectors, meta)
+        store._version = manifest["version"]
+        return store

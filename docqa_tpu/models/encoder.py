@@ -45,6 +45,10 @@ def init_encoder_params(rng: jax.Array, cfg: EncoderConfig) -> Params:
         "emb_ln_g": jnp.ones((cfg.hidden_dim,)),
         "emb_ln_b": jnp.zeros((cfg.hidden_dim,)),
     }
+    if cfg.embed_dim != cfg.hidden_dim:
+        # sentence-transformers-style Dense head after pooling
+        p["proj_w"] = norm((cfg.hidden_dim, cfg.embed_dim))
+        p["proj_b"] = jnp.zeros((cfg.embed_dim,))
     for i in range(cfg.num_layers):
         h, m = cfg.hidden_dim, cfg.mlp_dim
         p.update(
@@ -130,7 +134,16 @@ def encode_batch(
 ) -> jax.Array:
     """[b, s] ids -> [b, embed_dim] normalized embeddings.  Jit this."""
     hidden = encoder_forward(params, cfg, ids, lengths)
-    return mean_pool_normalize(hidden, lengths, cfg.normalize)
+    pooled = mean_pool_normalize(hidden, lengths, normalize=False)
+    if cfg.embed_dim != cfg.hidden_dim:
+        pooled = pooled @ params["proj_w"].astype(jnp.float32) + params[
+            "proj_b"
+        ].astype(jnp.float32)
+    if cfg.normalize:
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+        )
+    return pooled
 
 
 # --------------------------------------------------------------------------

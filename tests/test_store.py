@@ -1,0 +1,140 @@
+"""Vector store: exact search, filters, growth, snapshot, sharded mesh."""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import StoreConfig
+from docqa_tpu.index import VectorStore
+
+
+def _rand_vectors(n, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(n, dim)).astype(np.float32)
+    return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+
+CFG = StoreConfig(dim=64, shard_capacity=256, dtype="float32")
+
+
+def _meta(n, **kw):
+    return [{"doc_id": f"d{i}", "text": f"chunk {i}", **kw} for i in range(n)]
+
+
+class TestExactness:
+    def test_matches_numpy_brute_force(self):
+        store = VectorStore(CFG)
+        v = _rand_vectors(200, 64)
+        store.add(v, _meta(200))
+        q = _rand_vectors(5, 64, seed=1)
+        results = store.search(q, k=10)
+        want = np.argsort(-(q @ v.T), axis=1)[:, :10]
+        for qi in range(5):
+            got_ids = [r.row_id for r in results[qi]]
+            assert got_ids == list(want[qi])
+
+    def test_incremental_visibility(self):
+        # rows are searchable immediately after add — no restart, no reload
+        store = VectorStore(CFG)
+        v = _rand_vectors(10, 64)
+        store.add(v[:5], _meta(5))
+        probe = v[7:8]
+        before = store.search(probe, k=1)[0][0]
+        store.add(v[5:], [{"doc_id": f"d{5+i}"} for i in range(5)])
+        after = store.search(probe, k=1)[0][0]
+        assert after.row_id == 7
+        assert after.score > before.score
+
+    def test_scores_are_cosine(self):
+        store = VectorStore(CFG)
+        v = _rand_vectors(4, 64)
+        store.add(v * 5.0, _meta(4))  # unnormalized input gets normalized
+        r = store.search(v[2] * 3.0, k=1)[0][0]
+        assert r.row_id == 2
+        assert r.score == pytest.approx(1.0, abs=2e-3)
+
+
+class TestFilters:
+    def test_patient_filter(self):
+        store = VectorStore(CFG)
+        v = _rand_vectors(30, 64)
+        meta = [{"patient_id": f"P{i % 3}", "doc_id": f"d{i}"} for i in range(30)]
+        store.add(v, meta)
+        res = store.search(
+            v[0], k=30, where=lambda m: m["patient_id"] == "P1"
+        )[0]
+        assert 0 < len(res) <= 10
+        assert all(r.metadata["patient_id"] == "P1" for r in res)
+
+    def test_filter_all_out(self):
+        store = VectorStore(CFG)
+        store.add(_rand_vectors(5, 64), _meta(5))
+        res = store.search(np.ones(64), k=3, where=lambda m: False)[0]
+        assert res == []
+
+
+class TestGrowth:
+    def test_grow_past_capacity(self):
+        store = VectorStore(CFG)  # capacity rounds to 256
+        v = _rand_vectors(700, 64)
+        for s in range(0, 700, 100):
+            store.add(v[s : s + 100], _meta(100))
+        assert store.count == 700
+        q = v[650:651]
+        assert store.search(q, k=1)[0][0].row_id == 650
+
+    def test_empty_store(self):
+        store = VectorStore(CFG)
+        assert store.search(np.ones(64), k=5) == [[]]
+
+    def test_bad_dim_rejected(self):
+        store = VectorStore(CFG)
+        with pytest.raises(ValueError):
+            store.add(np.ones((2, 32)), _meta(2))
+
+
+class TestSnapshot:
+    def test_roundtrip(self, tmp_path):
+        store = VectorStore(CFG)
+        v = _rand_vectors(20, 64)
+        store.add(v, _meta(20, patient_id="P9"))
+        path = store.snapshot(str(tmp_path))
+        restored = VectorStore.restore(str(tmp_path), CFG)
+        assert restored.count == 20
+        r = restored.search(v[3], k=1)[0][0]
+        assert r.row_id == 3
+        assert r.metadata["patient_id"] == "P9"
+
+    def test_latest_pointer_updates(self, tmp_path):
+        store = VectorStore(CFG)
+        store.add(_rand_vectors(4, 64), _meta(4))
+        store.snapshot(str(tmp_path))
+        store.add(_rand_vectors(4, 64, seed=2), _meta(4))
+        store.snapshot(str(tmp_path))
+        restored = VectorStore.restore(str(tmp_path), CFG)
+        assert restored.count == 8
+
+
+class TestShardedMesh:
+    def test_sharded_matches_single(self, mesh_tp8):
+        v = _rand_vectors(512, 64)
+        q = _rand_vectors(3, 64, seed=3)
+        single = VectorStore(CFG)
+        single.add(v, _meta(512))
+        sharded = VectorStore(CFG, mesh=mesh_tp8)
+        sharded.add(v, _meta(512))
+        rs = single.search(q, k=7)
+        rm = sharded.search(q, k=7)
+        for a, b in zip(rs, rm):
+            assert [r.row_id for r in a] == [r.row_id for r in b]
+            np.testing.assert_allclose(
+                [r.score for r in a], [r.score for r in b], atol=1e-5
+            )
+
+    def test_sharded_growth_and_filter(self, mesh_tp8):
+        store = VectorStore(CFG, mesh=mesh_tp8)
+        v = _rand_vectors(1500, 64)
+        meta = [{"patient_id": f"P{i % 5}"} for i in range(1500)]
+        store.add(v[:800], meta[:800])
+        store.add(v[800:], meta[800:])
+        res = store.search(v[1203], k=4, where=lambda m: m["patient_id"] == "P3")[0]
+        assert res[0].row_id == 1203
