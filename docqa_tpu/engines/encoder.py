@@ -21,16 +21,10 @@ from docqa_tpu.models.encoder import Params, encode_batch, init_encoder_params
 from docqa_tpu.runtime.mesh import MeshContext
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
 from docqa_tpu.text.tokenizer import Tokenizer, default_tokenizer
+from docqa_tpu.utils import pick_bucket as _bucket, round_up
 
 SEQ_BUCKETS = (64, 128, 256, 512)
 BATCH_BUCKETS = (8, 32, 128)
-
-
-def _bucket(value: int, buckets: Sequence[int]) -> int:
-    for b in buckets:
-        if value <= b:
-            return b
-    return buckets[-1]
 
 
 class EncoderEngine:
@@ -76,8 +70,7 @@ class EncoderEngine:
         batch_b = _bucket(n, BATCH_BUCKETS)
         if self.mesh is not None:
             # batch axis must divide evenly over the data axis
-            nd = self.mesh.n_data
-            batch_b = -(-batch_b // nd) * nd
+            batch_b = round_up(batch_b, self.mesh.n_data)
         ids_p = np.zeros((batch_b, seq_b), np.int32)
         len_p = np.zeros((batch_b,), np.int32)
         ids_p[:n] = ids[:, :seq_b]

@@ -1,0 +1,243 @@
+"""TPU decode engine: prefill + on-device sampling loop with KV cache.
+
+Replaces the reference's external Ollama round-trip (``llm-qa/main.py:66-69``,
+SURVEY §3.2 "the real hot loop, external").  Everything after tokenization is
+one jit program per (prompt-bucket, max-new) pair:
+
+  prefill (batched matmuls over the prompt bucket)
+    → ``lax.while_loop`` decode: forward(1 token) → sample → append to cache
+    → early exit when every lane has emitted EOS
+
+No host↔device round trip per token (SURVEY §7 hard part (b)).  Batched
+lanes carry independent lengths, so requests of different sizes share one
+program — the slot-based precursor to continuous batching.
+
+TP: params/cache shardings from ``parallel/sharding.py``; GSPMD inserts the
+ICI collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from docqa_tpu.config import DecoderConfig, GenerateConfig
+from docqa_tpu.models.decoder import (
+    KVCache,
+    Params,
+    decoder_forward,
+    init_decoder_params,
+    init_kv_cache,
+)
+from docqa_tpu.ops.sampling import sample
+from docqa_tpu.parallel.sharding import cache_pspecs, shard_decoder_params
+from docqa_tpu.runtime.mesh import MeshContext
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+from docqa_tpu.text.tokenizer import Tokenizer, default_tokenizer
+from docqa_tpu.utils import pick_bucket, round_up
+
+BATCH_BUCKETS = (1, 2, 4, 8, 16)
+
+
+class GenerateEngine:
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        gen: Optional[GenerateConfig] = None,
+        mesh: Optional[MeshContext] = None,
+        params: Optional[Params] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        seed: int = 0,
+        use_flash: Optional[bool] = None,
+    ):
+        self.cfg = cfg
+        self.gen = gen or GenerateConfig()
+        self.mesh = mesh
+        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+        if params is None:
+            params = init_decoder_params(jax.random.PRNGKey(seed), cfg)
+        if mesh is not None:
+            params = shard_decoder_params(params, cfg, mesh)
+        self.params = params
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu" and cfg.head_dim % 64 == 0
+        self.use_flash = use_flash
+        self._fns = {}
+
+    # ---- device program ------------------------------------------------------
+
+    def _constrain_cache(self, cache: KVCache) -> KVCache:
+        if self.mesh is None or self.mesh.n_devices == 1:
+            return cache
+        from jax.sharding import NamedSharding
+
+        specs = cache_pspecs(self.cfg, self.mesh)
+        return {
+            k: jax.lax.with_sharding_constraint(
+                v, NamedSharding(self.mesh.mesh, specs[k])
+            )
+            for k, v in cache.items()
+        }
+
+    def _generate_fn(
+        self,
+        params: Params,
+        ids: jax.Array,  # [b, prompt_bucket]
+        prompt_lengths: jax.Array,  # [b]
+        rng: jax.Array,
+        *,
+        max_new: int,
+        temperature: float,
+    ):
+        b, bucket = ids.shape
+        cache_len = round_up(bucket + max_new, 128)
+        cache = init_kv_cache(self.cfg, b, max_len=cache_len)
+        cache = self._constrain_cache(cache)
+
+        # ---- prefill: whole (padded) prompt in one pass; padded tail rows
+        # are masked out via attn_lengths=prompt_lengths
+        logits, cache = decoder_forward(
+            params,
+            self.cfg,
+            ids,
+            cache,
+            jnp.zeros((b,), jnp.int32),
+            attn_lengths=prompt_lengths,
+            use_flash=self.use_flash,
+            last_token_only=True,
+        )
+        last = logits[:, -1]
+        first_tok = sample(last, rng, temperature, self.gen.top_k, self.gen.top_p)
+
+        out = jnp.full((b, max_new), self.gen.pad_id, jnp.int32)
+        out = out.at[:, 0].set(first_tok)
+        done = first_tok == self.gen.eos_id
+
+        def cond(state):
+            step, _, _, _, done, _ = state
+            return jnp.logical_and(step < max_new, ~jnp.all(done))
+
+        def body(state):
+            step, cache, lengths, out, done, rng = state
+            tok = out[:, step - 1]
+            logits, cache = decoder_forward(
+                params,
+                self.cfg,
+                tok[:, None],
+                cache,
+                lengths,
+                use_flash=self.use_flash,
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = sample(
+                logits[:, 0], sub, temperature, self.gen.top_k, self.gen.top_p
+            )
+            nxt = jnp.where(done, self.gen.pad_id, nxt)
+            out = out.at[:, step].set(nxt)
+            done = done | (nxt == self.gen.eos_id)
+            return step + 1, cache, lengths + 1, out, done, rng
+
+        state = (jnp.int32(1), cache, prompt_lengths, out, done, rng)
+        _, _, final_lengths, out, done, _ = jax.lax.while_loop(cond, body, state)
+        return out, final_lengths
+
+    def _get_fn(self, b: int, bucket: int, max_new: int, temperature: float):
+        key = (b, bucket, max_new, temperature)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    self._generate_fn, max_new=max_new, temperature=temperature
+                )
+            )
+            self._fns[key] = fn
+        return fn
+
+    # ---- host API ------------------------------------------------------------
+
+    def generate_ids(
+        self,
+        prompts_ids: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Token-id prompts -> generated token ids (EOS excluded)."""
+        max_new = (
+            self.gen.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        temperature = (
+            self.gen.temperature if temperature is None else temperature
+        )
+        b = len(prompts_ids)
+        if b == 0 or max_new == 0:
+            return [[] for _ in prompts_ids]
+        usable = self.cfg.max_seq_len - max_new
+        if usable < 1:
+            raise ValueError(
+                f"max_new_tokens={max_new} leaves no prompt room within "
+                f"max_seq_len={self.cfg.max_seq_len}"
+            )
+        longest = max(len(p) for p in prompts_ids)
+        bucket = min(
+            pick_bucket(longest, self.gen.prefill_buckets)
+            if longest <= self.gen.prefill_buckets[-1]
+            else round_up(longest, 128),
+            usable,
+        )
+        # pad the batch to a bucket (stable jit cache) and to a multiple of
+        # the data axis (sharding divisibility); dummy lanes get length-1
+        # prompts and their outputs are dropped
+        b_pad = pick_bucket(b, BATCH_BUCKETS) if b <= BATCH_BUCKETS[-1] else b
+        if self.mesh is not None:
+            b_pad = round_up(b_pad, self.mesh.n_data)
+        ids = np.full((b_pad, bucket), self.gen.pad_id, np.int32)
+        lengths = np.ones((b_pad,), np.int32)
+        for i, p in enumerate(prompts_ids):
+            p = list(p)[-bucket:]  # keep the tail on overflow
+            ids[i, : len(p)] = p
+            lengths[i] = max(len(p), 1)
+
+        fn = self._get_fn(b_pad, bucket, max_new, temperature)
+        with span("generate", DEFAULT_REGISTRY):
+            out, _ = fn(
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(lengths),
+                jax.random.PRNGKey(seed),
+            )
+            out = np.asarray(out)[:b]
+
+        results: List[List[int]] = []
+        for row in out:
+            toks: List[int] = []
+            for t in row:
+                if t == self.gen.eos_id or t == self.gen.pad_id:
+                    break
+                toks.append(int(t))
+            results.append(toks)
+        return results
+
+    def generate_texts(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        seed: int = 0,
+    ) -> List[str]:
+        """Text prompts -> generated text.
+
+        With real model weights + vocab this is normal detokenization; with
+        the hash-fallback tokenizer (zero-egress environment) ids map to
+        opaque ``w<id>`` wordpieces — the service contract and the device
+        program are identical either way.
+        """
+        # no truncation here: generate_ids keeps the prompt *tail* (where the
+        # question sits in a RAG prompt) when it exceeds the bucket
+        prompt_ids = [self.tokenizer.encode(p) for p in prompts]
+        outs = self.generate_ids(prompt_ids, max_new_tokens, temperature, seed)
+        return [self.tokenizer.decode_ids(ids) for ids in outs]

@@ -44,6 +44,7 @@ from docqa_tpu.config import StoreConfig
 from docqa_tpu.ops.topk import sharded_topk
 from docqa_tpu.runtime.mesh import MeshContext
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+from docqa_tpu.utils import round_up
 
 log = get_logger("docqa.store")
 
@@ -117,7 +118,7 @@ class VectorStore:
     def _round_capacity(self, n: int) -> int:
         """Round up to a multiple of 128*n_shards (MXU sublane + even shards)."""
         quantum = 128 * self._n_shards
-        return max(quantum, -(-n // quantum) * quantum)
+        return max(quantum, round_up(n, quantum))
 
     def _alloc(self, capacity: int) -> jax.Array:
         buf = jnp.zeros((capacity, self.cfg.dim), self._dtype)
@@ -182,7 +183,7 @@ class VectorStore:
             # varying sizes reuse a handful of compiled programs; the padding
             # lands beyond count (zeros over zeros) and capacity is grown to
             # keep the padded write in bounds
-            n_pad = -(-n // 64) * 64
+            n_pad = round_up(n, 64)
             self._grow_to(start + n_pad)
             rows = np.zeros((n_pad, self.cfg.dim), np.float32)
             rows[:n] = vectors

@@ -1,0 +1,47 @@
+"""Token sampling for the decode loop.
+
+All functions are jit-compatible (static shapes, no data-dependent Python
+control flow).  The reference ran ``temperature=0`` (``llm-qa/main.py:69``),
+so greedy is the default; temperature / top-k / top-p cover the rest.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    """[b, v] -> [b] int32."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """[b, v] logits -> [b] int32 tokens.
+
+    ``temperature`` is a static Python float: 0 means greedy and compiles to
+    an argmax with no RNG use.
+    """
+    if temperature == 0.0:
+        return greedy(logits)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose cumulative prob (exclusive) is < top_p
+        cutoff_mask = cum - probs < top_p
+        kth = jnp.where(cutoff_mask, sorted_logits, jnp.inf).min(
+            axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,13 @@
+from docqa_tpu.parallel.sharding import (
+    cache_pspecs,
+    decoder_param_pspecs,
+    shard_decoder_params,
+    shard_kv_cache,
+)
+
+__all__ = [
+    "decoder_param_pspecs",
+    "cache_pspecs",
+    "shard_decoder_params",
+    "shard_kv_cache",
+]

@@ -1,0 +1,74 @@
+"""Sharding layouts for the decoder (TP over ICI) — the scaling-book recipe:
+pick a mesh, annotate param/activation shardings, let GSPMD insert the
+collectives.  No hand-written NCCL-style calls (the reference had no device
+parallelism at all — SURVEY §2c).
+
+Megatron-style layout per block:
+  * wq/wk/wv: output (head) dim sharded       → column parallel
+  * wo:       input (head) dim sharded        → row parallel, psum after
+  * w_gate/w_up: output dim sharded           → column parallel
+  * w_down:   input dim sharded               → row parallel, psum after
+  * lm_head:  vocab dim sharded               → logits sharded, argmax local
+  * KV cache: kv-heads dim sharded            → decode attention stays local
+GSPMD derives exactly one all-reduce per block from these specs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from docqa_tpu.config import DecoderConfig
+from docqa_tpu.runtime.mesh import MeshContext
+
+
+def decoder_param_pspecs(cfg: DecoderConfig, model_axis: str) -> Dict[str, P]:
+    m = model_axis
+    specs: Dict[str, P] = {
+        "tok_emb": P(None, None),  # replicated (gather-heavy; small at 7B)
+        "final_norm_g": P(None),
+        "lm_head": P(None, m),  # vocab-sharded logits
+    }
+    for i in range(cfg.num_layers):
+        specs.update(
+            {
+                f"l{i}_attn_norm_g": P(None),
+                f"l{i}_wq": P(None, m),
+                f"l{i}_wk": P(None, m),
+                f"l{i}_wv": P(None, m),
+                f"l{i}_wo": P(m, None),
+                f"l{i}_mlp_norm_g": P(None),
+                f"l{i}_w_gate": P(None, m),
+                f"l{i}_w_up": P(None, m),
+                f"l{i}_w_down": P(m, None),
+            }
+        )
+    return specs
+
+
+def cache_pspecs(cfg: DecoderConfig, mesh: MeshContext) -> Dict[str, P]:
+    """KV cache [b, S, kv_heads, d]: batch over data, kv heads over model."""
+    spec = P(mesh.data_axis, None, mesh.model_axis, None)
+    out: Dict[str, P] = {}
+    for i in range(cfg.num_layers):
+        out[f"k{i}"] = spec
+        out[f"v{i}"] = spec
+    return out
+
+
+def shard_decoder_params(params, cfg: DecoderConfig, mesh: MeshContext):
+    specs = decoder_param_pspecs(cfg, mesh.model_axis)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh.mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def shard_kv_cache(cache, cfg: DecoderConfig, mesh: MeshContext):
+    specs = cache_pspecs(cfg, mesh)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh.mesh, specs[k]))
+        for k, v in cache.items()
+    }

@@ -73,6 +73,22 @@ class Tokenizer:
             ids.append(self.sep_id)
         return ids
 
+    def decode_ids(self, ids: Sequence[int]) -> str:
+        """Best-effort detokenization (skips specials, merges wordpieces)."""
+        inv = getattr(self, "_inv_vocab", None)
+        if inv is None:
+            return " ".join(f"w{i}" for i in ids)
+        pieces: List[str] = []
+        for i in ids:
+            tok = inv.get(int(i))
+            if tok is None or tok in _SPECIALS:
+                continue
+            if tok.startswith("##") and pieces:
+                pieces[-1] += tok[2:]
+            else:
+                pieces.append(tok)
+        return " ".join(pieces)
+
     def batch(
         self,
         texts: Sequence[str],
@@ -115,6 +131,7 @@ class WordPieceTokenizer(Tokenizer):
     ):
         super().__init__(len(vocab), lowercase)
         self.vocab = {tok: i for i, tok in enumerate(vocab)}
+        self._inv_vocab = {i: tok for tok, i in self.vocab.items()}
         self.max_word_chars = max_word_chars
         for name, attr in (
             ("[PAD]", "pad_id"),

@@ -1,0 +1,16 @@
+"""Small shared helpers."""
+
+from typing import Sequence
+
+
+def round_up(n: int, quantum: int) -> int:
+    """Smallest multiple of ``quantum`` >= n."""
+    return -(-n // quantum) * quantum
+
+
+def pick_bucket(value: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= value, else the largest bucket."""
+    for b in buckets:
+        if value <= b:
+            return b
+    return buckets[-1]
