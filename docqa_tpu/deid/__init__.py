@@ -1,0 +1,7 @@
+from docqa_tpu.deid.engine import (
+    DeidEngine,
+    RecognizerResult,
+    anonymize_text,
+)
+
+__all__ = ["DeidEngine", "RecognizerResult", "anonymize_text"]

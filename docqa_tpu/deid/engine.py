@@ -1,0 +1,263 @@
+"""PHI de-identification engine.
+
+Reproduces the reference deid worker's two-phase contract —
+``analyzer.analyze(text, entities, language)`` then
+``anonymizer.anonymize(text, results)`` (``deid-service/anonymizer.py:37-48``)
+— without Presidio/spaCy.  Two recognizer families:
+
+* **Pattern recognizers** (host, deterministic): EMAIL_ADDRESS,
+  PHONE_NUMBER, DATE_TIME, plus title/honorific cues for PERSON.  These
+  carry the precision-critical structured PHI.
+* **NER recognizer** (device, jit): the ``models/ner.py`` token classifier
+  for contextual entities (PERSON, LOCATION, NRP).  Random-init weights are
+  usable for pipeline plumbing; real clinical-BERT weights load via the
+  encoder's safetensors path, and ``training/ner.py`` can fine-tune.
+
+The entity universe is the reference's 6-type list (``anonymizer.py:43``):
+PERSON, PHONE_NUMBER, EMAIL_ADDRESS, DATE_TIME, NRP, LOCATION.
+Replacement mirrors Presidio's default: span → ``<ENTITY_TYPE>``.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from docqa_tpu.config import NERConfig
+from docqa_tpu.models.ner import bio_to_spans, init_ner_params, ner_forward
+from docqa_tpu.text.tokenizer import Tokenizer, default_tokenizer
+from docqa_tpu.utils import pick_bucket
+
+
+@dataclass(frozen=True)
+class RecognizerResult:
+    entity_type: str
+    start: int
+    end: int
+    score: float
+
+
+# ---- pattern recognizers ---------------------------------------------------
+
+_EMAIL_RE = re.compile(r"[\w.+-]+@[\w-]+\.[\w.-]+")
+_PHONE_RE = re.compile(
+    r"""(?<![\w])
+    (?:\+?\d{1,3}[\s.-]?)?          # country code
+    (?:\(\d{1,4}\)[\s.-]?)?         # area code in parens
+    \d{2,4}(?:[\s.-]\d{2,4}){1,4}   # grouped digits
+    (?![\w])""",
+    re.VERBOSE,
+)
+_DATE_RE = re.compile(
+    r"""(?<![\w])(?:
+    \d{1,4}[-/.]\d{1,2}[-/.]\d{1,4}                              # 2024-01-31, 31/01/24
+    | (?:jan|feb|mar|apr|may|jun|jul|aug|sep|oct|nov|dec)[a-z]*\.?\s+\d{1,2}(?:st|nd|rd|th)?,?\s+\d{2,4}  # March 5, 2024
+    | \d{1,2}\s+(?:jan|feb|mar|apr|may|jun|jul|aug|sep|oct|nov|dec)[a-z]*\.?\s+\d{2,4}   # 5 March 2024
+    | \d{1,2}:\d{2}(?::\d{2})?\s*(?:am|pm)?                      # times
+    )(?![\w])""",
+    re.VERBOSE | re.IGNORECASE,
+)
+_PERSON_TITLE_RE = re.compile(
+    r"\b(?i:dr|mr|mrs|ms|prof|docteur|monsieur|madame)\.?\s+"
+    r"((?:[A-Z][\w'-]+)(?:\s+[A-Z][\w'-]+){0,2})"
+)
+
+_MIN_PHONE_DIGITS = 7
+
+
+def _pattern_results(text: str) -> List[RecognizerResult]:
+    out: List[RecognizerResult] = []
+    for m in _EMAIL_RE.finditer(text):
+        out.append(RecognizerResult("EMAIL_ADDRESS", m.start(), m.end(), 1.0))
+    for m in _DATE_RE.finditer(text):
+        out.append(RecognizerResult("DATE_TIME", m.start(), m.end(), 0.85))
+    for m in _PHONE_RE.finditer(text):
+        digits = sum(c.isdigit() for c in m.group())
+        if digits >= _MIN_PHONE_DIGITS:
+            out.append(
+                RecognizerResult("PHONE_NUMBER", m.start(), m.end(), 0.8)
+            )
+    for m in _PERSON_TITLE_RE.finditer(text):
+        out.append(
+            RecognizerResult("PERSON", m.start(1), m.end(1), 0.75)
+        )
+    return out
+
+
+def _resolve_overlaps(
+    results: Sequence[RecognizerResult],
+) -> List[RecognizerResult]:
+    """Highest score wins on overlap; ties go to the longer span."""
+    picked: List[RecognizerResult] = []
+    for r in sorted(results, key=lambda r: (-r.score, r.start - r.end)):
+        if all(r.end <= p.start or r.start >= p.end for p in picked):
+            picked.append(r)
+    return sorted(picked, key=lambda r: r.start)
+
+
+def anonymize_text(
+    text: str,
+    results: Sequence[RecognizerResult],
+    replacement: Optional[Dict[str, str]] = None,
+) -> str:
+    """Replace spans with ``<ENTITY_TYPE>`` (Presidio's default operator)."""
+    out = []
+    pos = 0
+    for r in _resolve_overlaps(results):
+        out.append(text[pos : r.start])
+        token = (replacement or {}).get(r.entity_type, f"<{r.entity_type}>")
+        out.append(token)
+        pos = r.end
+    out.append(text[pos:])
+    return "".join(out)
+
+
+# ---- the engine ------------------------------------------------------------
+
+_WORD_OFFSET_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+
+
+class DeidEngine:
+    """analyze → anonymize over batches of documents."""
+
+    def __init__(
+        self,
+        cfg: NERConfig,
+        tokenizer: Optional[Tokenizer] = None,
+        params=None,
+        seed: int = 0,
+        use_ner_model: bool = True,
+        ner_threshold: float = 0.5,
+    ):
+        self.cfg = cfg
+        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+        self.use_ner_model = use_ner_model
+        self.ner_threshold = ner_threshold
+        if params is None and use_ner_model:
+            params = init_ner_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self._forward = jax.jit(functools.partial(ner_forward, cfg=cfg))
+
+    # -- NER path ------------------------------------------------------------
+
+    def _ner_results(self, texts: Sequence[str]) -> List[List[RecognizerResult]]:
+        """Batch the documents through the jit NER trunk (BASELINE config 2:
+        batch=32).
+
+        Long documents are split into *windows* sized by wordpiece count, so
+        every word of every document is classified — no silent tail drop
+        (a dropped word would be a silent PHI leak).  Windows of all
+        documents are packed into one padded batch (bucketed on both axes to
+        bound the jit cache) and results are stitched back per document.
+        """
+        budget = self.cfg.max_seq_len - 2  # room for CLS/SEP
+        # segment: (doc_idx, [(word_ids, char_start, char_end), ...])
+        segments: List[Tuple[int, List[Tuple[List[int], int, int]]]] = []
+        for di, text in enumerate(texts):
+            cur: List[Tuple[List[int], int, int]] = []
+            used = 0
+            for m in _WORD_OFFSET_RE.finditer(text):
+                wids = self.tokenizer.word_to_ids(m.group())[:budget]
+                if used + len(wids) > budget and cur:
+                    segments.append((di, cur))
+                    cur, used = [], 0
+                cur.append((wids, m.start(), m.end()))
+                used += len(wids)
+            if cur:
+                segments.append((di, cur))
+        if not segments:
+            return [[] for _ in texts]
+
+        max_tokens = max(
+            2 + sum(len(w) for w, _, _ in seg) for _, seg in segments
+        )
+        seq = min(
+            pick_bucket(max_tokens, (64, 128, 256, 512)), self.cfg.max_seq_len
+        )
+        n_seg = len(segments)
+        batch = pick_bucket(n_seg, (1, 2, 4, 8, 16, 32)) if n_seg <= 32 else n_seg
+        ids = np.zeros((batch, seq), np.int32)
+        lengths = np.ones((batch,), np.int32)
+        token_idx: List[List[int]] = []  # per segment, per word
+        for si, (_, seg) in enumerate(segments):
+            row = [self.tokenizer.cls_id]
+            idxs: List[int] = []
+            for wids, _, _ in seg:
+                idxs.append(len(row))
+                row.extend(wids)
+            row.append(self.tokenizer.sep_id)
+            ids[si, : len(row)] = row
+            lengths[si] = len(row)
+            token_idx.append(idxs)
+
+        logits = np.asarray(
+            self._forward(self.params, ids=ids, lengths=lengths)
+        )
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+
+        out: List[List[RecognizerResult]] = [[] for _ in texts]
+        for si, (di, seg) in enumerate(segments):
+            labels, scores = [], []
+            for wi in range(len(seg)):
+                ti = token_idx[si][wi]
+                lab = int(logits[si, ti].argmax())
+                labels.append(lab)
+                scores.append(float(probs[si, ti, lab]))
+            spans = bio_to_spans(
+                labels, [(s, e) for _, s, e in seg], self.cfg, scores
+            )
+            out[di].extend(
+                RecognizerResult(ent, s, e, sc)
+                for ent, s, e, sc in spans
+                if sc >= self.ner_threshold
+            )
+        return out
+
+    # -- public API (Presidio-shaped, anonymizer.py:41-48) -------------------
+
+    def analyze(
+        self,
+        text: str,
+        entities: Optional[Sequence[str]] = None,
+        language: str = "en",
+    ) -> List[RecognizerResult]:
+        return self.analyze_batch([text], entities, language)[0]
+
+    def analyze_batch(
+        self,
+        texts: Sequence[str],
+        entities: Optional[Sequence[str]] = None,
+        language: str = "en",
+    ) -> List[List[RecognizerResult]]:
+        del language  # patterns are latin-script generic; NER is model-bound
+        entities = tuple(entities) if entities else self.cfg.entities
+        results = [_pattern_results(t) for t in texts]
+        if self.use_ner_model and self.params is not None:
+            nonempty = [i for i, t in enumerate(texts) if t.strip()]
+            if nonempty:
+                ner = self._ner_results([texts[i] for i in nonempty])
+                for i, r in zip(nonempty, ner):
+                    results[i] = list(results[i]) + r
+        return [
+            [r for r in rs if r.entity_type in entities] for rs in results
+        ]
+
+    def anonymize(
+        self, text: str, results: Optional[Sequence[RecognizerResult]] = None
+    ) -> str:
+        if results is None:
+            results = self.analyze(text)
+        return anonymize_text(text, results)
+
+    def deidentify_batch(self, texts: Sequence[str]) -> List[str]:
+        """One-call batch path used by the pipeline worker."""
+        all_results = self.analyze_batch(texts)
+        return [
+            anonymize_text(t, rs) for t, rs in zip(texts, all_results)
+        ]

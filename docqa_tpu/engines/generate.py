@@ -89,10 +89,12 @@ class GenerateEngine:
         ids: jax.Array,  # [b, prompt_bucket]
         prompt_lengths: jax.Array,  # [b]
         rng: jax.Array,
+        temperature: jax.Array,  # traced scalar; greedy handled statically
         *,
         max_new: int,
-        temperature: float,
+        greedy: bool,
     ):
+        temperature = 0.0 if greedy else temperature
         b, bucket = ids.shape
         cache_len = round_up(bucket + max_new, 128)
         cache = init_kv_cache(self.cfg, b, max_len=cache_len)
@@ -116,13 +118,17 @@ class GenerateEngine:
         out = jnp.full((b, max_new), self.gen.pad_id, jnp.int32)
         out = out.at[:, 0].set(first_tok)
         done = first_tok == self.gen.eos_id
+        # tokens actually produced per lane (EOS excluded) — the host trims
+        # by this count, so a legitimately *sampled* pad_id token mid-stream
+        # is preserved
+        n_emitted = jnp.where(done, 0, 1).astype(jnp.int32)
 
         def cond(state):
-            step, _, _, _, done, _ = state
+            step, _, _, _, done, _, _ = state
             return jnp.logical_and(step < max_new, ~jnp.all(done))
 
         def body(state):
-            step, cache, lengths, out, done, rng = state
+            step, cache, lengths, out, done, n_emitted, rng = state
             tok = out[:, step - 1]
             logits, cache = decoder_forward(
                 params,
@@ -138,20 +144,22 @@ class GenerateEngine:
             )
             nxt = jnp.where(done, self.gen.pad_id, nxt)
             out = out.at[:, step].set(nxt)
-            done = done | (nxt == self.gen.eos_id)
-            return step + 1, cache, lengths + 1, out, done, rng
+            is_eos = nxt == self.gen.eos_id
+            n_emitted = n_emitted + jnp.where(done | is_eos, 0, 1)
+            done = done | is_eos
+            return step + 1, cache, lengths + 1, out, done, n_emitted, rng
 
-        state = (jnp.int32(1), cache, prompt_lengths, out, done, rng)
-        _, _, final_lengths, out, done, _ = jax.lax.while_loop(cond, body, state)
-        return out, final_lengths
+        state = (jnp.int32(1), cache, prompt_lengths, out, done, n_emitted, rng)
+        _, _, _, out, _, n_emitted, _ = jax.lax.while_loop(cond, body, state)
+        return out, n_emitted
 
-    def _get_fn(self, b: int, bucket: int, max_new: int, temperature: float):
-        key = (b, bucket, max_new, temperature)
+    def _get_fn(self, b: int, bucket: int, max_new: int, greedy: bool):
+        key = (b, bucket, max_new, greedy)
         fn = self._fns.get(key)
         if fn is None:
             fn = jax.jit(
                 functools.partial(
-                    self._generate_fn, max_new=max_new, temperature=temperature
+                    self._generate_fn, max_new=max_new, greedy=greedy
                 )
             )
             self._fns[key] = fn
@@ -202,25 +210,22 @@ class GenerateEngine:
             ids[i, : len(p)] = p
             lengths[i] = max(len(p), 1)
 
-        fn = self._get_fn(b_pad, bucket, max_new, temperature)
+        fn = self._get_fn(b_pad, bucket, max_new, greedy=temperature == 0.0)
         with span("generate", DEFAULT_REGISTRY):
-            out, _ = fn(
+            out, n_emitted = fn(
                 self.params,
                 jnp.asarray(ids),
                 jnp.asarray(lengths),
                 jax.random.PRNGKey(seed),
+                jnp.float32(temperature),
             )
             out = np.asarray(out)[:b]
+            n_emitted = np.asarray(n_emitted)[:b]
 
-        results: List[List[int]] = []
-        for row in out:
-            toks: List[int] = []
-            for t in row:
-                if t == self.gen.eos_id or t == self.gen.pad_id:
-                    break
-                toks.append(int(t))
-            results.append(toks)
-        return results
+        return [
+            [int(t) for t in row[:count]]
+            for row, count in zip(out, n_emitted)
+        ]
 
     def generate_texts(
         self,

@@ -1,0 +1,110 @@
+"""Token-classification NER for PHI detection.
+
+Device-plane replacement for Presidio's spaCy backbone
+(``deid-service/anonymizer.py:29-35``): the same BERT-class trunk as the
+encoder (``models/encoder.py``) with a per-token classification head, BIO
+label scheme over the reference's 6-entity contract (``anonymizer.py:43``).
+
+The trunk/head are jit-compiled and batch-friendly (BASELINE config 2:
+batch=32 docs); span extraction is host-side (``deid/engine.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from docqa_tpu.config import NERConfig, EncoderConfig
+from docqa_tpu.models.encoder import encoder_forward, init_encoder_params
+
+Params = Dict[str, jax.Array]
+
+
+def _trunk_cfg(cfg: NERConfig) -> EncoderConfig:
+    return EncoderConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_dim=cfg.hidden_dim,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        max_seq_len=cfg.max_seq_len,
+        embed_dim=cfg.hidden_dim,
+        dtype=cfg.dtype,
+    )
+
+
+def init_ner_params(rng: jax.Array, cfg: NERConfig) -> Params:
+    r1, r2 = jax.random.split(rng)
+    p = init_encoder_params(r1, _trunk_cfg(cfg))
+    p["head_w"] = (
+        jax.random.normal(r2, (cfg.hidden_dim, cfg.num_labels), jnp.float32)
+        * 0.02
+    )
+    p["head_b"] = jnp.zeros((cfg.num_labels,))
+    return p
+
+
+def ner_forward(
+    params: Params, cfg: NERConfig, ids: jax.Array, lengths: jax.Array
+) -> jax.Array:
+    """[b, s] ids -> [b, s, num_labels] f32 logits."""
+    hidden = encoder_forward(params, _trunk_cfg(cfg), ids, lengths)
+    return (
+        hidden.astype(jnp.float32) @ params["head_w"] + params["head_b"]
+    )
+
+
+# ---- BIO label scheme ------------------------------------------------------
+
+def label_ids(cfg: NERConfig) -> Dict[str, int]:
+    """{"O": 0, "B-PERSON": 1, "I-PERSON": 2, ...} in entity order."""
+    out = {"O": 0}
+    for i, ent in enumerate(cfg.entities):
+        out[f"B-{ent}"] = 1 + 2 * i
+        out[f"I-{ent}"] = 2 + 2 * i
+    return out
+
+
+def bio_to_spans(
+    labels: List[int],
+    word_spans: List[Tuple[int, int]],
+    cfg: NERConfig,
+    scores: List[float] | None = None,
+) -> List[Tuple[str, int, int, float]]:
+    """Merge per-word BIO labels into (entity, char_start, char_end, score).
+
+    ``labels[i]`` is the label id for the word covering chars
+    ``word_spans[i]``.  An I- tag without a preceding B-/I- of the same
+    entity opens a new span (standard lenient decoding).
+    """
+    spans: List[Tuple[str, int, int, float]] = []
+    cur_ent, cur_start, cur_end, cur_scores = None, 0, 0, []
+    for i, lab in enumerate(labels):
+        if lab <= 0 or lab > 2 * len(cfg.entities):
+            ent, is_b = None, False
+        else:
+            ent = cfg.entities[(lab - 1) // 2]
+            is_b = lab % 2 == 1
+        score = scores[i] if scores is not None else 1.0
+        if ent is None:
+            if cur_ent:
+                spans.append(
+                    (cur_ent, cur_start, cur_end, float(min(cur_scores)))
+                )
+            cur_ent = None
+        elif is_b or ent != cur_ent:
+            if cur_ent:
+                spans.append(
+                    (cur_ent, cur_start, cur_end, float(min(cur_scores)))
+                )
+            cur_ent = ent
+            cur_start, cur_end = word_spans[i]
+            cur_scores = [score]
+        else:  # I- continuing
+            cur_end = word_spans[i][1]
+            cur_scores.append(score)
+    if cur_ent:
+        spans.append((cur_ent, cur_start, cur_end, float(min(cur_scores))))
+    return spans

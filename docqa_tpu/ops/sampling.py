@@ -19,18 +19,22 @@ def greedy(logits: jax.Array) -> jax.Array:
 def sample(
     logits: jax.Array,
     rng: jax.Array,
-    temperature: float = 0.0,
+    temperature=0.0,
     top_k: int = 0,
     top_p: float = 1.0,
 ) -> jax.Array:
     """[b, v] logits -> [b] int32 tokens.
 
-    ``temperature`` is a static Python float: 0 means greedy and compiles to
-    an argmax with no RNG use.
+    ``temperature`` may be a Python float (0.0 compiles to pure argmax) or a
+    traced scalar — callers serving per-request temperatures pass it traced
+    so one compiled program covers every value (the greedy/stochastic split
+    stays static).
     """
-    if temperature == 0.0:
+    if isinstance(temperature, (int, float)) and temperature == 0.0:
         return greedy(logits)
-    logits = logits.astype(jnp.float32) / temperature
+    logits = logits.astype(jnp.float32) / jnp.maximum(
+        jnp.asarray(temperature, jnp.float32), 1e-6
+    )
     if top_k > 0:
         kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)

@@ -1,0 +1,127 @@
+"""De-identification: pattern recognizers, BIO decoding, anonymize engine."""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import NERConfig
+from docqa_tpu.deid import DeidEngine, RecognizerResult, anonymize_text
+from docqa_tpu.deid.engine import _pattern_results, _resolve_overlaps
+from docqa_tpu.models.ner import bio_to_spans, label_ids
+
+CFG = NERConfig(
+    vocab_size=500, hidden_dim=32, num_layers=1, num_heads=2,
+    mlp_dim=64, max_seq_len=128, dtype="float32",
+)
+
+
+def _ents(results):
+    return {r.entity_type for r in results}
+
+
+class TestPatternRecognizers:
+    def test_email(self):
+        rs = _pattern_results("contact jane.doe+x@hospital.org for records")
+        assert any(r.entity_type == "EMAIL_ADDRESS" for r in rs)
+        r = next(r for r in rs if r.entity_type == "EMAIL_ADDRESS")
+        assert "jane.doe+x@hospital.org" == "contact jane.doe+x@hospital.org for records"[r.start:r.end]
+
+    def test_phone_formats(self):
+        for phone in ["+1 555 123 4567", "(06) 12 34 56 78", "555-123-4567"]:
+            rs = _pattern_results(f"call {phone} today")
+            assert any(r.entity_type == "PHONE_NUMBER" for r in rs), phone
+
+    def test_short_number_not_phone(self):
+        rs = _pattern_results("dose of 12 34 mg")
+        assert not any(r.entity_type == "PHONE_NUMBER" for r in rs)
+
+    def test_dates(self):
+        for d in ["2024-01-31", "31/01/2024", "March 5, 2024", "5 mar 2024", "14:30"]:
+            rs = _pattern_results(f"admitted on {d} with fever")
+            assert any(r.entity_type == "DATE_TIME" for r in rs), d
+
+    def test_person_title(self):
+        rs = _pattern_results("Seen by Dr. Marie Dupont at the clinic")
+        person = next(r for r in rs if r.entity_type == "PERSON")
+        text = "Seen by Dr. Marie Dupont at the clinic"
+        assert text[person.start:person.end] == "Marie Dupont"
+
+
+class TestOverlapAndAnonymize:
+    def test_overlap_highest_score_wins(self):
+        rs = [
+            RecognizerResult("DATE_TIME", 0, 10, 0.85),
+            RecognizerResult("PHONE_NUMBER", 5, 15, 0.5),
+        ]
+        picked = _resolve_overlaps(rs)
+        assert len(picked) == 1 and picked[0].entity_type == "DATE_TIME"
+
+    def test_anonymize_replacement(self):
+        text = "Patient John reachable at j@x.com"
+        rs = [
+            RecognizerResult("PERSON", 8, 12, 0.9),
+            RecognizerResult("EMAIL_ADDRESS", 26, 33, 1.0),
+        ]
+        out = anonymize_text(text, rs)
+        assert out == "Patient <PERSON> reachable at <EMAIL_ADDRESS>"
+
+    def test_anonymize_empty_results(self):
+        assert anonymize_text("no phi here", []) == "no phi here"
+
+
+class TestBIODecode:
+    def test_merge_b_i(self):
+        L = label_ids(CFG)
+        labels = [L["B-PERSON"], L["I-PERSON"], L["O"], L["B-LOCATION"]]
+        spans = [(0, 4), (5, 10), (11, 14), (15, 20)]
+        out = bio_to_spans(labels, spans, CFG, [0.9, 0.8, 1.0, 0.7])
+        assert out == [("PERSON", 0, 10, 0.8), ("LOCATION", 15, 20, 0.7)]
+
+    def test_lenient_i_start(self):
+        L = label_ids(CFG)
+        out = bio_to_spans([L["I-NRP"]], [(3, 8)], CFG)
+        assert out == [("NRP", 3, 8, 1.0)]
+
+    def test_adjacent_b_b(self):
+        L = label_ids(CFG)
+        out = bio_to_spans(
+            [L["B-PERSON"], L["B-PERSON"]], [(0, 3), (4, 8)], CFG
+        )
+        assert len(out) == 2
+
+
+class TestDeidEngine:
+    def test_pattern_only_end_to_end(self):
+        eng = DeidEngine(CFG, use_ner_model=False)
+        text = "Dr. Alice Smith saw the patient on 2024-03-05, phone 555-123-4567, email a@b.org"
+        out = eng.anonymize(text)
+        assert "<PERSON>" in out and "<DATE_TIME>" in out
+        assert "<PHONE_NUMBER>" in out and "<EMAIL_ADDRESS>" in out
+        assert "555-123-4567" not in out and "a@b.org" not in out
+
+    def test_entity_filter_contract(self):
+        # the reference passes an explicit entity list (anonymizer.py:43)
+        eng = DeidEngine(CFG, use_ner_model=False)
+        rs = eng.analyze(
+            "email a@b.org on 2024-03-05", entities=["EMAIL_ADDRESS"]
+        )
+        assert _ents(rs) == {"EMAIL_ADDRESS"}
+
+    def test_empty_and_whitespace(self):
+        eng = DeidEngine(CFG, use_ner_model=True)
+        assert eng.deidentify_batch(["", "   "]) == ["", "   "]
+
+    def test_ner_model_path_runs(self):
+        # random weights: just prove the device path + span plumbing works
+        eng = DeidEngine(CFG, use_ner_model=True, ner_threshold=0.0)
+        out = eng.deidentify_batch(
+            ["Patient seen at Boston General by staff."] * 3
+        )
+        assert len(out) == 3
+        for t in out:
+            assert isinstance(t, str)
+
+    def test_batch_32(self):
+        eng = DeidEngine(CFG, use_ner_model=True)
+        texts = [f"note {i}: call 555-000-{1000+i}" for i in range(32)]
+        outs = eng.deidentify_batch(texts)
+        assert all("<PHONE_NUMBER>" in o for o in outs)
