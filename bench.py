@@ -1,0 +1,120 @@
+"""End-to-end QA latency benchmark (the driver runs this on real TPU).
+
+Measures the north-star metric from BASELINE.md: end-to-end QA latency —
+  tokenize + encode the question (MiniLM-class jit encoder)
+  → exact cosine top-k over an HBM-resident corpus (1M chunks on TPU)
+  → RAG prompt assembly
+  → decoder LM generation with KV cache (64 new tokens) on-device.
+
+The reference publishes no numbers (BASELINE.md: "measured, not inherited");
+the north-star target is <1 s p50 on TPU.  ``vs_baseline`` is therefore
+reported against that 1000 ms target: vs_baseline = 1000 / p50_ms (>1 means
+the target is beaten).
+
+Prints exactly one JSON line:
+  {"metric": "qa_e2e_p50_ms", "value": p50, "unit": "ms", "vs_baseline": r}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    small = (not on_tpu) or os.environ.get("DOCQA_BENCH_SMALL") == "1"
+
+    from docqa_tpu.config import DecoderConfig, EncoderConfig, StoreConfig
+    from docqa_tpu.engines.encoder import EncoderEngine
+    from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.index.store import VectorStore
+    from docqa_tpu.runtime.mesh import make_mesh
+
+    n_chunks = 20_000 if small else 1_000_000
+    max_new = 16 if small else 64
+    n_queries = 5 if small else 20
+    dec_cfg = (
+        DecoderConfig()  # smoke size
+        if small
+        else DecoderConfig(  # ~1.1B-param class, fits one chip in f32
+            vocab_size=32000,
+            hidden_dim=2048,
+            num_layers=16,
+            num_heads=16,
+            num_kv_heads=8,
+            head_dim=128,
+            mlp_dim=5632,
+            max_seq_len=4096,
+        )
+    )
+
+    mesh = make_mesh() if jax.device_count() > 1 else None
+
+    encoder = EncoderEngine(EncoderConfig(), mesh=mesh)
+    store = VectorStore(
+        StoreConfig(shard_capacity=max(n_chunks, 16384)), mesh=mesh
+    )
+    rng = np.random.default_rng(0)
+    block = 131_072
+    meta_block = lambda s, n: [  # noqa: E731
+        {"doc_id": f"d{i}", "source": f"chunk {i}", "type": "kb"}
+        for i in range(s, s + n)
+    ]
+    for start in range(0, n_chunks, block):
+        n = min(block, n_chunks - start)
+        vecs = rng.standard_normal((n, 384)).astype(np.float32)
+        store.add(vecs, meta_block(start, n))
+
+    gen = GenerateEngine(dec_cfg, mesh=mesh)
+
+    questions = [
+        f"What formula treats syndrome {i} with highest score and why?"
+        for i in range(n_queries + 2)
+    ]
+
+    def ask(q: str) -> None:
+        emb = encoder.encode_texts([q])
+        hits = store.search(emb, k=3)[0]
+        ctx = "\n".join(f"[{h.metadata['doc_id']}] {h.metadata['source']}" for h in hits)
+        prompt = f"Context:\n{ctx}\n\nQuestion: {q}\nAnswer:"
+        gen.generate_texts([prompt], max_new_tokens=max_new)
+
+    # warmup: compile encoder/search/prefill/decode programs
+    for q in questions[:2]:
+        ask(q)
+
+    lat = []
+    for q in questions[2:]:
+        t0 = time.perf_counter()
+        ask(q)
+        lat.append((time.perf_counter() - t0) * 1000.0)
+
+    p50 = float(np.percentile(lat, 50))
+    p95 = float(np.percentile(lat, 95))
+    print(
+        f"# backend={backend} chunks={n_chunks} decoder={dec_cfg.hidden_dim}x"
+        f"{dec_cfg.num_layers} new_tokens={max_new} p50={p50:.1f}ms p95={p95:.1f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "qa_e2e_p50_ms",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(1000.0 / p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

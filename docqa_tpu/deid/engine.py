@@ -31,7 +31,7 @@ import numpy as np
 from docqa_tpu.config import NERConfig
 from docqa_tpu.models.ner import bio_to_spans, init_ner_params, ner_forward
 from docqa_tpu.text.tokenizer import Tokenizer, default_tokenizer
-from docqa_tpu.utils import pick_bucket
+from docqa_tpu.utils import pick_bucket, round_up
 
 
 @dataclass(frozen=True)
@@ -119,7 +119,9 @@ def anonymize_text(
 
 # ---- the engine ------------------------------------------------------------
 
-_WORD_OFFSET_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+# Reuse the tokenizer's word splitter so char-offset word splits here can
+# never diverge from the tokenization the NER model was trained on.
+from docqa_tpu.text.tokenizer import _WORD_RE as _WORD_OFFSET_RE  # noqa: E402
 
 
 class DeidEngine:
@@ -162,7 +164,12 @@ class DeidEngine:
             cur: List[Tuple[List[int], int, int]] = []
             used = 0
             for m in _WORD_OFFSET_RE.finditer(text):
-                wids = self.tokenizer.word_to_ids(m.group())[:budget]
+                word = m.group()
+                if self.tokenizer.lowercase:
+                    # match pre_tokenize's casing: an uncased vocab would map
+                    # every capitalized name to [UNK] — a silent PHI leak
+                    word = word.lower()
+                wids = self.tokenizer.word_to_ids(word)[:budget]
                 if used + len(wids) > budget and cur:
                     segments.append((di, cur))
                     cur, used = [], 0
@@ -177,7 +184,10 @@ class DeidEngine:
             2 + sum(len(w) for w, _, _ in seg) for _, seg in segments
         )
         seq = min(
-            pick_bucket(max_tokens, (64, 128, 256, 512)), self.cfg.max_seq_len
+            pick_bucket(max_tokens, (64, 128, 256, 512))
+            if max_tokens <= 512
+            else round_up(max_tokens, 128),
+            self.cfg.max_seq_len,
         )
         n_seg = len(segments)
         batch = pick_bucket(n_seg, (1, 2, 4, 8, 16, 32)) if n_seg <= 32 else n_seg

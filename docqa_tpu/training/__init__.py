@@ -1,0 +1,6 @@
+from docqa_tpu.training.train import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    lm_loss,
+    make_train_step,
+)

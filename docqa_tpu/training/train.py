@@ -1,0 +1,155 @@
+"""Training plane: causal-LM loss + sharded train step.
+
+The reference has no training at all (SURVEY §2c — inference-only, all model
+execution delegated to Ollama / sentence-transformers).  The TPU build adds a
+first-class fine-tuning path so the NER tagger and the generator can be
+adapted on-device: one jit-compiled train step over the (data, model) mesh —
+DP over the batch axis, Megatron TP over the ``model`` axis via the same
+PartitionSpecs serving uses (``parallel/sharding.py``).  GSPMD inserts the
+gradient all-reduce over ``data`` and the TP collectives over ``model``;
+there are no hand-written communication calls.
+
+Memory: the per-layer forward is wrapped in ``jax.checkpoint`` (remat) so
+activations are recomputed in the backward pass — HBM goes to weights,
+optimizer state, and the batch, not to stored activations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from docqa_tpu.config import DecoderConfig
+from docqa_tpu.models.decoder import (
+    Params,
+    decoder_forward,
+    init_decoder_params,
+    init_kv_cache,
+)
+from docqa_tpu.parallel.sharding import decoder_param_pspecs
+from docqa_tpu.runtime.mesh import MeshContext
+
+TrainState = Dict[str, object]  # {"params", "opt_state", "step"}
+
+
+def lm_loss(
+    params: Params,
+    cfg: DecoderConfig,
+    ids: jax.Array,  # [b, s] right-padded token ids
+    lengths: jax.Array,  # [b] valid lengths
+    *,
+    use_flash: bool = False,
+) -> jax.Array:
+    """Mean next-token cross-entropy over valid positions.
+
+    Reuses the serving forward with a throwaway sequence-length cache
+    (cache_lengths = 0 ≡ pure prefill) so train and serve share one
+    numerical path — no train/serve skew.
+    """
+    b, s = ids.shape
+    cache = init_kv_cache(cfg, b, max_len=s)
+    logits, _ = decoder_forward(
+        params,
+        cfg,
+        ids,
+        cache,
+        jnp.zeros((b,), jnp.int32),
+        attn_lengths=lengths,
+        use_flash=use_flash,
+    )  # [b, s, vocab] f32
+    targets = ids[:, 1:]  # predict token t+1 from position t
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # position t is supervised iff t+1 < length
+    mask = (jnp.arange(s - 1)[None, :] + 1) < lengths[:, None]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def default_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1),
+    )
+
+
+def init_train_state(
+    rng: jax.Array,
+    cfg: DecoderConfig,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    mesh: Optional[MeshContext] = None,
+    params: Optional[Params] = None,
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    """Params TP-placed per serving PartitionSpecs; optimizer moments inherit
+    the param shardings (``zeros_like`` preserves placement), so the Adam
+    state is sharded over ``model`` with no extra annotation."""
+    optimizer = optimizer or default_optimizer()
+    if params is None:
+        params = init_decoder_params(rng, cfg)
+    if mesh is not None:
+        specs = decoder_param_pspecs(cfg, mesh.model_axis)
+        params = {
+            k: jax.device_put(v, NamedSharding(mesh.mesh, specs[k]))
+            for k, v in params.items()
+        }
+    opt_state = optimizer.init(params)
+    state: TrainState = {
+        "params": params,
+        "opt_state": opt_state,
+        "step": jnp.zeros((), jnp.int32),
+    }
+    return state, optimizer
+
+
+def make_train_step(
+    cfg: DecoderConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[MeshContext] = None,
+    *,
+    use_flash: bool = False,
+    remat: bool = True,
+):
+    """One jit program: loss → grads → optimizer update.
+
+    Batch arrives host-side; the step constrains it to the ``data`` axis so
+    the forward is DP-sharded while params stay TP-sharded — GSPMD derives
+    the psum over ``data`` for the gradients.  ``state`` is donated: the
+    updated params/opt-state reuse the old buffers in HBM.
+    """
+    loss_fn = lm_loss
+    if remat:
+        loss_fn = jax.checkpoint(
+            functools.partial(lm_loss, use_flash=use_flash),
+            static_argnums=(1,),
+        )
+    else:
+        loss_fn = functools.partial(lm_loss, use_flash=use_flash)
+
+    def step(state: TrainState, ids: jax.Array, lengths: jax.Array):
+        if mesh is not None:
+            batch_sharding = NamedSharding(mesh.mesh, P(mesh.data_axis))
+            ids = jax.lax.with_sharding_constraint(
+                ids, NamedSharding(mesh.mesh, P(mesh.data_axis, None))
+            )
+            lengths = jax.lax.with_sharding_constraint(lengths, batch_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], cfg, ids, lengths
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {
+                "params": params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            },
+            loss,
+        )
+
+    return jax.jit(step, donate_argnums=(0,))

@@ -125,3 +125,21 @@ class TestDeidEngine:
         texts = [f"note {i}: call 555-000-{1000+i}" for i in range(32)]
         outs = eng.deidentify_batch(texts)
         assert all("<PHONE_NUMBER>" in o for o in outs)
+
+    def test_long_doc_wide_window(self):
+        # regression: max_seq_len > 512 with a doc longer than 512 wordpieces
+        # used to overflow the 512-capped seq bucket and crash
+        from docqa_tpu.config import NERConfig
+
+        wide = NERConfig(
+            vocab_size=CFG.vocab_size,
+            hidden_dim=CFG.hidden_dim,
+            num_layers=1,
+            num_heads=CFG.num_heads,
+            mlp_dim=CFG.mlp_dim,
+            max_seq_len=1024,
+        )
+        eng = DeidEngine(wide, use_ner_model=True, ner_threshold=0.0)
+        doc = " ".join(f"word{i}" for i in range(800))
+        out = eng.deidentify_batch([doc])
+        assert len(out) == 1 and len(out[0]) > 0
