@@ -177,6 +177,7 @@ class BrokerConfig:
     clean_queue: str = "clean_documents_queue"
     prefetch: int = 8  # reference forced 1 (anonymizer.py:97); we batch
     max_redelivery: int = 3  # reference dropped poison messages; we DLQ
+    retry_backoff_s: float = 0.5  # base redelivery delay (doubles per attempt)
     amqp_host: str = "localhost"
     amqp_port: int = 5672
 

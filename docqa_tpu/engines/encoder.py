@@ -84,3 +84,31 @@ class EncoderEngine:
             emb = self._encode(params=self.params, ids=ids_j, lengths=len_j)
             emb = np.asarray(emb, np.float32)
         return emb[:n]
+
+
+class HashEncoder:
+    """Device-free deterministic stand-in for :class:`EncoderEngine`
+    (the injectable fake-backend pattern, ``core/config.py:22-23`` — but a
+    *working* fake: stable seeded random projections of token counts, so
+    similar texts land near each other and tests exercise real retrieval)."""
+
+    def __init__(self, cfg: EncoderConfig, seed: int = 0):
+        self.cfg = cfg
+        self.tokenizer = default_tokenizer(cfg.vocab_size)
+        rng = np.random.default_rng(seed)
+        self._proj = rng.standard_normal(
+            (cfg.vocab_size, cfg.embed_dim)
+        ).astype(np.float32) / np.sqrt(cfg.embed_dim)
+
+    def encode_texts(self, texts):
+        out = np.zeros((len(texts), self.cfg.embed_dim), np.float32)
+        for i, t in enumerate(texts):
+            ids = self.tokenizer.encode(t, add_specials=False)
+            if ids:
+                counts = np.bincount(
+                    np.asarray(ids) % self.cfg.vocab_size,
+                    minlength=self.cfg.vocab_size,
+                ).astype(np.float32)
+                v = counts @ self._proj
+                out[i] = v / max(np.linalg.norm(v), 1e-9)
+        return out

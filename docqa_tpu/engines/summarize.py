@@ -1,0 +1,123 @@
+"""Clinical summarization engine (BASELINE config 4: multi-doc, 5 chunks).
+
+Replaces the reference's dual-mode LLM client whose "fake" path truncated
+the prompt to its last 1200 chars (``synthese-comparative/core/llm_client.py:18-30``)
+and whose "real" path called an endpoint that didn't exist
+(``core/llm_client.py:47-54``).  Here:
+
+* the real path is an in-process TPU decode (``engines/generate.py``) —
+  batched across documents/patients, no HTTP hop, no 60 s timeout;
+* inputs are packed *token-aware*: each document block gets a proportional
+  token budget and is trimmed at a word boundary, so no document is silently
+  dropped (the reference's tail-truncation kept whichever document happened
+  to be last);
+* the fake mode is kept as an injectable flag for tests/dev parity
+  (``core/config.py:22-23`` pattern) with the reference's exact semantics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from docqa_tpu.config import SummarizerConfig
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+
+SINGLE_PATIENT_TEMPLATE = (
+    "Tu es un assistant clinique. À partir des extraits du dossier du patient "
+    "{patient_id} ci-dessous, rédige une synthèse structurée en quatre parties: "
+    "1) Contexte clinique, 2) Éléments marquants, 3) Évolution, 4) Points de "
+    "vigilance. Réponds uniquement en texte (pas de JSON).\n\n"
+    "Extraits du dossier:\n{documents}\n\nSynthèse:"
+)
+
+MULTI_PATIENT_TEMPLATE = (
+    "Tu es un assistant clinique. Compare les dossiers des patients suivants. "
+    "Pour chaque patient, dégage les éléments cliniques essentiels, puis liste "
+    "les différences notables et les risques partagés. Réponds uniquement en "
+    "texte (pas de JSON).\n\n{documents}\n\nSynthèse comparative:"
+)
+
+
+class SummarizeEngine:
+    def __init__(
+        self,
+        generator,  # GenerateEngine (shares tokenizer + decode programs)
+        cfg: Optional[SummarizerConfig] = None,
+        use_fake: bool = False,
+        fake_max_chars: int = 1200,
+    ) -> None:
+        self.generator = generator
+        self.cfg = cfg or SummarizerConfig()
+        self.use_fake = use_fake
+        self.fake_max_chars = fake_max_chars
+
+    # ---- packing -------------------------------------------------------------
+
+    def _pack_documents(
+        self, docs: Sequence[Tuple[str, str]], budget_tokens: int
+    ) -> str:
+        """[(doc_id, text)] → one prompt block within the token budget.
+
+        Per-doc budget is proportional to doc length with a floor, so every
+        document is represented."""
+        docs = list(docs)[: self.cfg.max_chunks]
+        if not docs:
+            return ""
+        tok = self.generator.tokenizer
+        lengths = [max(1, len(tok.encode(t, add_specials=False))) for _, t in docs]
+        total = sum(lengths)
+        floor = max(16, budget_tokens // (4 * len(docs)))
+        blocks: List[str] = []
+        for (doc_id, text), n_tok in zip(docs, lengths):
+            share = max(floor, int(budget_tokens * n_tok / max(total, 1)))
+            if n_tok > share:
+                # trim at a word boundary near the proportional char budget
+                approx_chars = int(len(text) * share / n_tok)
+                cut = text.rfind(" ", 0, approx_chars)
+                text = text[: cut if cut > 0 else approx_chars] + " …"
+            blocks.append(f"[{doc_id}]\n{text}")
+        return "\n\n".join(blocks)
+
+    # ---- API -----------------------------------------------------------------
+
+    def summarize_prompt(
+        self, prompt: str, max_tokens: Optional[int] = None
+    ) -> str:
+        """Free-form prompt → summary text (the ``/api/llm/summarize``
+        contract the reference declared but never implemented)."""
+        if self.use_fake:
+            return prompt[-self.fake_max_chars :]
+        max_tokens = max_tokens or self.cfg.max_summary_tokens
+        with span("summarize", DEFAULT_REGISTRY):
+            return self.generator.generate_texts(
+                [prompt], max_new_tokens=max_tokens
+            )[0]
+
+    def summarize_patient(
+        self,
+        patient_id: str,
+        docs: Sequence[Tuple[str, str]],
+        max_tokens: Optional[int] = None,
+    ) -> str:
+        body = self._pack_documents(docs, self.cfg.max_input_tokens)
+        prompt = SINGLE_PATIENT_TEMPLATE.format(
+            patient_id=patient_id, documents=body
+        )
+        return self.summarize_prompt(prompt, max_tokens)
+
+    def compare_patients(
+        self,
+        patient_docs: Sequence[Tuple[str, Sequence[Tuple[str, str]]]],
+        max_tokens: Optional[int] = None,
+    ) -> str:
+        """[(patient_id, [(doc_id, text)])] → comparative summary.
+        Block format mirrors the reference's ``=== PATIENT_x ===`` assembly
+        (``routes.py:91-101``)."""
+        n = max(1, len(patient_docs))
+        per_patient = self.cfg.max_input_tokens // n
+        sections = []
+        for pid, docs in patient_docs:
+            body = self._pack_documents(docs, per_patient)
+            sections.append(f"=== PATIENT {pid} ===\n{body}")
+        prompt = MULTI_PATIENT_TEMPLATE.format(documents="\n\n".join(sections))
+        return self.summarize_prompt(prompt, max_tokens)

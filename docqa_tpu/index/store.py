@@ -282,6 +282,13 @@ class VectorStore:
             out.append(row)
         return out
 
+    def metadata_rows(self) -> List[Dict[str, Any]]:
+        """Stable copy of the live metadata (row order == insertion order) —
+        backs non-semantic listings like patient-snippet retrieval without a
+        device round-trip."""
+        with self._lock:
+            return list(self._meta[: self._count])
+
     # ---- versioned snapshot (checkpoint/resume parity, SURVEY §5) -----------
 
     def snapshot(self, directory: str) -> str:

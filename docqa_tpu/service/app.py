@@ -1,0 +1,329 @@
+"""Application wiring + HTTP surface.
+
+One process replaces the reference's six (SURVEY §1): the runtime owns the
+mesh, engines, store, broker, registry, pipeline and services; the aiohttp
+app exposes every endpoint the reference exposed — plus the two it *called*
+without providing (patient-snippet search, prompt summarize).
+
+Construction is factory-based, never at import time — the reference built
+models/indexes at module import, which its own tests had to undo with
+``sys.modules`` surgery (SURVEY §4 lesson 1).
+
+Endpoint parity map (reference → here):
+  POST /ingest/                 doc-ingestor/main.py:19-65
+  GET  /documents/              doc-ingestor/main.py:67-69
+  GET  /health                  doc-ingestor/main.py:72-74, llm-qa/main.py:124-126
+  POST /ask/                    llm-qa/main.py:111-122
+  GET  /api/status              synthese-comparative/api/routes.py:22-24
+  POST /api/synthese/patient    routes.py:27-75
+  POST /api/synthese/comparaison routes.py:78-141
+  GET  /api/search/patient-snippets   (aspirational: retrieval_client.py:89)
+  POST /api/llm/summarize             (aspirational: llm_client.py:51)
+  GET  /metrics                 (new: SURVEY §5 — reference had none)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+from typing import Optional
+
+from docqa_tpu.config import Config, load_config
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
+from docqa_tpu.service.broker import make_broker
+from docqa_tpu.service.pipeline import DocumentPipeline
+from docqa_tpu.service.qa import QAService
+from docqa_tpu.service.registry import DocumentRegistry
+from docqa_tpu.service.schemas import (
+    PatientComparisonRequest,
+    PatientSummaryRequest,
+    Query,
+    SummarizeRequest,
+)
+from docqa_tpu.service.synthesis import SynthesisError, SynthesisService
+
+log = get_logger("docqa.app")
+
+
+class DocQARuntime:
+    """Builds and owns every component; start()/stop() manage the workers."""
+
+    def __init__(
+        self,
+        cfg: Optional[Config] = None,
+        journal_dir: Optional[str] = None,
+    ) -> None:
+        import jax
+
+        from docqa_tpu.deid.engine import DeidEngine
+        from docqa_tpu.engines.encoder import EncoderEngine, HashEncoder
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.engines.summarize import SummarizeEngine
+        from docqa_tpu.index.store import VectorStore
+        from docqa_tpu.runtime.mesh import make_mesh, multihost_init
+
+        self.cfg = cfg or load_config()
+        multihost_init()
+        self.mesh = make_mesh(self.cfg.mesh) if jax.device_count() > 1 else None
+
+        if self.cfg.flags.use_fake_encoder:
+            self.encoder = HashEncoder(self.cfg.encoder)
+        else:
+            self.encoder = EncoderEngine(self.cfg.encoder, mesh=self.mesh)
+        self.store = VectorStore(self.cfg.store, mesh=self.mesh)
+        self.deid = DeidEngine(self.cfg.ner)
+        self.generator = GenerateEngine(
+            self.cfg.decoder, gen=self.cfg.generate, mesh=self.mesh
+        )
+        self.summarizer = SummarizeEngine(
+            self.generator,
+            self.cfg.summarizer,
+            use_fake=self.cfg.flags.use_fake_llm,
+        )
+
+        self.broker = make_broker(self.cfg.broker, journal_dir=journal_dir)
+        self.registry = DocumentRegistry(self.cfg.registry.url)
+        self.pipeline = DocumentPipeline(
+            self.cfg,
+            self.broker,
+            self.registry,
+            self.deid,
+            self.encoder,
+            self.store,
+        )
+        self.qa = QAService(
+            self.encoder,
+            self.store,
+            self.generator,
+            self.summarizer,
+            k=self.cfg.store.default_k,
+            use_fake_llm=self.cfg.flags.use_fake_llm,
+        )
+        self.synthesis = SynthesisService(
+            retrieval=self.qa.patient_snippets, summarizer=self.summarizer
+        )
+
+    def start(self) -> "DocQARuntime":
+        self.pipeline.start()
+        return self
+
+    def stop(self) -> None:
+        self.pipeline.stop()
+        self.broker.close()
+        self.registry.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer (aiohttp; device work runs on a single executor thread so decode
+# programs are never dispatched concurrently)
+# ---------------------------------------------------------------------------
+
+def make_app(rt: DocQARuntime):
+    from aiohttp import web
+
+    device_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="device"
+    )
+
+    async def on_device(fn, *args, **kw):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            device_pool, lambda: fn(*args, **kw)
+        )
+
+    def json_error(status: int, detail: str):
+        return web.json_response({"detail": detail}, status=status)
+
+    # ---- health / status ----------------------------------------------------
+
+    async def health(_req):
+        return web.json_response({"status": "ok"})
+
+    async def api_status(_req):
+        return web.json_response(
+            {
+                "service": "docqa-tpu",
+                "status": "running",
+                "indexed_vectors": rt.store.count,
+                "index_version": rt.store.version,
+                "queue_depths": {
+                    rt.cfg.broker.raw_queue: rt.broker.depth(
+                        rt.cfg.broker.raw_queue
+                    ),
+                    rt.cfg.broker.clean_queue: rt.broker.depth(
+                        rt.cfg.broker.clean_queue
+                    ),
+                },
+            }
+        )
+
+    async def metrics(_req):
+        return web.json_response(DEFAULT_REGISTRY.snapshot())
+
+    # ---- ingestion ----------------------------------------------------------
+
+    async def ingest(req):
+        """Multipart (file + form fields, reference contract
+        doc-ingestor/main.py:19-24) or JSON {filename, text, ...}."""
+        filename, data = None, None
+        doc_type = patient_id = doc_date = None
+        wait = req.query.get("wait") in ("1", "true")
+        if req.content_type and req.content_type.startswith("multipart/"):
+            reader = await req.multipart()
+            async for part in reader:
+                if part.name == "file":
+                    filename = part.filename or "upload"
+                    data = await part.read(decode=False)
+                elif part.name in ("doc_type", "patient_id", "doc_date"):
+                    value = (await part.text()).strip() or None
+                    if part.name == "doc_type":
+                        doc_type = value
+                    elif part.name == "patient_id":
+                        patient_id = value
+                    else:
+                        doc_date = value
+        else:
+            body = await req.json()
+            filename = body.get("filename", "inline.txt")
+            data = body.get("text", "").encode()
+            doc_type = body.get("doc_type")
+            patient_id = body.get("patient_id")
+            doc_date = body.get("doc_date")
+        if not data:
+            return json_error(400, "no file/text provided")
+        record = await on_device(
+            rt.pipeline.ingest_document,
+            filename,
+            data,
+            doc_type,
+            patient_id,
+            doc_date,
+        )
+        if wait:
+            await asyncio.get_running_loop().run_in_executor(
+                None, rt.pipeline.wait_indexed, record.doc_id
+            )
+            record = rt.registry.get(record.doc_id)
+        return web.json_response(
+            {"doc_id": record.doc_id, "status": record.status}
+        )
+
+    async def documents(_req):
+        return web.json_response(
+            [r.to_dict() for r in rt.registry.list_documents()]
+        )
+
+    async def document_one(req):
+        rec = rt.registry.get(req.match_info["doc_id"])
+        if rec is None:
+            return json_error(404, "document not found")
+        return web.json_response(rec.to_dict())
+
+    # ---- QA -----------------------------------------------------------------
+
+    async def ask(req):
+        try:
+            q = Query(**await req.json())
+        except Exception as e:
+            return json_error(422, str(e))
+        if rt.store.count == 0:
+            # parity: llm-qa returns 503 when its index is unavailable
+            # (main.py:113-114) — ours can only be *empty*, never missing
+            return json_error(503, "index is empty; ingest documents first")
+        result = await on_device(rt.qa.ask, q.question)
+        return web.json_response(result)
+
+    async def patient_snippets(req):
+        pid = req.query.get("patient_id")
+        if not pid:
+            return json_error(422, "patient_id is required")
+        rows = await on_device(
+            rt.qa.patient_snippets,
+            pid,
+            req.query.get("from_date"),
+            req.query.get("to_date"),
+            req.query.get("focus"),
+        )
+        return web.json_response(rows)
+
+    async def llm_summarize(req):
+        try:
+            body = SummarizeRequest(**await req.json())
+        except Exception as e:
+            return json_error(422, str(e))
+        summary = await on_device(
+            rt.qa.summarize, body.prompt, body.max_tokens
+        )
+        return web.json_response({"summary": summary})
+
+    # ---- synthesis ----------------------------------------------------------
+
+    async def synthese_patient(req):
+        try:
+            body = PatientSummaryRequest(**await req.json())
+        except Exception as e:
+            return json_error(422, str(e))
+        try:
+            resp = await on_device(
+                rt.synthesis.patient_summary,
+                body.patient_id,
+                body.from_date,
+                body.to_date,
+                body.focus,
+            )
+        except SynthesisError as e:
+            return json_error(e.status, e.detail)
+        return web.json_response(json.loads(resp.model_dump_json()))
+
+    async def synthese_comparaison(req):
+        try:
+            body = PatientComparisonRequest(**await req.json())
+        except Exception as e:
+            return json_error(422, str(e))
+        try:
+            resp = await on_device(
+                rt.synthesis.patient_comparison, body.patient_ids, body.focus
+            )
+        except SynthesisError as e:
+            return json_error(e.status, e.detail)
+        return web.json_response(json.loads(resp.model_dump_json()))
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app.add_routes(
+        [
+            web.get("/health", health),
+            web.get("/api/status", api_status),
+            web.get("/metrics", metrics),
+            web.post("/ingest/", ingest),
+            web.get("/documents/", documents),
+            web.get("/documents/{doc_id}", document_one),
+            web.post("/ask/", ask),
+            web.get("/api/search/patient-snippets", patient_snippets),
+            web.post("/api/llm/summarize", llm_summarize),
+            web.post("/api/synthese/patient", synthese_patient),
+            web.post("/api/synthese/comparaison", synthese_comparaison),
+        ]
+    )
+    app["runtime"] = rt
+    app["device_pool"] = device_pool
+    return app
+
+
+def serve(cfg: Optional[Config] = None, port: Optional[int] = None) -> None:
+    from aiohttp import web
+
+    rt = DocQARuntime(cfg).start()
+    app = make_app(rt)
+    try:
+        web.run_app(
+            app,
+            host=rt.cfg.service.host,
+            port=port or rt.cfg.service.ingest_port,
+        )
+    finally:
+        rt.stop()
+
+
+if __name__ == "__main__":
+    serve()

@@ -1,0 +1,102 @@
+"""Knowledge-base bootstrap from CSV files.
+
+Parity with ``semantic-indexer/indexer.py:50-94``: on first start, CSV rows
+from a data directory are templated into natural-language sentences and
+indexed, filename-dispatched —
+
+* files whose name contains ``matrice`` or ``ranking``: the reference's
+  (syndrome, plant, score) scoring matrix → one score sentence per row
+  (``indexer.py:67-76``);
+* files whose name contains ``base`` or ``connaissance``: the denormalized
+  syndrome/formula/plant table → one detail sentence per row
+  (``indexer.py:79-89``);
+* anything else: a generic "column: value" sentence (the reference skipped
+  unknown files; we keep them searchable).
+
+Sentences are our own templating, not the reference's strings; the *shape*
+(one sentence per row, score surfaced for ranking prompts) is what matters
+for retrieval parity.
+"""
+
+from __future__ import annotations
+
+import csv
+import glob
+import os
+from typing import Dict, List, Optional, Tuple
+
+from docqa_tpu.runtime.metrics import get_logger
+
+log = get_logger("docqa.bootstrap")
+
+
+def _get(row: Dict[str, str], *names: str) -> Optional[str]:
+    for n in names:
+        for key, value in row.items():
+            if key and key.strip().lower() == n:
+                value = (value or "").strip()
+                if value:
+                    return value
+    return None
+
+
+def row_to_sentence(filename: str, row: Dict[str, str]) -> Optional[str]:
+    base = os.path.basename(filename).lower()
+    if "matrice" in base or "ranking" in base:
+        syndrome = _get(row, "nom_syndrome", "syndrome")
+        plant = _get(row, "nom_latin", "plante", "plant")
+        chinese = _get(row, "nom_chinois")
+        score = _get(row, "score_role", "score")
+        if not (syndrome and plant):
+            return None
+        name = f"{plant} ({chinese})" if chinese else plant
+        return (
+            f"Pour le syndrome {syndrome}, la plante {name} est pertinente "
+            f"avec un score de {score or 'non renseigné'}."
+        )
+    if "base" in base or "connaissance" in base:
+        syndrome = _get(row, "nom_syndrome", "syndrome")
+        formula = _get(row, "nom_formule", "formule", "formula")
+        plant = _get(row, "nom_latin", "nom_plante", "plante")
+        role = _get(row, "role", "role_plante")
+        score = _get(row, "score_role", "score")
+        parts = []
+        if syndrome:
+            parts.append(f"Syndrome: {syndrome}.")
+        if formula:
+            parts.append(f"Formule associée: {formula}.")
+        if plant:
+            r = f" avec le rôle {role}" if role else ""
+            s = f" (score {score})" if score else ""
+            parts.append(f"La plante {plant} y figure{r}{s}.")
+        return " ".join(parts) if parts else None
+    # generic fallback
+    kv = [f"{k.strip()}: {v.strip()}" for k, v in row.items() if k and v and v.strip()]
+    return ". ".join(kv) + "." if kv else None
+
+
+def bootstrap_csv_dir(data_dir: str, encoder, store) -> int:
+    """Index every CSV in ``data_dir``; returns rows indexed.  All sentences
+    of all files are encoded in batched device calls (the reference looped
+    batch-1 encodes, 649 of them — SURVEY §3.4 hot spot)."""
+    sentences: List[str] = []
+    metas: List[Dict[str, object]] = []
+    for path in sorted(glob.glob(os.path.join(data_dir, "*.csv"))):
+        with open(path, newline="", encoding="utf-8", errors="replace") as f:
+            for row in csv.DictReader(f):
+                sent = row_to_sentence(path, row)
+                if sent:
+                    sentences.append(sent)
+                    metas.append(
+                        {
+                            "doc_id": f"kb:{os.path.basename(path)}",
+                            "text_content": sent,
+                            "source": os.path.basename(path),
+                            "type": "knowledge_base",
+                            "patient_id": None,
+                        }
+                    )
+    if sentences:
+        store.add(encoder.encode_texts(sentences), metas)
+        log.info("bootstrapped %d knowledge rows from %s", len(sentences), data_dir)
+    return len(sentences)

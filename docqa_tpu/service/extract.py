@@ -1,0 +1,187 @@
+"""Text extraction (host-side CPU stage — not TPU work, SURVEY §2b).
+
+Replaces the reference's external Apache Tika JVM server
+(``doc-ingestor/processing.py:10-19``, ``docker-compose.yml:34-38``) with
+in-process pure-Python extractors for the three formats the reference UI
+accepts — pdf / txt / docx (``clinical-ui/app.py:38``) — plus the same
+HTTP-server escape hatch for anything exotic.
+
+Contract mirrors ``extract_text_from_file``: returns the stripped text, or
+``None`` on failure (``processing.py:16-19``).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zipfile
+import zlib
+from typing import Callable, Dict, Optional
+
+from docqa_tpu.runtime.metrics import get_logger
+
+log = get_logger("docqa.extract")
+
+
+# ---- plain text ------------------------------------------------------------
+
+def extract_txt(data: bytes) -> Optional[str]:
+    for enc in ("utf-8", "utf-16", "latin-1"):
+        try:
+            text = data.decode(enc).strip()
+        except (UnicodeDecodeError, UnicodeError):
+            continue
+        # latin-1 decodes ANY byte string — reject binary mojibake so the
+        # HTTP (Tika) fallback stays reachable for real binary formats
+        if text and _control_fraction(text) > 0.05:
+            return None
+        return text
+    return None
+
+
+def _control_fraction(text: str) -> float:
+    n = len(text)
+    if n == 0:
+        return 0.0
+    bad = sum(
+        1
+        for c in text
+        if (ord(c) < 32 and c not in "\n\r\t") or 0x7F <= ord(c) < 0xA0
+    )
+    return bad / n
+
+
+# ---- docx ------------------------------------------------------------------
+
+_DOCX_TAG_RE = re.compile(rb"<[^>]+>")
+
+
+def extract_docx(data: bytes) -> Optional[str]:
+    """DOCX = zip; text lives in word/document.xml.  Paragraph tags become
+    newlines, every other tag is stripped."""
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            xml = z.read("word/document.xml")
+    except (zipfile.BadZipFile, KeyError):
+        return None
+    xml = re.sub(rb"</w:p>", b"\n", xml)
+    xml = re.sub(rb"<w:tab[^>]*/>", b"\t", xml)
+    text = _DOCX_TAG_RE.sub(b"", xml).decode("utf-8", errors="replace")
+    # unescape the XML entities that matter in prose
+    for ent, ch in (
+        ("&amp;", "&"), ("&lt;", "<"), ("&gt;", ">"),
+        ("&quot;", '"'), ("&apos;", "'"),
+    ):
+        text = text.replace(ent, ch)
+    return text.strip() or None
+
+
+# ---- pdf -------------------------------------------------------------------
+
+_STREAM_RE = re.compile(rb"stream\r?\n(.*?)endstream", re.DOTALL)
+_TEXT_OP_RE = re.compile(
+    rb"\((?:[^()\\]|\\.)*\)\s*Tj"  # (string) Tj
+    rb"|\[(?:[^\]\\]|\\.)*\]\s*TJ"  # [ (s) kern (s) ] TJ
+    rb"|T\*|TD|Td",  # line-advance operators → newline
+)
+_PDF_STR_RE = re.compile(rb"\((?:[^()\\]|\\.)*\)")
+
+_PDF_ESCAPES = {
+    b"\\n": b"\n", b"\\r": b"\r", b"\\t": b"\t",
+    b"\\(": b"(", b"\\)": b")", b"\\\\": b"\\",
+}
+
+
+def _decode_pdf_string(raw: bytes) -> bytes:
+    out = raw[1:-1]  # strip parens
+    for esc, ch in _PDF_ESCAPES.items():
+        out = out.replace(esc, ch)
+    out = re.sub(rb"\\(\d{1,3})", lambda m: bytes([int(m.group(1), 8) & 0xFF]), out)
+    return out
+
+
+def extract_pdf(data: bytes) -> Optional[str]:
+    """Minimal PDF text extraction: inflate content streams, read Tj/TJ
+    show-text operators.  Covers linear text PDFs (clinical letters/reports);
+    image-only or CID-encoded PDFs fall through to the HTTP extractor if one
+    is configured."""
+    if not data.startswith(b"%PDF"):
+        return None
+    pieces = []
+    for m in _STREAM_RE.finditer(data):
+        raw = m.group(1)
+        try:
+            content = zlib.decompress(raw)
+        except zlib.error:
+            content = raw  # uncompressed stream
+        if b"Tj" not in content and b"TJ" not in content:
+            continue
+        line: list = []
+        for op in _TEXT_OP_RE.finditer(content):
+            tok = op.group()
+            if tok in (b"T*", b"TD", b"Td") or tok.endswith((b"TD", b"Td")):
+                if line:
+                    pieces.append(b"".join(line))
+                    line = []
+                continue
+            for s in _PDF_STR_RE.finditer(tok):
+                line.append(_decode_pdf_string(s.group()))
+        if line:
+            pieces.append(b"".join(line))
+    if not pieces:
+        return None
+    text = b"\n".join(pieces).decode("utf-8", errors="replace").strip()
+    return text or None
+
+
+# ---- HTTP escape hatch (Tika-protocol compatible) --------------------------
+
+def make_http_extractor(server_url: str) -> Callable[[bytes], Optional[str]]:
+    """PUT bytes to a Tika-compatible server (`{server}/tika`) — the same
+    wire protocol the reference used (``processing.py:15``), kept as an
+    opt-in fallback for scanned/exotic formats."""
+
+    def extract(data: bytes) -> Optional[str]:
+        try:
+            import httpx
+
+            r = httpx.put(
+                f"{server_url.rstrip('/')}/tika",
+                content=data,
+                headers={"Accept": "text/plain"},
+                timeout=30.0,
+            )
+            r.raise_for_status()
+            return r.text.strip() or None
+        except Exception:
+            log.exception("http extraction failed")
+            return None
+
+    return extract
+
+
+# ---- dispatch --------------------------------------------------------------
+
+_BY_EXT: Dict[str, Callable[[bytes], Optional[str]]] = {
+    "txt": extract_txt,
+    "md": extract_txt,
+    "csv": extract_txt,
+    "json": extract_txt,
+    "docx": extract_docx,
+    "pdf": extract_pdf,
+}
+
+
+def extract_text(
+    data: bytes,
+    filename: str,
+    http_fallback: Optional[Callable[[bytes], Optional[str]]] = None,
+) -> Optional[str]:
+    """Extension-dispatched extraction; unknown extensions try plain-text
+    sniffing; anything still unreadable goes to the HTTP fallback."""
+    ext = filename.rsplit(".", 1)[-1].lower() if "." in filename else ""
+    fn = _BY_EXT.get(ext, extract_txt)
+    text = fn(data)
+    if text is None and http_fallback is not None:
+        text = http_fallback(data)
+    return text

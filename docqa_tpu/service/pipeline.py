@@ -1,0 +1,205 @@
+"""The async document pipeline: ingest → de-identify → chunk+embed+index.
+
+Re-creates the reference's three-process queue pipeline (SURVEY §3.1) inside
+one framework, with the device plane batched:
+
+* ingest (was ``doc-ingestor/main.py:19-65``): registry row PENDING → extract
+  → publish to the raw queue → PROCESSED / ERROR_EXTRACTION / ERROR_QUEUE;
+* deid worker (was ``deid-service/anonymizer.py:50-87``): batch-consumes the
+  raw queue, jit NER + pattern recognizers over the batch, publishes the
+  reference's message schema ``{doc_id, original_text_masked, metadata,
+  processed_at}`` to the clean queue;
+* index worker (was ``semantic-indexer/indexer.py:112-126``): batch-consumes,
+  chunks, encodes ALL chunks of the batch in one device call (the reference
+  ran one batch-1 encode per chunk) and appends to the HBM store — which is
+  immediately searchable, no file handoff, no restart.
+
+Completion is *observable*: the registry reaches INDEXED with a chunk count
+(the reference UI guessed with a 5 s sleep, ``clinical-ui/app.py:55-58``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from docqa_tpu.config import Config
+from docqa_tpu.service import registry as reg
+from docqa_tpu.service.broker import Consumer, MemoryBroker
+from docqa_tpu.service.extract import extract_text
+from docqa_tpu.service.registry import DocumentRegistry
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+from docqa_tpu.text.chunker import chunk_text
+
+log = get_logger("docqa.pipeline")
+
+
+class DocumentPipeline:
+    """Owns the two queue consumers and the ingest entrypoint."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        broker: MemoryBroker,
+        registry: DocumentRegistry,
+        deid_engine,  # DeidEngine
+        encoder_engine,  # EncoderEngine
+        store,  # VectorStore
+        http_extractor=None,
+    ) -> None:
+        self.cfg = cfg
+        self.broker = broker
+        self.registry = registry
+        self.deid = deid_engine
+        self.encoder = encoder_engine
+        self.store = store
+        self.http_extractor = http_extractor
+        self._consumers = [
+            Consumer(
+                broker,
+                cfg.broker.raw_queue,
+                self._deid_handler,
+                batch=cfg.broker.prefetch,
+                name="deid-worker",
+                on_dead=lambda body: self.registry.set_status(
+                    body["doc_id"], reg.ERROR_DEID
+                ),
+            ),
+            Consumer(
+                broker,
+                cfg.broker.clean_queue,
+                self._index_handler,
+                batch=cfg.broker.prefetch,
+                name="index-worker",
+                on_dead=lambda body: self.registry.set_status(
+                    body["doc_id"], reg.ERROR_INDEXING
+                ),
+            ),
+        ]
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for c in self._consumers:
+            c.start()
+
+    def stop(self) -> None:
+        for c in self._consumers:
+            c.stop()
+
+    # ---- ingest (sync stage) -------------------------------------------------
+
+    def ingest_document(
+        self,
+        filename: str,
+        data: bytes,
+        doc_type: Optional[str] = None,
+        patient_id: Optional[str] = None,
+        doc_date: Optional[str] = None,
+    ):
+        """Reference contract (``doc-ingestor/main.py:19-65``): create the
+        metadata row first, then extract, then queue; every failure mode gets
+        a distinct terminal status."""
+        record = self.registry.create(filename, doc_type, patient_id, doc_date)
+        with span("extract", DEFAULT_REGISTRY):
+            text = extract_text(data, filename, self.http_extractor)
+        if text is None or not text.strip():
+            self.registry.set_status(record.doc_id, reg.ERROR_EXTRACTION)
+            return self.registry.get(record.doc_id)
+        try:
+            self.broker.publish(
+                self.cfg.broker.raw_queue,
+                {
+                    "doc_id": record.doc_id,
+                    "text": text,
+                    "metadata": {
+                        "filename": filename,
+                        "type": doc_type,
+                        "patient_id": patient_id,
+                        "doc_date": doc_date,
+                    },
+                },
+            )
+        except Exception:
+            log.exception("queue publish failed")
+            self.registry.set_status(record.doc_id, reg.ERROR_QUEUE)
+            return self.registry.get(record.doc_id)
+        self.registry.set_status(record.doc_id, reg.PROCESSED)
+        return self.registry.get(record.doc_id)
+
+    def ingest_text(self, text: str, **kw):
+        """Convenience for pre-extracted text (tests, CSV bootstrap)."""
+        return self.ingest_document(kw.pop("filename", "inline.txt"), text.encode(), **kw)
+
+    # ---- workers -------------------------------------------------------------
+
+    def _deid_handler(self, bodies: List[Dict[str, Any]]) -> None:
+        texts = [b["text"] for b in bodies]
+        with span("deid_batch", DEFAULT_REGISTRY):
+            masked = self.deid.deidentify_batch(texts)
+        for body, clean in zip(bodies, masked):
+            # status BEFORE publish: once the message is on the clean queue
+            # the index worker may race us to INDEXED, which must not be
+            # overwritten by a late DEIDENTIFIED
+            self.registry.set_status(body["doc_id"], reg.DEIDENTIFIED)
+            self.broker.publish(
+                self.cfg.broker.clean_queue,
+                {
+                    "doc_id": body["doc_id"],
+                    "original_text_masked": clean,
+                    "metadata": body.get("metadata", {}),
+                    "processed_at": time.time(),
+                },
+            )
+
+    def _index_handler(self, bodies: List[Dict[str, Any]]) -> None:
+        all_chunks: List[str] = []
+        all_meta: List[Dict[str, Any]] = []
+        per_doc: List[tuple] = []
+        for body in bodies:
+            text = body["original_text_masked"]
+            md = body.get("metadata", {})
+            chunks = chunk_text(text, self.cfg.chunk)
+            per_doc.append((body["doc_id"], len(chunks)))
+            for ci, ch in enumerate(chunks):
+                all_chunks.append(ch.text)
+                all_meta.append(
+                    {
+                        "doc_id": body["doc_id"],
+                        "text_content": ch.text,
+                        "source": f"Dossier Patient {body['doc_id']}"
+                        if md.get("patient_id")
+                        else (md.get("filename") or body["doc_id"]),
+                        "type": "patient_file",
+                        "patient_id": md.get("patient_id"),
+                        "doc_type": md.get("type"),
+                        "doc_date": md.get("doc_date"),
+                        "chunk_index": ci,
+                        "char_start": ch.start,
+                        "char_end": ch.end,
+                    }
+                )
+        if all_chunks:
+            with span("index_batch", DEFAULT_REGISTRY):
+                embeddings = self.encoder.encode_texts(all_chunks)
+                self.store.add(embeddings, all_meta)
+        for doc_id, n in per_doc:
+            self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
+
+    # ---- completion signal ---------------------------------------------------
+
+    def wait_indexed(self, doc_id: str, timeout: float = 30.0) -> bool:
+        """Real completion signal (vs the reference's 5 s guess)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            record = self.registry.get(doc_id)
+            if record is not None and record.status in (
+                reg.INDEXED,
+                reg.ERROR_EXTRACTION,
+                reg.ERROR_QUEUE,
+                reg.ERROR_DEID,
+                reg.ERROR_INDEXING,
+            ):
+                return record.status == reg.INDEXED
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,120 @@
+"""QA / RAG service core (replaces ``llm-qa/main.py`` end to end).
+
+The reference's ``/ask/`` stack — CPU batch-1 query embed → FAISS exact
+search k=3 → prompt stuffing → HTTP round-trip to Ollama (SURVEY §3.2) —
+becomes three on-device steps in one process: jit encoder → sharded
+HBM top-k → jit decode loop with KV cache.
+
+Also implements, for real, the two endpoints the reference *called* but
+never provided (SURVEY §1 "aspirational API layer"):
+
+* patient-snippet retrieval (``core/retrieval_client.py:89``) — backed by
+  the store's metadata filter (first-class ``patient_id``/dates, which the
+  reference store schema couldn't express);
+* prompt summarization (``core/llm_client.py:51``) — backed by the
+  summarizer engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+
+# Our own QA template; same *shape* as the reference's French TCM-expert
+# prompt with score-ranking instructions (``llm-qa/main.py:71-93``) without
+# reproducing its wording.
+QA_TEMPLATE = (
+    "Tu es un expert en médecine traditionnelle chinoise et en analyse de "
+    "dossiers cliniques. Appuie-toi uniquement sur le contexte ci-dessous. "
+    "Quand plusieurs éléments portent un score, privilégie les scores les "
+    "plus élevés et mentionne-les. Si le contexte ne permet pas de répondre, "
+    "dis-le explicitement.\n\n"
+    "Contexte:\n{context}\n\nQuestion: {question}\n\nRéponse:"
+)
+
+
+class QAService:
+    def __init__(
+        self,
+        encoder,  # EncoderEngine
+        store,  # VectorStore
+        generator,  # GenerateEngine
+        summarizer,  # SummarizeEngine
+        k: int = 3,
+        use_fake_llm: bool = False,
+    ) -> None:
+        self.encoder = encoder
+        self.store = store
+        self.generator = generator
+        self.summarizer = summarizer
+        self.k = k
+        self.use_fake_llm = use_fake_llm
+
+    # ---- /ask/ ---------------------------------------------------------------
+
+    def ask(self, question: str, k: Optional[int] = None) -> Dict[str, Any]:
+        """Returns the reference's response contract
+        ``{"answer": ..., "sources": [...]}`` (``llm-qa/main.py:119-122``)."""
+        with span("qa_e2e", DEFAULT_REGISTRY):
+            emb = self.encoder.encode_texts([question])
+            hits = self.store.search(emb, k=k or self.k)[0]
+            context = "\n\n".join(
+                h.metadata.get("text_content", h.metadata.get("source", ""))
+                for h in hits
+            )
+            prompt = QA_TEMPLATE.format(context=context, question=question)
+            if self.use_fake_llm:
+                answer = context[:500] if context else "Aucun contexte trouvé."
+            else:
+                answer = self.generator.generate_texts([prompt])[0]
+        return {
+            "answer": answer,
+            "sources": [h.metadata.get("source", "") for h in hits],
+        }
+
+    # ---- /api/search/patient-snippets ---------------------------------------
+
+    def patient_snippets(
+        self,
+        patient_id: str,
+        from_date: Optional[str] = None,
+        to_date: Optional[str] = None,
+        focus: Optional[str] = None,
+        limit: int = 20,
+    ) -> List[Dict[str, str]]:
+        """The retrieval contract synthese expected: ``[{doc_id, text}]``
+        (``core/retrieval_client.py:81-91``).
+
+        ``focus`` ranks the patient's chunks by semantic similarity; without
+        focus, chunks come back in document order."""
+
+        def belongs(md: Dict[str, Any]) -> bool:
+            if md.get("patient_id") != patient_id:
+                return False
+            d = md.get("doc_date")
+            if from_date and (d is None or d < from_date):
+                return False
+            if to_date and (d is None or d > to_date):
+                return False
+            return True
+
+        if focus:
+            emb = self.encoder.encode_texts([focus])
+            hits = self.store.search(emb, k=limit, where=belongs)[0]
+            rows = [h.metadata for h in hits]
+        else:
+            rows = [
+                md
+                for md in self.store.metadata_rows()
+                if belongs(md)
+            ][:limit]
+        return [
+            {"doc_id": md["doc_id"], "text": md.get("text_content", "")}
+            for md in rows
+        ]
+
+    # ---- /api/llm/summarize --------------------------------------------------
+
+    def summarize(self, prompt: str, max_tokens: Optional[int] = None) -> str:
+        return self.summarizer.summarize_prompt(prompt, max_tokens)

@@ -1,0 +1,176 @@
+"""Document-metadata registry.
+
+Replaces the reference's Postgres ``documents`` table + SQLAlchemy layer
+(``doc-ingestor/models.py:5-12``, ``doc-ingestor/database.py:7-21``) with a
+pluggable store: SQLite by default (stdlib, zero deploy), same schema shape,
+no hardcoded credentials (the reference committed them, ``database.py:10``).
+
+Two deliberate extensions over the reference schema:
+
+* first-class ``patient_id`` — the synthesis service's patient-snippet
+  retrieval was unimplementable against the reference store because patient
+  identity lived only in a display string (SURVEY appendix);
+* an ``INDEXED`` terminal status — the reference pipeline emitted no
+  completion signal, so its UI faked one with a 5 s sleep
+  (``clinical-ui/app.py:55-58``).  Status flow:
+  PENDING → PROCESSED → DEIDENTIFIED → INDEXED (or ERROR_*).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, List, Optional
+
+# status values; the first four mirror the reference's
+# (doc-ingestor/main.py:30,44,54,63)
+PENDING = "PENDING"
+PROCESSED = "PROCESSED"
+ERROR_EXTRACTION = "ERROR_EXTRACTION"
+ERROR_QUEUE = "ERROR_QUEUE"
+DEIDENTIFIED = "DEIDENTIFIED"
+INDEXED = "INDEXED"
+ERROR_DEID = "ERROR_DEID"
+ERROR_INDEXING = "ERROR_INDEXING"
+
+
+@dataclass
+class DocumentRecord:
+    doc_id: str
+    filename: str
+    upload_date: float
+    status: str
+    doc_type: Optional[str] = None
+    patient_id: Optional[str] = None
+    doc_date: Optional[str] = None  # ISO date of the clinical document
+    n_chunks: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+class DocumentRegistry:
+    """SQLite-backed registry; ``url`` 'sqlite://' = in-memory,
+    'sqlite:///path.db' = on disk (crash-durable)."""
+
+    def __init__(self, url: str = "sqlite://") -> None:
+        if url in ("sqlite://", "sqlite:///:memory:"):
+            path = ":memory:"
+        elif url.startswith("sqlite:///"):
+            path = url[len("sqlite:///") :]
+        else:
+            raise ValueError(f"unsupported registry url: {url}")
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS documents (
+                    doc_id TEXT PRIMARY KEY,
+                    filename TEXT NOT NULL,
+                    upload_date REAL NOT NULL,
+                    status TEXT NOT NULL,
+                    doc_type TEXT,
+                    patient_id TEXT,
+                    doc_date TEXT,
+                    n_chunks INTEGER DEFAULT 0
+                )"""
+            )
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_documents_filename "
+                "ON documents(filename)"
+            )
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_documents_patient "
+                "ON documents(patient_id)"
+            )
+            self._conn.commit()
+
+    def create(
+        self,
+        filename: str,
+        doc_type: Optional[str] = None,
+        patient_id: Optional[str] = None,
+        doc_date: Optional[str] = None,
+    ) -> DocumentRecord:
+        rec = DocumentRecord(
+            doc_id=uuid.uuid4().hex[:16],
+            filename=filename,
+            upload_date=time.time(),
+            status=PENDING,
+            doc_type=doc_type,
+            patient_id=patient_id,
+            doc_date=doc_date,
+        )
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO documents VALUES (?,?,?,?,?,?,?,?)",
+                (
+                    rec.doc_id,
+                    rec.filename,
+                    rec.upload_date,
+                    rec.status,
+                    rec.doc_type,
+                    rec.patient_id,
+                    rec.doc_date,
+                    rec.n_chunks,
+                ),
+            )
+            self._conn.commit()
+        return rec
+
+    def set_status(
+        self, doc_id: str, status: str, n_chunks: Optional[int] = None
+    ) -> None:
+        with self._lock:
+            if n_chunks is None:
+                self._conn.execute(
+                    "UPDATE documents SET status=? WHERE doc_id=?",
+                    (status, doc_id),
+                )
+            else:
+                self._conn.execute(
+                    "UPDATE documents SET status=?, n_chunks=? WHERE doc_id=?",
+                    (status, n_chunks, doc_id),
+                )
+            self._conn.commit()
+
+    def _row_to_record(self, row) -> DocumentRecord:
+        return DocumentRecord(*row)
+
+    def get(self, doc_id: str) -> Optional[DocumentRecord]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT * FROM documents WHERE doc_id=?", (doc_id,)
+            )
+            row = cur.fetchone()
+        return self._row_to_record(row) if row else None
+
+    def list_documents(
+        self,
+        limit: int = 100,
+        patient_id: Optional[str] = None,
+        status: Optional[str] = None,
+    ) -> List[DocumentRecord]:
+        clauses: List[str] = []
+        args: tuple = ()
+        if patient_id is not None:
+            clauses.append("patient_id=?")
+            args += (patient_id,)
+        if status is not None:
+            clauses.append("status=?")
+            args += (status,)
+        where = f"WHERE {' AND '.join(clauses)}" if clauses else ""
+        with self._lock:
+            cur = self._conn.execute(
+                f"SELECT * FROM documents {where} "
+                "ORDER BY upload_date DESC LIMIT ?",
+                args + (limit,),
+            )
+            rows = cur.fetchall()
+        return [self._row_to_record(r) for r in rows]
+
+    def close(self) -> None:
+        self._conn.close()

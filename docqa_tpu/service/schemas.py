@@ -1,0 +1,95 @@
+"""Request/response schemas (pydantic).
+
+Parity with the reference's typed surface:
+``synthese-comparative/models/requests.py:6-21``,
+``models/responses.py:6-38``, and llm-qa's ``Query`` (``llm-qa/main.py:108-109``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pydantic import BaseModel, Field
+
+
+class Query(BaseModel):
+    question: str
+
+
+class AskResponse(BaseModel):
+    answer: str
+    sources: List[str]
+
+
+class SummarizeRequest(BaseModel):
+    prompt: str
+    max_tokens: Optional[int] = None
+
+
+class SummarizeResponse(BaseModel):
+    summary: str
+
+
+class PatientSummaryRequest(BaseModel):
+    patient_id: str
+    from_date: Optional[str] = None  # ISO yyyy-mm-dd
+    to_date: Optional[str] = None
+    focus: Optional[str] = None
+    language: str = "fr"
+
+
+class PatientComparisonRequest(BaseModel):
+    patient_ids: List[str] = Field(min_length=1)
+    focus: Optional[str] = None
+    language: str = "fr"
+
+
+class SourceSnippet(BaseModel):
+    doc_id: str
+    snippet: str
+
+
+class Section(BaseModel):
+    title: str
+    content: str
+
+
+class SinglePatientSummaryResponse(BaseModel):
+    type: str = "single_patient_summary"
+    patient_id: str
+    sections: List[Section]
+    key_points: List[str]
+    sources: List[SourceSnippet]
+
+
+class ComparisonRow(BaseModel):
+    criterion: str
+    values: dict  # patient_id -> value
+
+
+class MultiPatientComparisonResponse(BaseModel):
+    type: str = "multi_patient_comparison"
+    patient_ids: List[str]
+    summary: str
+    comparison_table: List[ComparisonRow]
+    sources: List[SourceSnippet]
+
+
+class PatientSnippet(BaseModel):
+    doc_id: str
+    text: str
+
+
+class IngestResponse(BaseModel):
+    doc_id: str
+    status: str
+
+
+class DocumentInfo(BaseModel):
+    doc_id: str
+    filename: str
+    upload_date: float
+    status: str
+    doc_type: Optional[str] = None
+    patient_id: Optional[str] = None
+    n_chunks: int = 0

@@ -1,0 +1,174 @@
+"""Patient synthesis / comparison service.
+
+Replaces ``synthese-comparative`` (``api/routes.py:27-141``) with real
+backends: retrieval hits the live store (the reference's real mode called a
+nonexistent endpoint and its fake mode returned two hardcoded snippets,
+``core/retrieval_client.py:31-54``) and summarization runs on-device
+(the reference's fake kept the prompt's last 1200 chars,
+``core/llm_client.py:26-30``).
+
+The dual-mode *client* pattern is preserved — retrieval and LLM are
+injectable and can be swapped for HTTP clients (multi-host deployment) or
+fakes (tests) — but the flags are constructor arguments, not read-at-import
+env (the reference's own tests fought that, ``test_llm_client.py:45-47``).
+
+The comparison table is computed, not the reference's hardcoded placeholder
+(``routes.py:124-130``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from docqa_tpu.service.schemas import (
+    ComparisonRow,
+    MultiPatientComparisonResponse,
+    Section,
+    SinglePatientSummaryResponse,
+    SourceSnippet,
+)
+
+
+class SynthesisError(Exception):
+    def __init__(self, status: int, detail: str):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+_SECTION_TITLES = (
+    "Contexte clinique",
+    "Éléments marquants",
+    "Évolution",
+    "Points de vigilance",
+)
+
+
+def _split_sections(summary: str) -> List[Section]:
+    """Best-effort split of the generated summary on the four requested
+    headings; falls back to one section (the reference always returned one,
+    ``routes.py:62-66``)."""
+    marks: List[Tuple[int, str]] = []
+    low = summary.lower()
+    for title in _SECTION_TITLES:
+        i = low.find(title.lower())
+        if i >= 0:
+            marks.append((i, title))
+    marks.sort()
+    if len(marks) < 2:
+        return [Section(title="Synthèse", content=summary.strip())]
+    out = []
+    for j, (i, title) in enumerate(marks):
+        end = marks[j + 1][0] if j + 1 < len(marks) else len(summary)
+        content = summary[i + len(title) : end].strip(" :\n-—")
+        out.append(Section(title=title, content=content))
+    return out
+
+
+def _key_points(docs: Sequence[Dict[str, str]], limit: int = 5) -> List[str]:
+    """Extract short factual lines (scores, measurements, dated events) from
+    the retrieved snippets — the reference left this as a TODO
+    (``routes.py:66``)."""
+    import re
+
+    points: List[str] = []
+    seen = set()
+    pattern = re.compile(
+        r"[^.\n]*(?:\d+[.,]?\d*\s*(?:%|mg|ml|mmhg|°c|kg)|score\s*[:=]?\s*\d|"
+        r"\d{4}-\d{2}-\d{2})[^.\n]*",
+        re.IGNORECASE,
+    )
+    for d in docs:
+        for m in pattern.finditer(d.get("text", "")):
+            line = m.group().strip()
+            if 10 < len(line) < 200 and line.lower() not in seen:
+                seen.add(line.lower())
+                points.append(line)
+            if len(points) >= limit:
+                return points
+    return points
+
+
+class SynthesisService:
+    def __init__(self, retrieval, summarizer) -> None:
+        """``retrieval``: callable(patient_id, from_date, to_date, focus) →
+        [{doc_id, text}] (QAService.patient_snippets or an HTTP client).
+        ``summarizer``: SummarizeEngine or a compatible fake."""
+        self.retrieval = retrieval
+        self.summarizer = summarizer
+
+    # ---- POST /api/synthese/patient -----------------------------------------
+
+    def patient_summary(
+        self,
+        patient_id: str,
+        from_date: Optional[str] = None,
+        to_date: Optional[str] = None,
+        focus: Optional[str] = None,
+    ) -> SinglePatientSummaryResponse:
+        docs = self.retrieval(patient_id, from_date, to_date, focus)
+        if not docs:
+            raise SynthesisError(
+                404, f"no documents found for patient {patient_id}"
+            )  # parity: routes.py:41-42
+        summary = self.summarizer.summarize_patient(
+            patient_id, [(d["doc_id"], d["text"]) for d in docs]
+        )
+        return SinglePatientSummaryResponse(
+            patient_id=patient_id,
+            sections=_split_sections(summary),
+            key_points=_key_points(docs),
+            sources=[
+                SourceSnippet(doc_id=d["doc_id"], snippet=d["text"][:300])
+                for d in docs[:5]  # parity: routes.py:67-73
+            ],
+        )
+
+    # ---- POST /api/synthese/comparaison -------------------------------------
+
+    def patient_comparison(
+        self,
+        patient_ids: Sequence[str],
+        focus: Optional[str] = None,
+    ) -> MultiPatientComparisonResponse:
+        if len(patient_ids) < 2:
+            raise SynthesisError(
+                400, "at least two patient_ids are required"
+            )  # parity: routes.py:84-85
+        per_patient: List[Tuple[str, List[Dict[str, str]]]] = []
+        for pid in patient_ids:
+            docs = self.retrieval(pid, None, None, focus)
+            per_patient.append((pid, docs[:3]))  # parity: 3 per patient
+        if all(not docs for _, docs in per_patient):
+            raise SynthesisError(404, "no documents found for any patient")
+        summary = self.summarizer.compare_patients(
+            [
+                (pid, [(d["doc_id"], d["text"]) for d in docs])
+                for pid, docs in per_patient
+            ]
+        )
+        table = [
+            ComparisonRow(
+                criterion="documents_retrieved",
+                values={pid: len(docs) for pid, docs in per_patient},
+            ),
+            ComparisonRow(
+                criterion="key_points",
+                values={
+                    pid: "; ".join(_key_points(docs, 3)) or "—"
+                    for pid, docs in per_patient
+                },
+            ),
+        ]
+        sources: List[SourceSnippet] = []
+        for pid, docs in per_patient:
+            sources.extend(
+                SourceSnippet(doc_id=d["doc_id"], snippet=d["text"][:300])
+                for d in docs
+            )
+        return MultiPatientComparisonResponse(
+            patient_ids=list(patient_ids),
+            summary=summary,
+            comparison_table=table,
+            sources=sources[:10],  # parity: routes.py:138
+        )

@@ -1,0 +1,225 @@
+"""End-to-end pipeline + service integration tests (SURVEY §4 lesson 3:
+the integration coverage the reference never had).
+
+Everything runs in-process on the CPU backend with tiny model configs and
+fake-LLM mode where generation content doesn't matter; the *pipeline* —
+upload → extract → deid → chunk → encode → index → retrieve → respond —
+is the real code path.
+"""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import load_config
+from docqa_tpu.service.app import DocQARuntime
+from docqa_tpu.service import registry as reg
+
+TINY = {
+    "encoder.hidden_dim": 64,
+    "encoder.num_layers": 1,
+    "encoder.num_heads": 4,
+    "encoder.mlp_dim": 128,
+    "encoder.embed_dim": 64,
+    "store.dim": 64,
+    "store.shard_capacity": 256,
+    "ner.hidden_dim": 32,
+    "ner.num_layers": 1,
+    "ner.num_heads": 2,
+    "ner.mlp_dim": 64,
+    "decoder.hidden_dim": 64,
+    "decoder.num_layers": 2,
+    "decoder.num_heads": 4,
+    "decoder.num_kv_heads": 2,
+    "decoder.head_dim": 16,
+    "decoder.mlp_dim": 128,
+    "decoder.vocab_size": 512,
+    "decoder.max_seq_len": 512,
+    "generate.max_new_tokens": 8,
+    "flags.use_fake_llm": True,
+}
+
+
+@pytest.fixture(scope="module")
+def rt():
+    cfg = load_config(env={}, overrides=TINY)
+    runtime = DocQARuntime(cfg).start()
+    yield runtime
+    runtime.stop()
+
+
+NOTE_A = (
+    "Patient admitted on 2024-03-05 with hypertension. BP 150/95 mmHg. "
+    "Contact: dr.smith@hospital.org, phone 555-123-4567. "
+    "Treatment plan includes lisinopril 10 mg daily. Follow-up scheduled."
+)
+NOTE_B = (
+    "Consultation note: diabetic patient, HbA1c 8.2 %. Metformin 500 mg "
+    "twice daily. Diet counselling provided. Next visit 2024-04-10."
+)
+
+
+class TestPipelineE2E:
+    def test_ingest_to_indexed(self, rt):
+        rec = rt.pipeline.ingest_document(
+            "note_a.txt", NOTE_A.encode(), doc_type="consult", patient_id="p1"
+        )
+        assert rec.status == reg.PROCESSED
+        assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        final = rt.registry.get(rec.doc_id)
+        assert final.status == reg.INDEXED and final.n_chunks >= 1
+        assert rt.store.count >= 1
+
+    def test_indexed_content_is_deidentified(self, rt):
+        rows = rt.store.metadata_rows()
+        joined = " ".join(r["text_content"] for r in rows)
+        assert "dr.smith@hospital.org" not in joined
+        assert "555-123-4567" not in joined
+        assert "<EMAIL_ADDRESS>" in joined or "<PHONE_NUMBER>" in joined
+
+    def test_ask_returns_answer_and_sources(self, rt):
+        rec = rt.pipeline.ingest_document(
+            "note_b.txt", NOTE_B.encode(), doc_type="consult", patient_id="p2"
+        )
+        assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        out = rt.qa.ask("What is the metformin dose?")
+        assert set(out) == {"answer", "sources"}
+        assert isinstance(out["answer"], str) and out["answer"]
+        assert out["sources"]
+
+    def test_patient_snippets_filtering(self, rt):
+        rows = rt.qa.patient_snippets("p1")
+        assert rows and all("doc_id" in r and "text" in r for r in rows)
+        assert not rt.qa.patient_snippets("nobody")
+
+    def test_extraction_failure_status(self, rt):
+        rec = rt.pipeline.ingest_document("broken.pdf", b"\x00\x01junk")
+        assert rec.status == reg.ERROR_EXTRACTION
+
+    def test_synthesis_patient_summary(self, rt):
+        resp = rt.synthesis.patient_summary("p1")
+        assert resp.patient_id == "p1"
+        assert resp.sections and resp.sources
+        data = resp.model_dump()
+        assert data["type"] == "single_patient_summary"
+
+    def test_synthesis_404_unknown_patient(self, rt):
+        from docqa_tpu.service.synthesis import SynthesisError
+
+        with pytest.raises(SynthesisError) as e:
+            rt.synthesis.patient_summary("ghost")
+        assert e.value.status == 404
+
+    def test_synthesis_comparison(self, rt):
+        resp = rt.synthesis.patient_comparison(["p1", "p2"])
+        assert resp.summary
+        assert any(
+            row.criterion == "documents_retrieved"
+            for row in resp.comparison_table
+        )
+        assert len(resp.sources) <= 10
+
+    def test_comparison_requires_two(self, rt):
+        from docqa_tpu.service.synthesis import SynthesisError
+
+        with pytest.raises(SynthesisError) as e:
+            rt.synthesis.patient_comparison(["p1"])
+        assert e.value.status == 400
+
+
+class TestBootstrap:
+    def test_csv_bootstrap(self, rt, tmp_path):
+        csv_path = tmp_path / "matrice_test.csv"
+        csv_path.write_text(
+            "nom_syndrome,nom_latin,nom_chinois,score_role\n"
+            "Vide de Qi,Astragalus membranaceus,Huang Qi,9\n"
+            "Vide de Qi,Panax ginseng,Ren Shen,8\n"
+        )
+        from docqa_tpu.service.bootstrap import bootstrap_csv_dir
+
+        before = rt.store.count
+        n = bootstrap_csv_dir(str(tmp_path), rt.encoder, rt.store)
+        assert n == 2 and rt.store.count == before + 2
+        rows = rt.store.metadata_rows()
+        kb = [r for r in rows if r.get("type") == "knowledge_base"]
+        assert "score de 9" in kb[0]["text_content"]
+
+
+class TestHTTPSurface:
+    @pytest.fixture()
+    def client(self, rt, event_loop=None):
+        pytest.importorskip("aiohttp")
+        return rt
+
+    def test_http_roundtrip(self, rt):
+        """Full HTTP contract over a real server socket."""
+        import asyncio
+
+        import aiohttp
+        from aiohttp import web
+
+        from docqa_tpu.service.app import make_app
+
+        async def run():
+            app = make_app(rt)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            base = f"http://127.0.0.1:{port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/health") as r:
+                    assert r.status == 200
+                    assert (await r.json())["status"] == "ok"
+                async with s.post(
+                    f"{base}/ingest/?wait=1",
+                    json={
+                        "filename": "http_note.txt",
+                        "text": "Aspirin 100 mg daily for patient p9. BP 130/85 mmHg.",
+                        "patient_id": "p9",
+                    },
+                ) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                    assert body["status"] == "INDEXED"
+                async with s.post(
+                    f"{base}/ask/", json={"question": "aspirin dose?"}
+                ) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                    assert "answer" in body and "sources" in body
+                async with s.get(
+                    f"{base}/api/search/patient-snippets",
+                    params={"patient_id": "p9"},
+                ) as r:
+                    assert r.status == 200
+                    assert await r.json()
+                async with s.post(
+                    f"{base}/api/llm/summarize",
+                    json={"prompt": "Summarize: patient stable."},
+                ) as r:
+                    assert r.status == 200
+                    assert (await r.json())["summary"]
+                async with s.post(
+                    f"{base}/api/synthese/patient",
+                    json={"patient_id": "p9"},
+                ) as r:
+                    assert r.status == 200
+                    assert (await r.json())["sections"]
+                async with s.post(
+                    f"{base}/api/synthese/comparaison",
+                    json={"patient_ids": ["p9"]},
+                ) as r:
+                    assert r.status == 400
+                async with s.get(f"{base}/api/status") as r:
+                    body = await r.json()
+                    assert body["indexed_vectors"] >= 1
+                async with s.get(f"{base}/documents/") as r:
+                    assert r.status == 200
+                    docs = await r.json()
+                    assert any(d["filename"] == "http_note.txt" for d in docs)
+                async with s.get(f"{base}/metrics") as r:
+                    assert r.status == 200
+            await runner.cleanup()
+
+        asyncio.run(run())

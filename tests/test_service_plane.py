@@ -1,0 +1,284 @@
+"""Service-plane tests: broker semantics, registry, extraction, chunking.
+
+Models the reference's unit-test strategy (SURVEY §4) but without its
+``sys.modules`` surgery — everything here is injectable by construction.
+"""
+
+import io
+import threading
+import time
+import zipfile
+import zlib
+
+import pytest
+
+from docqa_tpu.config import BrokerConfig, ChunkConfig
+from docqa_tpu.service.broker import Consumer, MemoryBroker
+from docqa_tpu.service.extract import (
+    extract_docx,
+    extract_pdf,
+    extract_text,
+    extract_txt,
+)
+from docqa_tpu.service.registry import (
+    DocumentRegistry,
+    INDEXED,
+    PENDING,
+    PROCESSED,
+)
+from docqa_tpu.text.chunker import chunk_text
+
+
+# ---- broker ----------------------------------------------------------------
+
+class TestBroker:
+    def test_publish_get_ack(self):
+        b = MemoryBroker()
+        b.publish("q", {"x": 1})
+        d = b.get("q", timeout=1)
+        assert d.body == {"x": 1} and d.attempts == 1
+        b.ack(d)
+        assert b.depth("q") == 0 and b.in_flight("q") == 0
+
+    def test_nack_requeues_then_dead_letters(self):
+        b = MemoryBroker(BrokerConfig(max_redelivery=2))
+        b.publish("q", {"poison": True})
+        d1 = b.get("q", timeout=1)
+        b.nack(d1)  # attempt 1 -> requeue
+        d2 = b.get("q", timeout=1)
+        assert d2.attempts == 2
+        b.nack(d2)  # attempt 2 == max -> DLQ (reference dropped these)
+        assert b.get("q") is None
+        assert b.dead_letters("q") == [{"poison": True}]
+
+    def test_get_many_batches(self):
+        b = MemoryBroker(BrokerConfig(prefetch=8))
+        for i in range(5):
+            b.publish("q", {"i": i})
+        ds = b.get_many("q", timeout=1)
+        assert [d.body["i"] for d in ds] == [0, 1, 2, 3, 4]
+        for d in ds:
+            b.ack(d)
+
+    def test_blocking_get_wakes_on_publish(self):
+        b = MemoryBroker()
+        got = []
+
+        def consume():
+            got.append(b.get("q", timeout=5))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.05)
+        b.publish("q", {"late": 1})
+        t.join(timeout=5)
+        assert got and got[0].body == {"late": 1}
+
+    def test_journal_replay_restores_unacked(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        b = MemoryBroker(journal_dir=jd)
+        b.publish("q", {"a": 1})
+        b.publish("q", {"a": 2})
+        d = b.get("q", timeout=1)
+        b.ack(d)  # a=1 acked; a=2 never consumed
+        b.close()  # simulated crash after this point
+        b2 = MemoryBroker(journal_dir=jd)
+        d2 = b2.get("q", timeout=1)
+        assert d2.body == {"a": 2}
+        assert b2.get("q") is None
+
+    def test_consumer_thread_processes_and_acks(self):
+        b = MemoryBroker()
+        seen = []
+        c = Consumer(b, "q", lambda bodies: seen.extend(bodies), poll_s=0.01)
+        c.start()
+        for i in range(4):
+            b.publish("q", {"i": i})
+        assert b.drain("q", timeout=5)
+        c.stop()
+        assert sorted(s["i"] for s in seen) == [0, 1, 2, 3]
+
+    def test_consumer_handler_error_dead_letters(self):
+        b = MemoryBroker(BrokerConfig(max_redelivery=2))
+
+        def boom(bodies):
+            raise RuntimeError("bad message")
+
+        c = Consumer(b, "q", boom, poll_s=0.01)
+        c.start()
+        b.publish("q", {"i": 0})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not b.dead_letters("q"):
+            time.sleep(0.01)
+        c.stop()
+        assert b.dead_letters("q") == [{"i": 0}]
+
+
+# ---- registry --------------------------------------------------------------
+
+class TestRegistry:
+    def test_create_and_status_flow(self):
+        r = DocumentRegistry()
+        rec = r.create("note.pdf", doc_type="consult", patient_id="p1")
+        assert rec.status == PENDING
+        r.set_status(rec.doc_id, PROCESSED)
+        r.set_status(rec.doc_id, INDEXED, n_chunks=7)
+        got = r.get(rec.doc_id)
+        assert got.status == INDEXED and got.n_chunks == 7
+
+    def test_list_filters(self):
+        r = DocumentRegistry()
+        a = r.create("a.txt", patient_id="p1")
+        r.create("b.txt", patient_id="p2")
+        r.set_status(a.doc_id, INDEXED)
+        assert len(r.list_documents()) == 2
+        assert [d.doc_id for d in r.list_documents(patient_id="p1")] == [a.doc_id]
+        assert [d.doc_id for d in r.list_documents(status=INDEXED)] == [a.doc_id]
+
+    def test_disk_persistence(self, tmp_path):
+        url = f"sqlite:///{tmp_path}/reg.db"
+        r = DocumentRegistry(url)
+        rec = r.create("x.txt")
+        r.close()
+        r2 = DocumentRegistry(url)
+        assert r2.get(rec.doc_id).filename == "x.txt"
+
+
+# ---- extraction ------------------------------------------------------------
+
+def _make_docx(paragraphs):
+    xml = (
+        b'<?xml version="1.0"?><w:document><w:body>'
+        + b"".join(
+            b"<w:p><w:r><w:t>" + p.encode() + b"</w:t></w:r></w:p>"
+            for p in paragraphs
+        )
+        + b"</w:body></w:document>"
+    )
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("word/document.xml", xml)
+    return buf.getvalue()
+
+
+def _make_pdf(lines):
+    content = b"BT /F1 12 Tf " + b" ".join(
+        b"(" + ln.encode() + b") Tj T*" for ln in lines
+    ) + b" ET"
+    stream = zlib.compress(content)
+    return (
+        b"%PDF-1.4\n1 0 obj\n<< /Length "
+        + str(len(stream)).encode()
+        + b" /Filter /FlateDecode >>\nstream\n"
+        + stream
+        + b"endstream\nendobj\ntrailer\n%%EOF"
+    )
+
+
+class TestExtract:
+    def test_txt_encodings(self):
+        assert extract_txt("héllo".encode("utf-8")) == "héllo"
+        assert extract_txt("héllo".encode("utf-16")) == "héllo"
+
+    def test_docx(self):
+        data = _make_docx(["Patient: John Doe", "Diagnosis & plan"])
+        text = extract_docx(data)
+        assert "Patient: John Doe" in text
+        assert "Diagnosis & plan" in text  # entity unescaped
+
+    def test_pdf_flate(self):
+        data = _make_pdf(["Clinical report", "BP 120/80"])
+        text = extract_pdf(data)
+        assert "Clinical report" in text and "BP 120/80" in text
+
+    def test_dispatch_and_failure_none(self):
+        assert extract_text(b"plain words", "note.txt") == "plain words"
+        assert extract_text(b"\x00\x01garbage", "scan.pdf") is None
+
+    def test_docx_rejects_garbage(self):
+        assert extract_docx(b"not a zip") is None
+
+
+# ---- chunking --------------------------------------------------------------
+
+class TestChunker:
+    def test_reference_budget(self):
+        text = "x" * 1200
+        chunks = chunk_text(text, ChunkConfig(chunk_chars=500))
+        # no boundaries to snap to -> exact 500-char slices like indexer.py:120
+        assert [len(c.text) for c in chunks] == [500, 500, 200]
+        assert chunks[1].start == 500
+
+    def test_sentence_snap(self):
+        text = ("A sentence here. " * 40).strip()
+        chunks = chunk_text(text, ChunkConfig(chunk_chars=500))
+        for c in chunks[:-1]:
+            assert c.text.rstrip().endswith(".")
+
+    def test_overlap(self):
+        text = "word " * 300
+        chunks = chunk_text(text, ChunkConfig(chunk_chars=200, overlap_chars=50))
+        assert chunks[1].start < chunks[0].end
+
+    def test_offsets_reconstruct(self):
+        text = "Sentence one. Sentence two is longer. Three." * 30
+        chunks = chunk_text(text, ChunkConfig(chunk_chars=100))
+        for c in chunks:
+            assert text[c.start : c.end] == c.text
+
+
+class TestReviewRegressions:
+    """Fixes from the service-plane review."""
+
+    def test_poison_isolation_in_batch(self):
+        # one poison message must not drag batch-mates into the DLQ
+        b = MemoryBroker(BrokerConfig(prefetch=8, max_redelivery=2, retry_backoff_s=0.01))
+        good = []
+
+        def handler(bodies):
+            if any(x.get("poison") for x in bodies):
+                raise RuntimeError("poison")
+            good.extend(bodies)
+
+        c = Consumer(b, "q", handler, poll_s=0.01)
+        c.start()
+        b.publish("q", {"i": 0})
+        b.publish("q", {"poison": True})
+        b.publish("q", {"i": 2})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not b.dead_letters("q"):
+            time.sleep(0.01)
+        b.drain("q", timeout=5)
+        c.stop()
+        assert b.dead_letters("q") == [{"poison": True}]
+        assert sorted(g["i"] for g in good) == [0, 2]
+
+    def test_on_dead_callback_fires(self):
+        b = MemoryBroker(BrokerConfig(max_redelivery=1, retry_backoff_s=0.01))
+        dead = []
+
+        def boom(bodies):
+            raise RuntimeError("always")
+
+        c = Consumer(b, "q", boom, poll_s=0.01, on_dead=dead.append)
+        c.start()
+        b.publish("q", {"doc_id": "d1"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not dead:
+            time.sleep(0.01)
+        c.stop()
+        assert dead == [{"doc_id": "d1"}]
+
+    def test_retry_backoff_delays_redelivery(self):
+        b = MemoryBroker(BrokerConfig(max_redelivery=3, retry_backoff_s=0.2))
+        b.publish("q", {"x": 1})
+        d = b.get("q", timeout=1)
+        b.nack(d)
+        # immediately after the nack the message is backed off, not ready
+        assert b.get("q", timeout=0.02) is None
+        d2 = b.get("q", timeout=2)
+        assert d2 is not None and d2.attempts == 2
+
+    def test_extract_txt_rejects_binary(self):
+        assert extract_txt(bytes(range(256)) * 4) is None
+        assert extract_txt("normal réport\n".encode("utf-8")) == "normal réport"

@@ -1,0 +1,62 @@
+"""Summarizer engine tests: packing fairness, fake parity, real decode."""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import DecoderConfig, SummarizerConfig
+from docqa_tpu.engines.generate import GenerateEngine
+from docqa_tpu.engines.summarize import SummarizeEngine
+
+CFG = DecoderConfig(
+    vocab_size=256,
+    hidden_dim=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    mlp_dim=128,
+    max_seq_len=1024,
+)
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return GenerateEngine(CFG)
+
+
+def test_fake_mode_reference_parity(gen):
+    # reference fake kept the LAST 1200 chars (llm_client.py:26-30)
+    s = SummarizeEngine(gen, use_fake=True)
+    prompt = "x" * 2000 + "TAIL"
+    out = s.summarize_prompt(prompt)
+    assert out.endswith("TAIL") and len(out) == 1200
+
+
+def test_packing_keeps_every_document(gen):
+    s = SummarizeEngine(gen, SummarizerConfig(max_input_tokens=200))
+    docs = [(f"doc{i}", f"unique{i} " + "filler " * 300) for i in range(4)]
+    packed = s._pack_documents(docs, 200)
+    for i in range(4):
+        assert f"[doc{i}]" in packed  # no doc silently dropped
+        assert f"unique{i}" in packed
+
+
+def test_packing_respects_max_chunks(gen):
+    s = SummarizeEngine(gen, SummarizerConfig(max_chunks=2))
+    docs = [(f"d{i}", "text") for i in range(5)]
+    packed = s._pack_documents(docs, 1000)
+    assert "[d0]" in packed and "[d1]" in packed and "[d2]" not in packed
+
+
+def test_real_summarize_decodes(gen):
+    s = SummarizeEngine(gen, SummarizerConfig(max_summary_tokens=8))
+    out = s.summarize_patient("p1", [("d1", "Patient stable. BP normal.")])
+    assert isinstance(out, str) and out
+
+
+def test_compare_patients_blocks(gen):
+    s = SummarizeEngine(gen, use_fake=True, fake_max_chars=100_000)
+    out = s.compare_patients(
+        [("pA", [("d1", "alpha")]), ("pB", [("d2", "beta")])]
+    )
+    assert "=== PATIENT pA ===" in out and "=== PATIENT pB ===" in out
