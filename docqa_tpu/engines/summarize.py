@@ -58,25 +58,40 @@ class SummarizeEngine:
     ) -> str:
         """[(doc_id, text)] → one prompt block within the token budget.
 
-        Per-doc budget is proportional to doc length with a floor, so every
-        document is represented."""
+        Water-filling allocation: shortest documents first, each taking
+        ``min(its length, fair share of what remains)`` — so every document
+        is represented, short ones are never trimmed, and the packed total is
+        GUARANTEED ≤ ``budget_tokens`` (an overflow here would push the
+        instruction template out of the decoder window, since the generator
+        keeps the prompt *tail* on overflow)."""
         docs = list(docs)[: self.cfg.max_chunks]
         if not docs:
             return ""
         tok = self.generator.tokenizer
         lengths = [max(1, len(tok.encode(t, add_specials=False))) for _, t in docs]
-        total = sum(lengths)
-        floor = max(16, budget_tokens // (4 * len(docs)))
+        shares = [0] * len(docs)
+        remaining = budget_tokens
+        order = sorted(range(len(docs)), key=lambda i: lengths[i])
+        for pos, i in enumerate(order):
+            fair = remaining // (len(docs) - pos)
+            shares[i] = min(lengths[i], fair)
+            remaining -= shares[i]
         blocks: List[str] = []
-        for (doc_id, text), n_tok in zip(docs, lengths):
-            share = max(floor, int(budget_tokens * n_tok / max(total, 1)))
+        for (doc_id, text), n_tok, share in zip(docs, lengths, shares):
             if n_tok > share:
-                # trim at a word boundary near the proportional char budget
-                approx_chars = int(len(text) * share / n_tok)
+                # trim at a word boundary; 0.95 margin absorbs char→token
+                # ratio drift in the trimmed slice
+                approx_chars = int(len(text) * 0.95 * share / n_tok)
                 cut = text.rfind(" ", 0, approx_chars)
                 text = text[: cut if cut > 0 else approx_chars] + " …"
             blocks.append(f"[{doc_id}]\n{text}")
         return "\n\n".join(blocks)
+
+    def _doc_budget(self, template: str, overhead_chars: int = 64) -> int:
+        """Token budget left for documents after the instruction template."""
+        tok = self.generator.tokenizer
+        t_tok = len(tok.encode(template, add_specials=False))
+        return max(256, self.cfg.max_input_tokens - t_tok - overhead_chars)
 
     # ---- API -----------------------------------------------------------------
 
@@ -99,7 +114,9 @@ class SummarizeEngine:
         docs: Sequence[Tuple[str, str]],
         max_tokens: Optional[int] = None,
     ) -> str:
-        body = self._pack_documents(docs, self.cfg.max_input_tokens)
+        body = self._pack_documents(
+            docs, self._doc_budget(SINGLE_PATIENT_TEMPLATE)
+        )
         prompt = SINGLE_PATIENT_TEMPLATE.format(
             patient_id=patient_id, documents=body
         )
@@ -114,7 +131,7 @@ class SummarizeEngine:
         Block format mirrors the reference's ``=== PATIENT_x ===`` assembly
         (``routes.py:91-101``)."""
         n = max(1, len(patient_docs))
-        per_patient = self.cfg.max_input_tokens // n
+        per_patient = self._doc_budget(MULTI_PATIENT_TEMPLATE) // n
         sections = []
         for pid, docs in patient_docs:
             body = self._pack_documents(docs, per_patient)

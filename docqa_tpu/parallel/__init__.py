@@ -1,3 +1,8 @@
+from docqa_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+    ulysses_attention,
+)
 from docqa_tpu.parallel.sharding import (
     cache_pspecs,
     decoder_param_pspecs,
@@ -10,4 +15,7 @@ __all__ = [
     "cache_pspecs",
     "shard_decoder_params",
     "shard_kv_cache",
+    "ring_attention",
+    "ring_attention_local",
+    "ulysses_attention",
 ]

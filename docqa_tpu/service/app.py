@@ -115,8 +115,12 @@ class DocQARuntime:
 
 
 # ---------------------------------------------------------------------------
-# HTTP layer (aiohttp; device work runs on a single executor thread so decode
-# programs are never dispatched concurrently)
+# HTTP layer (aiohttp).  HTTP-initiated device work funnels through one
+# executor thread so concurrent /ask requests queue instead of interleaving
+# decode dispatches (pipeline consumer threads still dispatch their own batch
+# programs — JAX dispatch is thread-safe; this is a latency policy, not a
+# correctness requirement).  Host-only work (extraction, registry IO) runs on
+# a separate pool so uploads don't block QA.
 # ---------------------------------------------------------------------------
 
 def make_app(rt: DocQARuntime):
@@ -125,12 +129,22 @@ def make_app(rt: DocQARuntime):
     device_pool = concurrent.futures.ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="device"
     )
+    host_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=4, thread_name_prefix="host"
+    )
 
     async def on_device(fn, *args, **kw):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             device_pool, lambda: fn(*args, **kw)
         )
+
+    async def on_host(fn, *args, **kw):
+        """Host-only work (extraction, registry/journal IO) — keeps large
+        uploads from head-of-line-blocking /ask and /summarize behind the
+        single device executor."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(host_pool, lambda: fn(*args, **kw))
 
     def json_error(status: int, detail: str):
         return web.json_response({"detail": detail}, status=status)
@@ -192,7 +206,7 @@ def make_app(rt: DocQARuntime):
             doc_date = body.get("doc_date")
         if not data:
             return json_error(400, "no file/text provided")
-        record = await on_device(
+        record = await on_host(
             rt.pipeline.ingest_document,
             filename,
             data,

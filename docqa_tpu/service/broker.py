@@ -97,7 +97,7 @@ class MemoryBroker:
                 continue
             queue = name[: -len(".jsonl")]
             alive: Dict[int, Dict[str, Any]] = {}
-            dead: List[Dict[str, Any]] = []
+            dead: List[tuple] = []  # (tag, body) — tags kept so compaction can re-journal them
             with open(os.path.join(self._journal_dir, name), encoding="utf-8") as f:
                 for line in f:
                     line = line.strip()
@@ -111,17 +111,23 @@ class MemoryBroker:
                     elif rec["op"] == "dlq":
                         body = alive.pop(rec["tag"], None)
                         if body is not None:
-                            dead.append(body)
+                            dead.append((rec["tag"], body))
             q = self._queues.setdefault(queue, _Queue())
-            q.dead.extend(dead)
-            # compact: rewrite only the still-alive publications
+            q.dead.extend(body for _, body in dead)
+            # compact: rewrite still-alive publications AND dead letters (as
+            # pub+dlq pairs) — dead letters must survive any number of restarts
             tmp = self._journal_path(queue) + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 for tag, body in alive.items():
                     f.write(json.dumps({"op": "pub", "tag": tag, "body": body}) + "\n")
+                for tag, body in dead:
+                    f.write(json.dumps({"op": "pub", "tag": tag, "body": body}) + "\n")
+                    f.write(json.dumps({"op": "dlq", "tag": tag}) + "\n")
             os.replace(tmp, self._journal_path(queue))
             for tag, body in alive.items():
                 q.pending.append((tag, body, 0, 0.0))
+                self._next_tag = max(self._next_tag, tag + 1)
+            for tag, _ in dead:
                 self._next_tag = max(self._next_tag, tag + 1)
             if alive or dead:
                 log.info(
@@ -258,9 +264,18 @@ class Consumer(threading.Thread):
     """Pull-loop worker: batches messages to a handler, acks on success.
 
     On a batch failure the messages are retried *individually*, so one
-    poison message cannot drag its batch-mates into the DLQ with it.  When a
-    message is finally dead-lettered, ``on_dead`` fires so the owner can
-    record a terminal error status.  Replaces the reference's per-service
+    poison message cannot drag its batch-mates into the DLQ with it.
+
+    Handler contract (what makes that retry safe): a handler that RAISES must
+    have produced no external side effects for any message in the batch —
+    i.e. do all fallible pure work (device batches, parsing) first, and once
+    side effects (publishes, store appends, status writes) begin, handle
+    per-message failures internally (record a terminal status) instead of
+    raising.  Otherwise the individual retry would replay the already
+    side-effected prefix (duplicate publishes / duplicate vectors).
+
+    When a message is finally dead-lettered, ``on_dead`` fires so the owner
+    can record a terminal error status.  Replaces the reference's per-service
     ``start_consuming`` loops with their reconnect boilerplate
     (``anonymizer.py:89-110``)."""
 

@@ -87,17 +87,46 @@ _TEXT_OP_RE = re.compile(
 _PDF_STR_RE = re.compile(rb"\((?:[^()\\]|\\.)*\)")
 
 _PDF_ESCAPES = {
-    b"\\n": b"\n", b"\\r": b"\r", b"\\t": b"\t",
-    b"\\(": b"(", b"\\)": b")", b"\\\\": b"\\",
+    ord("n"): b"\n", ord("r"): b"\r", ord("t"): b"\t",
+    ord("b"): b"\b", ord("f"): b"\f",
+    ord("("): b"(", ord(")"): b")", ord("\\"): b"\\",
 }
 
 
 def _decode_pdf_string(raw: bytes) -> bytes:
-    out = raw[1:-1]  # strip parens
-    for esc, ch in _PDF_ESCAPES.items():
-        out = out.replace(esc, ch)
-    out = re.sub(rb"\\(\d{1,3})", lambda m: bytes([int(m.group(1), 8) & 0xFF]), out)
-    return out
+    """PDF literal-string unescape via a single left-to-right scan (sequential
+    ``replace`` calls mis-decode sequences like ``\\\\n`` — an escaped
+    backslash followed by a literal 'n' — because a later pattern can consume
+    the output of an earlier one)."""
+    src = raw[1:-1]  # strip parens
+    out = bytearray()
+    i = 0
+    while i < len(src):
+        c = src[i]
+        if c != 0x5C:  # backslash
+            out.append(c)
+            i += 1
+            continue
+        if i + 1 >= len(src):
+            break
+        nxt = src[i + 1]
+        if nxt in _PDF_ESCAPES:
+            out += _PDF_ESCAPES[nxt]
+            i += 2
+        elif 0x30 <= nxt <= 0x37:  # \ddd octal, 1-3 digits
+            j = i + 1
+            while j < min(i + 4, len(src)) and 0x30 <= src[j] <= 0x37:
+                j += 1
+            out.append(int(src[i + 1 : j], 8) & 0xFF)
+            i = j
+        elif nxt in (0x0A, 0x0D):  # line continuation: \<eol> is elided
+            i += 2
+            if nxt == 0x0D and i < len(src) and src[i] == 0x0A:
+                i += 1
+        else:  # unknown escape: PDF spec says drop the backslash
+            out.append(nxt)
+            i += 2
+    return bytes(out)
 
 
 def extract_pdf(data: bytes) -> Optional[str]:

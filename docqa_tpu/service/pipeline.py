@@ -134,23 +134,34 @@ class DocumentPipeline:
     # ---- workers -------------------------------------------------------------
 
     def _deid_handler(self, bodies: List[Dict[str, Any]]) -> None:
+        # Pure phase first — a raise here is side-effect-free, so the
+        # Consumer's one-by-one poison isolation may safely replay the batch.
         texts = [b["text"] for b in bodies]
         with span("deid_batch", DEFAULT_REGISTRY):
             masked = self.deid.deidentify_batch(texts)
+        # Side-effect phase: per-message failures are terminal here, never
+        # re-raised (a raise would make the retry republish the prefix).
         for body, clean in zip(bodies, masked):
-            # status BEFORE publish: once the message is on the clean queue
-            # the index worker may race us to INDEXED, which must not be
-            # overwritten by a late DEIDENTIFIED
-            self.registry.set_status(body["doc_id"], reg.DEIDENTIFIED)
-            self.broker.publish(
-                self.cfg.broker.clean_queue,
-                {
-                    "doc_id": body["doc_id"],
-                    "original_text_masked": clean,
-                    "metadata": body.get("metadata", {}),
-                    "processed_at": time.time(),
-                },
-            )
+            try:
+                # status BEFORE publish: once the message is on the clean queue
+                # the index worker may race us to INDEXED, which must not be
+                # overwritten by a late DEIDENTIFIED
+                self.registry.set_status(body["doc_id"], reg.DEIDENTIFIED)
+                self.broker.publish(
+                    self.cfg.broker.clean_queue,
+                    {
+                        "doc_id": body["doc_id"],
+                        "original_text_masked": clean,
+                        "metadata": body.get("metadata", {}),
+                        "processed_at": time.time(),
+                    },
+                )
+            except Exception:
+                log.exception("clean-queue publish failed for %s", body["doc_id"])
+                try:
+                    self.registry.set_status(body["doc_id"], reg.ERROR_DEID)
+                except Exception:
+                    log.exception("status write failed for %s", body["doc_id"])
 
     def _index_handler(self, bodies: List[Dict[str, Any]]) -> None:
         all_chunks: List[str] = []
@@ -181,10 +192,18 @@ class DocumentPipeline:
                 )
         if all_chunks:
             with span("index_batch", DEFAULT_REGISTRY):
+                # encode is pure; a raise from it (or from store.add, whose
+                # append is all-or-nothing) leaves no partial state, so the
+                # Consumer's individual retry cannot duplicate vectors
                 embeddings = self.encoder.encode_texts(all_chunks)
                 self.store.add(embeddings, all_meta)
+        # vectors are committed past this point: never raise (a retry would
+        # re-encode and re-append the whole batch)
         for doc_id, n in per_doc:
-            self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
+            try:
+                self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
+            except Exception:
+                log.exception("status write failed for %s", doc_id)
 
     # ---- completion signal ---------------------------------------------------
 
