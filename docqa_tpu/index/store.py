@@ -42,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.config import StoreConfig
 from docqa_tpu.ops.topk import sharded_topk
+from docqa_tpu.runtime import native
 from docqa_tpu.runtime.mesh import MeshContext
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 from docqa_tpu.utils import round_up
@@ -289,6 +290,15 @@ class VectorStore:
         with self._lock:
             return list(self._meta[: self._count])
 
+    def vectors_snapshot(self) -> Tuple[np.ndarray, List[Dict[str, Any]]]:
+        """Consistent (vectors, metadata) pair under one lock acquisition —
+        the safe input for offline rebuilds (IVF) while add() runs
+        concurrently."""
+        with self._lock:
+            return self._host[: self._count].copy(), list(
+                self._meta[: self._count]
+            )
+
     # ---- versioned snapshot (checkpoint/resume parity, SURVEY §5) -----------
 
     def snapshot(self, directory: str) -> str:
@@ -304,12 +314,20 @@ class VectorStore:
             meta = list(self._meta)
         base = os.path.join(directory, f"index_v{version}")
         tmp = tempfile.mkdtemp(dir=directory)
-        np.save(os.path.join(tmp, "vectors.npy"), vectors)
+        # checksummed native codec (C++ DNS1 shard, crc32-verified mmap read)
+        # when the library is available; .npy otherwise
+        vec_path = native.write_vectors(os.path.join(tmp, "vectors"), vectors)
         with open(os.path.join(tmp, "metadata.json"), "w") as f:
             json.dump(meta, f)
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(
-                {"version": version, "count": count, "dim": self.cfg.dim}, f
+                {
+                    "version": version,
+                    "count": count,
+                    "dim": self.cfg.dim,
+                    "vectors": os.path.basename(vec_path),
+                },
+                f,
             )
         if os.path.exists(base):  # re-publishing an unchanged version
             import shutil
@@ -334,7 +352,9 @@ class VectorStore:
             base = os.path.join(directory, f.read().strip())
         with open(os.path.join(base, "manifest.json")) as f:
             manifest = json.load(f)
-        vectors = np.load(os.path.join(base, "vectors.npy"))
+        vectors = native.read_vectors(
+            os.path.join(base, manifest.get("vectors", "vectors.npy"))
+        )
         with open(os.path.join(base, "metadata.json")) as f:
             meta = json.load(f)
         store = cls(cfg, mesh=mesh)

@@ -1,0 +1,44 @@
+"""Build the native host library (g++; no cmake needed for one TU).
+
+Usage: python native/build.py  → native/libdocqa_native.so
+The Python loader (docqa_tpu/runtime/native.py) can also invoke this lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "docqa_native.cpp")
+OUT = os.path.join(HERE, "libdocqa_native.so")
+
+
+def build(force: bool = False) -> str:
+    if (
+        not force
+        and os.path.exists(OUT)
+        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+    ):
+        return OUT
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-Wall",
+        "-Werror",
+        SRC,
+        "-o",
+        OUT + ".tmp",
+    ]
+    subprocess.run(cmd, check=True)
+    os.replace(OUT + ".tmp", OUT)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

@@ -1,0 +1,213 @@
+// docqa_native — host-side native runtime for the TPU framework.
+//
+// The reference leaned on external native components for its host plane
+// (FAISS C++ for index serialization: semantic-indexer/indexer.py:26-30,
+// llm-qa/main.py:35; pickle for metadata).  This library is the in-repo
+// equivalent: a checksummed, mmap-readable shard codec for vector-store
+// snapshots plus bf16<->f32 converters used when publishing HBM-resident
+// shards to disk.  Exposed to Python via ctypes (no pybind11 in this image).
+//
+// File format "DNS1" (little-endian):
+//   offset 0   char[4]  magic "DNS1"
+//   offset 4   u32      header_size (=64)
+//   offset 8   u32      dtype (0 = f32, 1 = bf16)
+//   offset 12  u32      dim
+//   offset 16  u64      count (rows)
+//   offset 24  u64      payload_bytes (= count * dim * dtype_size)
+//   offset 32  u32      payload_crc32
+//   offset 36  u32[7]   reserved (zero)
+//   offset 64  payload
+//
+// Error codes (negative): -1 io, -2 bad magic/header, -3 size mismatch,
+// -4 crc mismatch, -5 bad args.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kHeaderSize = 64;
+constexpr char kMagic[4] = {'D', 'N', 'S', '1'};
+
+struct Header {
+  char magic[4];
+  uint32_t header_size;
+  uint32_t dtype;
+  uint32_t dim;
+  uint64_t count;
+  uint64_t payload_bytes;
+  uint32_t payload_crc32;
+  uint32_t reserved[7];
+};
+static_assert(sizeof(Header) == kHeaderSize, "header must be 64 bytes");
+
+uint32_t crc_table[8][256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int s = 1; s < 8; s++)
+      crc_table[s][i] =
+          crc_table[0][crc_table[s - 1][i] & 0xFF] ^ (crc_table[s - 1][i] >> 8);
+  crc_init_done = true;
+}
+
+uint32_t crc32_impl(const uint8_t* buf, size_t len, uint32_t crc = 0) {
+  crc_init();
+  crc = ~crc;
+  // slice-by-8
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    memcpy(&lo, buf, 4);
+    memcpy(&hi, buf + 4, 4);
+    lo ^= crc;
+    crc = crc_table[7][lo & 0xFF] ^ crc_table[6][(lo >> 8) & 0xFF] ^
+          crc_table[5][(lo >> 16) & 0xFF] ^ crc_table[4][lo >> 24] ^
+          crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+          crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+    buf += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc_table[0][(crc ^ *buf++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+size_t dtype_size(uint32_t dtype) { return dtype == 1 ? 2 : 4; }
+
+}  // namespace
+
+extern "C" {
+
+uint32_t dn_crc32(const uint8_t* buf, size_t len) {
+  return crc32_impl(buf, len);
+}
+
+// Write header + payload + fsync.  Caller handles atomic rename.
+int dn_shard_write(const char* path, const void* data, uint64_t count,
+                   uint32_t dim, uint32_t dtype) {
+  if (!path || (!data && count) || dtype > 1 || dim == 0) return -5;
+  const uint64_t payload = count * (uint64_t)dim * dtype_size(dtype);
+  Header h;
+  memset(&h, 0, sizeof(h));
+  memcpy(h.magic, kMagic, 4);
+  h.header_size = kHeaderSize;
+  h.dtype = dtype;
+  h.dim = dim;
+  h.count = count;
+  h.payload_bytes = payload;
+  h.payload_crc32 =
+      payload ? crc32_impl(static_cast<const uint8_t*>(data), payload) : 0;
+
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  bool ok = write(fd, &h, sizeof(h)) == (ssize_t)sizeof(h);
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint64_t left = payload;
+  while (ok && left) {
+    ssize_t n = write(fd, p, left > (1u << 30) ? (1u << 30) : left);
+    if (n <= 0) {
+      if (errno == EINTR) continue;
+      ok = false;
+      break;
+    }
+    p += n;
+    left -= n;
+  }
+  if (ok) ok = fsync(fd) == 0;
+  close(fd);
+  return ok ? 0 : -1;
+}
+
+// Read header fields without touching the payload.
+int dn_shard_info(const char* path, uint32_t* dtype, uint32_t* dim,
+                  uint64_t* count, uint64_t* payload_bytes) {
+  if (!path) return -5;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  Header h;
+  ssize_t n = read(fd, &h, sizeof(h));
+  close(fd);
+  if (n != (ssize_t)sizeof(h)) return -2;
+  if (memcmp(h.magic, kMagic, 4) != 0 || h.header_size != kHeaderSize ||
+      h.dtype > 1 || h.dim == 0)
+    return -2;
+  if (h.payload_bytes != h.count * (uint64_t)h.dim * dtype_size(h.dtype))
+    return -2;
+  if (dtype) *dtype = h.dtype;
+  if (dim) *dim = h.dim;
+  if (count) *count = h.count;
+  if (payload_bytes) *payload_bytes = h.payload_bytes;
+  return 0;
+}
+
+// mmap the file, optionally verify crc, copy payload into out.
+int dn_shard_read(const char* path, void* out, uint64_t out_bytes,
+                  int verify_crc) {
+  if (!path || !out) return -5;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  if ((uint64_t)st.st_size < kHeaderSize) {
+    close(fd);
+    return -2;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) return -1;
+  int rc = 0;
+  const Header* h = static_cast<const Header*>(map);
+  const uint8_t* payload = static_cast<const uint8_t*>(map) + kHeaderSize;
+  if (memcmp(h->magic, kMagic, 4) != 0 || h->header_size != kHeaderSize)
+    rc = -2;
+  else if ((uint64_t)st.st_size != kHeaderSize + h->payload_bytes ||
+           out_bytes != h->payload_bytes)
+    rc = -3;
+  else if (verify_crc && crc32_impl(payload, h->payload_bytes) != h->payload_crc32)
+    rc = -4;
+  else
+    memcpy(out, payload, h->payload_bytes);
+  munmap(map, st.st_size);
+  return rc;
+}
+
+// f32 -> bf16 with round-to-nearest-even (matches XLA/TPU semantics).
+void dn_f32_to_bf16(const float* src, uint16_t* dst, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    uint32_t bits;
+    memcpy(&bits, &src[i], 4);
+    if ((bits & 0x7FFFFFFFu) > 0x7F800000u) {  // NaN: quiet, keep payload bit
+      dst[i] = (uint16_t)((bits >> 16) | 0x0040);
+      continue;
+    }
+    uint32_t lsb = (bits >> 16) & 1;
+    bits += 0x7FFFu + lsb;
+    dst[i] = (uint16_t)(bits >> 16);
+  }
+}
+
+void dn_bf16_to_f32(const uint16_t* src, float* dst, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    uint32_t bits = ((uint32_t)src[i]) << 16;
+    memcpy(&dst[i], &bits, 4);
+  }
+}
+
+}  // extern "C"
