@@ -1,0 +1,390 @@
+"""Continuous-batching decode scheduler (BASELINE config 5: full RAG, QPS 16).
+
+The reference served generation through one external Ollama process per
+request (``llm-qa/main.py:66-69``) — no batching, no admission control.
+Here a fixed pool of decode *slots* shares one KV cache and one jit decode
+program:
+
+* admission: a queued request prefills into any free slot (its own jit
+  program per prompt bucket) while the other slots keep decoding;
+* decode: ONE program advances all slots a chunk of tokens per dispatch
+  (``lax.fori_loop`` inside jit — no host round-trip per token, SURVEY §7
+  hard part (b)); finished lanes go inactive inside the chunk;
+* retirement: a slot frees as soon as its lane hits EOS or its token budget,
+  and the next queued request takes it — throughput tracks the number of
+  *live* requests, not the slowest member of a static batch.
+
+The KV cache is donated through both programs (prefill scatter and decode
+chunk), so slot state stays HBM-resident across the whole serving session.
+TP shardings come from ``parallel/sharding.py``; slots ride the batch axis.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from docqa_tpu.models.decoder import (
+    decoder_forward,
+    init_decoder_params,  # noqa: F401  (re-export convenience for tests)
+    init_kv_cache,
+)
+from docqa_tpu.ops.sampling import sample
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+from docqa_tpu.utils import pick_bucket, round_up
+
+log = get_logger("docqa.serve")
+
+
+@dataclass
+class _Request:
+    prompt_ids: List[int]
+    max_new: int
+    done: threading.Event = field(default_factory=threading.Event)
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[BaseException] = None
+
+
+class Handle:
+    """Future-like result for a submitted request."""
+
+    def __init__(self, req: _Request) -> None:
+        self._req = req
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if self._req.error is not None:
+            raise self._req.error
+        return list(self._req.tokens)
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a ``GenerateEngine``'s model."""
+
+    def __init__(
+        self,
+        engine,  # GenerateEngine: supplies cfg/gen/params/tokenizer/mesh
+        n_slots: Optional[int] = None,
+        chunk: int = 8,
+        cache_len: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.gen = engine.gen
+        self.mesh = engine.mesh
+        self.n_slots = n_slots or self.gen.max_concurrent
+        if self.mesh is not None and self.n_slots % self.mesh.n_data:
+            self.n_slots = round_up(self.n_slots, self.mesh.n_data)
+        self.chunk = chunk
+        self.cache_len = round_up(cache_len or self.cfg.max_seq_len, 128)
+        self._seed = seed
+        self._rng_counter = 0
+
+        # device state (host-held references; donated through each dispatch)
+        self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
+        if self.mesh is not None and self.mesh.n_devices > 1:
+            from docqa_tpu.parallel.sharding import shard_kv_cache
+
+            self._cache = shard_kv_cache(self._cache, self.cfg, self.mesh)
+        self._tok = jnp.zeros((self.n_slots,), jnp.int32)
+        self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
+        self._active = jnp.zeros((self.n_slots,), bool)
+
+        # host-side slot bookkeeping
+        self._slot_req: List[Optional[_Request]] = [None] * self.n_slots
+        self._slot_budget = np.zeros((self.n_slots,), np.int64)
+
+        self._queue: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = None
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="continuous-batcher"
+        )
+        self._worker.start()
+
+    # ---- device programs -----------------------------------------------------
+
+    def _next_rng(self) -> jax.Array:
+        self._rng_counter += 1
+        return jax.random.PRNGKey(self._seed * 100_003 + self._rng_counter)
+
+    def _prefill_program(self, params, cache, ids, length, slot, rng):
+        """Prefill one request into slot ``slot`` of the shared cache."""
+        local = init_kv_cache(self.cfg, 1, max_len=self.cache_len)
+        logits, local = decoder_forward(
+            params,
+            self.cfg,
+            ids,
+            local,
+            jnp.zeros((1,), jnp.int32),
+            attn_lengths=length,
+            use_flash=self.engine.use_flash,
+            last_token_only=True,
+        )
+        tok = sample(
+            logits[:, -1], rng, self.gen.temperature, self.gen.top_k,
+            self.gen.top_p,
+        )
+        for key in cache:
+            cache[key] = jax.lax.dynamic_update_slice(
+                cache[key], local[key].astype(cache[key].dtype), (slot, 0, 0, 0)
+            )
+        return cache, tok[0]
+
+    def _decode_program(self, params, cache, tok, lengths, active, rng):
+        """Advance every active slot by ``self.chunk`` tokens in one dispatch.
+
+        Returns out [S, chunk] (pad on inactive steps), valid [S, chunk]
+        (True where the token is a real emission, EOS excluded — so a
+        legitimately *sampled* pad_id is preserved), plus updated state."""
+        S = self.n_slots
+        out0 = jnp.full((S, self.chunk), self.gen.pad_id, jnp.int32)
+        valid0 = jnp.zeros((S, self.chunk), bool)
+
+        def body(t, carry):
+            cache, tok, lengths, active, out, valid, rng = carry
+            logits, cache = decoder_forward(
+                params,
+                self.cfg,
+                tok[:, None],
+                cache,
+                lengths,
+                use_flash=self.engine.use_flash,
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = sample(
+                logits[:, 0], sub, self.gen.temperature, self.gen.top_k,
+                self.gen.top_p,
+            )
+            nxt = jnp.where(active, nxt, self.gen.pad_id)
+            is_eos = active & (nxt == self.gen.eos_id)
+            out = out.at[:, t].set(nxt)
+            valid = valid.at[:, t].set(active & ~is_eos)
+            lengths = lengths + active.astype(jnp.int32)
+            active = active & ~is_eos
+            tok = jnp.where(active, nxt, tok)
+            return cache, tok, lengths, active, out, valid, rng
+
+        cache, tok, lengths, active, out, valid, _ = jax.lax.fori_loop(
+            0,
+            self.chunk,
+            body,
+            (cache, tok, lengths, active, out0, valid0, rng),
+        )
+        return cache, tok, lengths, active, out, valid
+
+    def _get_prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_program, donate_argnums=(1,))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _get_decode_fn(self):
+        if self._decode_fn is None:
+            self._decode_fn = jax.jit(self._decode_program, donate_argnums=(1,))
+        return self._decode_fn
+
+    # ---- public API ----------------------------------------------------------
+
+    def submit_ids(
+        self, prompt_ids: Sequence[int], max_new_tokens: Optional[int] = None
+    ) -> Handle:
+        max_new = max_new_tokens or self.gen.max_new_tokens
+        req = _Request(list(prompt_ids), max_new)
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("batcher is stopped")
+            self._queue.append(req)
+            self._cv.notify_all()
+        DEFAULT_REGISTRY.counter("serve_submitted").inc()
+        return Handle(req)
+
+    def submit_text(
+        self, prompt: str, max_new_tokens: Optional[int] = None
+    ) -> Handle:
+        return self.submit_ids(
+            self.engine.tokenizer.encode(prompt), max_new_tokens
+        )
+
+    def generate_texts(
+        self, prompts: Sequence[str], max_new_tokens: Optional[int] = None
+    ) -> List[str]:
+        """Batch-convenience API (same contract as GenerateEngine)."""
+        handles = [self.submit_text(p, max_new_tokens) for p in prompts]
+        return [
+            self.engine.tokenizer.decode_ids(h.result(timeout=600))
+            for h in handles
+        ]
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._worker.join(timeout=10)
+        for req in list(self._queue) + [r for r in self._slot_req if r]:
+            if not req.done.is_set():
+                req.error = RuntimeError("batcher stopped")
+                req.done.set()
+
+    @property
+    def n_active(self) -> int:
+        return sum(1 for r in self._slot_req if r is not None)
+
+    @property
+    def n_queued(self) -> int:
+        return len(self._queue)
+
+    # ---- worker loop ---------------------------------------------------------
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        usable = self.cache_len - 1
+        ids = req.prompt_ids[-usable:] or [self.gen.pad_id]
+        bucket = min(
+            pick_bucket(len(ids), self.gen.prefill_buckets)
+            if len(ids) <= self.gen.prefill_buckets[-1]
+            else round_up(len(ids), 128),
+            usable,
+        )
+        padded = np.full((1, bucket), self.gen.pad_id, np.int32)
+        padded[0, : len(ids)] = ids
+        fn = self._get_prefill_fn(bucket)
+        with span("serve_prefill", DEFAULT_REGISTRY):
+            self._cache, first = fn(
+                self.engine.params,
+                self._cache,
+                jnp.asarray(padded),
+                jnp.asarray([len(ids)], jnp.int32),
+                jnp.int32(slot),
+                self._next_rng(),
+            )
+        first = int(first)
+        self._slot_req[slot] = req
+        # remaining decode budget; the prefill-sampled token counts as one
+        budget = min(req.max_new, self.cache_len - len(ids) - 1)
+        self._slot_budget[slot] = budget
+        alive = True
+        if first == self.gen.eos_id or budget <= 0:
+            alive = False
+            self._retire(slot)
+        else:
+            req.tokens.append(first)
+            if len(req.tokens) >= budget:
+                alive = False
+                self._retire(slot)
+        self._tok = self._tok.at[slot].set(first)
+        self._lengths = self._lengths.at[slot].set(len(ids))
+        self._active = self._active.at[slot].set(alive)
+
+    def _fail_active(self, err: BaseException) -> None:
+        """Fail all in-flight requests and rebuild clean device state."""
+        for slot in range(self.n_slots):
+            req = self._slot_req[slot]
+            if req is not None:
+                req.error = RuntimeError(f"decode failed: {err!r}")
+                req.done.set()
+                self._slot_req[slot] = None
+        self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
+        if self.mesh is not None and self.mesh.n_devices > 1:
+            from docqa_tpu.parallel.sharding import shard_kv_cache
+
+            self._cache = shard_kv_cache(self._cache, self.cfg, self.mesh)
+        self._tok = jnp.zeros((self.n_slots,), jnp.int32)
+        self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
+        self._active = jnp.zeros((self.n_slots,), bool)
+        DEFAULT_REGISTRY.counter("serve_decode_failures").inc()
+
+    def _retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        self._slot_req[slot] = None
+        if req is not None:
+            req.done.set()
+            DEFAULT_REGISTRY.counter("serve_completed").inc()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while (
+                    not self._stopped
+                    and not self._queue
+                    and not any(self._slot_req)
+                ):
+                    self._cv.wait(0.5)
+                if self._stopped:
+                    return
+                # admission: fill free slots from the queue
+                for slot in range(self.n_slots):
+                    if not self._queue:
+                        break
+                    if self._slot_req[slot] is None:
+                        req = self._queue.popleft()
+                        try:
+                            self._admit(slot, req)
+                        except Exception as e:  # bad request; fail it alone
+                            log.exception("prefill failed")
+                            req.error = e
+                            req.done.set()
+                            self._slot_req[slot] = None
+            if not any(self._slot_req):
+                continue
+            # one decode chunk for every live slot
+            fn = self._get_decode_fn()
+            try:
+                with span("serve_decode_chunk", DEFAULT_REGISTRY):
+                    (
+                        self._cache,
+                        self._tok,
+                        self._lengths,
+                        self._active,
+                        out,
+                        valid,
+                    ) = fn(
+                        self.engine.params,
+                        self._cache,
+                        self._tok,
+                        self._lengths,
+                        self._active,
+                        self._next_rng(),
+                    )
+            except Exception as e:
+                # the cache was donated into a failed dispatch — fail every
+                # in-flight request, reset device state, and keep serving
+                # (a dead daemon thread would strand all current AND future
+                # requests with no error)
+                log.exception("decode chunk failed; resetting slot state")
+                self._fail_active(e)
+                continue
+            out_h = np.asarray(out)
+            valid_h = np.asarray(valid)
+            active_h = np.asarray(self._active)
+            deactivate = []
+            for slot in range(self.n_slots):
+                req = self._slot_req[slot]
+                if req is None:
+                    continue
+                for t in range(self.chunk):
+                    if not valid_h[slot, t]:
+                        continue
+                    if len(req.tokens) >= self._slot_budget[slot]:
+                        break
+                    req.tokens.append(int(out_h[slot, t]))
+                if (
+                    not active_h[slot]
+                    or len(req.tokens) >= self._slot_budget[slot]
+                ):
+                    deactivate.append(slot)
+                    self._retire(slot)
+            if deactivate:
+                idx = jnp.asarray(deactivate, jnp.int32)
+                self._active = self._active.at[idx].set(False)
