@@ -1,0 +1,249 @@
+"""IVF (inverted-file) coarse-quantized search for corpora beyond exact scale.
+
+The reference's only index is exact ``IndexFlatL2`` over 649 vectors
+(``semantic-indexer/indexer.py:39,104``).  The exact HBM store
+(``index/store.py``) already beats that to ~1M chunks on TPU — one MXU
+matmul per query batch is HBM-bandwidth bound, not compute bound.  IVF is
+the next decade: probing ``nprobe`` of ``n_clusters`` cells cuts HBM reads
+per query by ~``nprobe/n_clusters``, at a measured recall cost.
+
+TPU-first layout (no pointer-chasing inverted lists):
+
+* k-means runs ON DEVICE: assignment is one ``[n, d] x [d, C]`` matmul +
+  argmax; the centroid update is a one-hot ``[C, n] x [n, d]`` matmul —
+  both MXU shapes, iterated under ``lax.fori_loop`` in a single jit.
+* cells are stored as one dense ``[C, cap, d]`` buffer (uniform capacity,
+  padded with zeros; padding rows carry id -1 and score -inf).  Probing is
+  a static-shape ``take`` of ``[nprobe, cap, d]`` per query — XLA-friendly,
+  no ragged gathers.
+* cell overflow spills to a small exact buffer that every query also scans,
+  so recall degrades gracefully instead of silently dropping rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+
+log = get_logger("docqa.ivf")
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# On-device k-means
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _kmeans_fit(vectors: jax.Array, init: jax.Array, n_iters: int, c: int):
+    """Lloyd iterations, fully on device.  vectors [n, d] (L2-normalized),
+    init [C, d].  Returns (centroids [C, d], assignments [n])."""
+
+    def body(_, centroids):
+        scores = vectors @ centroids.T  # [n, C] cosine
+        assign = jnp.argmax(scores, axis=1)  # [n]
+        onehot = jax.nn.one_hot(assign, c, dtype=vectors.dtype)  # [n, C]
+        sums = onehot.T @ vectors  # [C, d]
+        counts = jnp.sum(onehot, axis=0)[:, None]  # [C, 1]
+        new = sums / jnp.maximum(counts, 1.0)
+        # empty cell keeps its old centroid (avoids NaN / collapse)
+        new = jnp.where(counts > 0, new, centroids)
+        norm = jnp.linalg.norm(new, axis=1, keepdims=True)
+        return new / jnp.maximum(norm, 1e-9)
+
+    centroids = jax.lax.fori_loop(0, n_iters, body, init)
+    assign = jnp.argmax(vectors @ centroids.T, axis=1)
+    return centroids, assign
+
+
+def kmeans(
+    vectors: np.ndarray,
+    n_clusters: int,
+    n_iters: int = 10,
+    seed: int = 0,
+    sample: Optional[int] = 262_144,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Fit centroids (on a subsample for huge corpora), assign every row.
+
+    Returns (centroids [C, d] float32, assignments [n] int32)."""
+    vectors = np.asarray(vectors, np.float32)
+    n = len(vectors)
+    rng = np.random.default_rng(seed)
+    fit_on = vectors
+    if sample is not None and n > sample:
+        fit_on = vectors[rng.choice(n, sample, replace=False)]
+    init = fit_on[rng.choice(len(fit_on), n_clusters, replace=n_clusters > len(fit_on))]
+    centroids, _ = _kmeans_fit(
+        jnp.asarray(fit_on), jnp.asarray(init), n_iters, n_clusters
+    )
+    # final assignment over the full corpus, blocked to bound device memory
+    assigns = []
+    block = 1 << 18
+    cT = centroids.T
+    for start in range(0, n, block):
+        scores = jnp.asarray(vectors[start : start + block]) @ cT
+        assigns.append(np.asarray(jnp.argmax(scores, axis=1)))
+    return np.asarray(centroids), np.concatenate(assigns).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# IVF index
+# ---------------------------------------------------------------------------
+
+def _probe_kernel(
+    cells: jax.Array,  # [C, cap, d]
+    cell_ids: jax.Array,  # [C, cap] int32 global row ids (-1 pad)
+    centroids: jax.Array,  # [C, d]
+    spill: jax.Array,  # [S, d]
+    spill_ids: jax.Array,  # [S]
+    queries: jax.Array,  # [q, d]
+    *,
+    nprobe: int,
+    k: int,
+):
+    c_scores = queries @ centroids.T  # [q, C]
+    _, probe = jax.lax.top_k(c_scores, nprobe)  # [q, nprobe]
+
+    def one_query(qv, cells_q, ids_q):
+        # cells_q [nprobe, cap, d], ids_q [nprobe, cap]
+        s = jnp.einsum("pcd,d->pc", cells_q, qv)  # [nprobe, cap]
+        s = jnp.where(ids_q >= 0, s, NEG_INF)
+        return s.reshape(-1), ids_q.reshape(-1)
+
+    probed_cells = cells[probe]  # [q, nprobe, cap, d]
+    probed_ids = cell_ids[probe]  # [q, nprobe, cap]
+    cell_s, cell_i = jax.vmap(one_query)(queries, probed_cells, probed_ids)
+
+    spill_s = queries @ spill.T  # [q, S]
+    spill_s = jnp.where(spill_ids[None, :] >= 0, spill_s, NEG_INF)
+
+    all_s = jnp.concatenate([cell_s, jnp.broadcast_to(spill_s, (queries.shape[0], spill_s.shape[1]))], axis=1)
+    all_i = jnp.concatenate(
+        [cell_i, jnp.broadcast_to(spill_ids[None, :], (queries.shape[0], spill_ids.shape[0]))],
+        axis=1,
+    )
+    vals, pos = jax.lax.top_k(all_s, k)
+    return vals, jnp.take_along_axis(all_i, pos, axis=1)
+
+
+class IVFIndex:
+    """Coarse-quantized cosine search over a fixed corpus snapshot.
+
+    Build once from vectors+metadata (or straight from a ``VectorStore``);
+    rebuild periodically as the store grows — the serving pattern is exact
+    search over the live append buffer + IVF over the compacted bulk.
+    """
+
+    def __init__(
+        self,
+        vectors: np.ndarray,
+        metadata: Sequence[Dict[str, Any]],
+        n_clusters: Optional[int] = None,
+        nprobe: int = 32,
+        cap_factor: float = 1.5,
+        n_iters: int = 10,
+        seed: int = 0,
+        dtype: str = "bfloat16",
+    ) -> None:
+        vectors = np.asarray(vectors, np.float32)
+        n, d = vectors.shape
+        norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+        vectors = vectors / np.maximum(norms, 1e-9)
+        self._meta = list(metadata)
+        self.n = n
+        self.dim = d
+        c = n_clusters or max(1, int(np.sqrt(max(n, 1))))
+        self.n_clusters = c
+        self.nprobe = min(nprobe, c)
+        self._dtype = jnp.dtype(dtype)
+
+        with span("ivf_build", DEFAULT_REGISTRY):
+            centroids, assign = kmeans(vectors, c, n_iters=n_iters, seed=seed)
+            cap = max(8, int(np.ceil(cap_factor * n / c)))
+            cells = np.zeros((c, cap, d), np.float32)
+            cell_ids = np.full((c, cap), -1, np.int32)
+            fill = np.zeros((c,), np.int64)
+            spill_rows: List[int] = []
+            for i, a in enumerate(assign):
+                if fill[a] < cap:
+                    cells[a, fill[a]] = vectors[i]
+                    cell_ids[a, fill[a]] = i
+                    fill[a] += 1
+                else:
+                    spill_rows.append(i)
+            spill_n = max(1, len(spill_rows))
+            spill = np.zeros((spill_n, d), np.float32)
+            spill_ids = np.full((spill_n,), -1, np.int32)
+            for j, i in enumerate(spill_rows):
+                spill[j] = vectors[i]
+                spill_ids[j] = i
+            self.cap = cap
+            self.n_spilled = len(spill_rows)
+            self._cells = jnp.asarray(cells, self._dtype)
+            self._cell_ids = jnp.asarray(cell_ids)
+            self._centroids = jnp.asarray(centroids, self._dtype)
+            self._spill = jnp.asarray(spill, self._dtype)
+            self._spill_ids = jnp.asarray(spill_ids)
+        self._fns: Dict[Tuple[int, int, int], Any] = {}
+        log.info(
+            "ivf built: n=%d C=%d cap=%d spill=%d nprobe=%d",
+            n, c, cap, self.n_spilled, self.nprobe,
+        )
+
+    @classmethod
+    def from_store(cls, store, **kw) -> "IVFIndex":
+        """Snapshot the live exact store into an IVF index (consistent
+        vectors/metadata pair even while the store keeps appending)."""
+        vectors, meta = store.vectors_snapshot()
+        return cls(vectors, meta, **kw)
+
+    def _get_fn(self, q: int, k: int, nprobe: int):
+        key = (q, k, nprobe)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = jax.jit(functools.partial(_probe_kernel, nprobe=nprobe, k=k))
+            self._fns[key] = fn
+        return fn
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int = 10,
+        nprobe: Optional[int] = None,
+    ) -> List[List[Tuple[float, int, Dict[str, Any]]]]:
+        """Returns per query a list of (score, row_id, metadata)."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        qn = queries / np.maximum(
+            np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+        )
+        nprobe = min(nprobe or self.nprobe, self.n_clusters)
+        k_eff = min(k, self.n)
+        fn = self._get_fn(len(qn), k_eff, nprobe)
+        with span("ivf_search", DEFAULT_REGISTRY):
+            vals, ids = fn(
+                self._cells,
+                self._cell_ids,
+                self._centroids,
+                self._spill,
+                self._spill_ids,
+                jnp.asarray(qn, self._dtype),
+            )
+        vals = np.asarray(vals, np.float32)
+        ids = np.asarray(ids)
+        out = []
+        for qi in range(len(qn)):
+            row = []
+            for score, rid in zip(vals[qi], ids[qi]):
+                if rid < 0 or score <= NEG_INF / 2:
+                    continue
+                row.append((float(score), int(rid), self._meta[int(rid)]))
+            out.append(row)
+        return out

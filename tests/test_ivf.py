@@ -1,0 +1,79 @@
+"""IVF coarse-quantized index: recall vs the exact store, spill handling."""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import StoreConfig
+from docqa_tpu.index.ivf import IVFIndex, kmeans
+from docqa_tpu.index.store import VectorStore
+
+
+def _clustered_corpus(n=4000, d=64, n_centers=32, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 4
+    assign = rng.integers(0, n_centers, n)
+    x = centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
+    return x.astype(np.float32)
+
+
+def test_kmeans_clusters_separate_data():
+    x = _clustered_corpus(n=2000, n_centers=8)
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    centroids, assign = kmeans(xn, 8, n_iters=15)
+    assert centroids.shape == (8, 64)
+    assert assign.shape == (2000,)
+    # every centroid is unit-norm and at least most cells are populated
+    np.testing.assert_allclose(np.linalg.norm(centroids, axis=1), 1.0, atol=1e-3)
+    assert len(np.unique(assign)) >= 6
+
+
+def test_recall_vs_exact():
+    x = _clustered_corpus()
+    meta = [{"row": i} for i in range(len(x))]
+    store = VectorStore(StoreConfig(dim=64, shard_capacity=4096))
+    store.add(x, meta)
+    ivf = IVFIndex(x, meta, n_clusters=64, nprobe=16, dtype="float32")
+
+    queries = x[:50] + 0.01 * np.random.default_rng(1).standard_normal((50, 64)).astype(np.float32)
+    exact = store.search(queries, k=10)
+    approx = ivf.search(queries, k=10, nprobe=16)
+
+    hits = total = 0
+    for e_row, a_row in zip(exact, approx):
+        e_ids = {r.row_id for r in e_row}
+        a_ids = {rid for _, rid, _ in a_row}
+        hits += len(e_ids & a_ids)
+        total += len(e_ids)
+    recall = hits / total
+    assert recall >= 0.9, f"recall@10 {recall:.3f} too low"
+
+
+def test_full_probe_is_exact():
+    # nprobe == n_clusters must reproduce exact top-1 (self-queries)
+    x = _clustered_corpus(n=500, n_centers=4)
+    meta = [{"row": i} for i in range(len(x))]
+    ivf = IVFIndex(x, meta, n_clusters=8, nprobe=8, cap_factor=8.0, dtype="float32")
+    assert ivf.n_spilled == 0
+    res = ivf.search(x[:20], k=1, nprobe=8)
+    for i, row in enumerate(res):
+        assert row[0][1] == i
+
+
+def test_spill_rows_still_findable():
+    # tiny cap forces spill; spilled rows must remain retrievable
+    x = _clustered_corpus(n=300, n_centers=2)
+    meta = [{"row": i} for i in range(len(x))]
+    ivf = IVFIndex(x, meta, n_clusters=4, nprobe=1, cap_factor=0.1, dtype="float32")
+    assert ivf.n_spilled > 0
+    res = ivf.search(x, k=1, nprobe=1)
+    found_self = sum(1 for i, row in enumerate(res) if row and row[0][1] == i)
+    assert found_self == len(x)  # spill is scanned exactly for every query
+
+
+def test_from_store_roundtrip():
+    x = _clustered_corpus(n=600, n_centers=8)
+    store = VectorStore(StoreConfig(dim=64, shard_capacity=1024))
+    store.add(x, [{"row": i} for i in range(len(x))])
+    ivf = IVFIndex.from_store(store, n_clusters=16, nprobe=8, dtype="float32")
+    res = ivf.search(x[:5], k=3, nprobe=16)
+    assert res[0][0][2]["row"] == 0
