@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import json
+import os
 from typing import Optional
 
 from docqa_tpu.config import Config, load_config
@@ -303,9 +304,17 @@ def make_app(rt: DocQARuntime):
             return json_error(e.status, e.detail)
         return web.json_response(json.loads(resp.model_dump_json()))
 
+    async def index_page(_req):
+        """The chat/upload UI (replaces the reference's Streamlit app,
+        ``clinical-ui/app.py`` — status pings, upload, QA chat — with a real
+        pipeline completion signal instead of its 5 s fake progress bar)."""
+        path = os.path.join(os.path.dirname(__file__), "ui.html")
+        return web.FileResponse(path)
+
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app.add_routes(
         [
+            web.get("/", index_page),
             web.get("/health", health),
             web.get("/api/status", api_status),
             web.get("/metrics", metrics),

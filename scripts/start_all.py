@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Single-command launcher (replaces the reference's ``start_all.bat``).
+
+The reference needed Docker (Postgres, RabbitMQ, Tika) plus five separate
+terminals (``start_all.bat:12-35``).  Here the whole system — ingest API,
+de-id worker, index worker, QA, synthesis, UI — is one process on one port:
+
+    python scripts/start_all.py [--port 8000] [--config cfg.json]
+
+Open http://localhost:8000/ for the UI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument(
+        "--config",
+        type=str,
+        default=None,
+        help='JSON file of dotted-path overrides, e.g. {"store.shard_capacity": 65536}',
+    )
+    ap.add_argument(
+        "--cpu", action="store_true", help="force the CPU backend (dev/test)"
+    )
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from docqa_tpu.config import load_config
+    from docqa_tpu.service.app import serve
+
+    overrides = None
+    if args.config:
+        import json
+
+        with open(args.config) as f:
+            overrides = json.load(f)
+    serve(load_config(overrides=overrides), port=args.port)
+
+
+if __name__ == "__main__":
+    main()
