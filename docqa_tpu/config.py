@@ -77,6 +77,12 @@ class NERConfig:
         "LOCATION",
     )
     dtype: str = "bfloat16"
+    # Serving-runtime tagger provenance: load cached params from params_path
+    # if present/compatible, else train train_steps on the synthetic PHI
+    # generator (training/ner.py) and cache.  train_steps=0 keeps random-init
+    # weights — pipeline-plumbing mode only, never masks contextual PHI.
+    params_path: Optional[str] = None
+    train_steps: int = 500
 
     @property
     def num_labels(self) -> int:

@@ -1,0 +1,307 @@
+"""Synthetic labeled-PHI generator for training the NER tagger.
+
+The reference gets contextual PHI detection (PERSON / LOCATION / NRP) from
+Presidio's pretrained spaCy backbone (``deid-service/anonymizer.py:29-48``).
+This environment is zero-egress — no pretrained NER weights — so the tagger
+is *trained here*, on synthetic clinical sentences templated over PHI
+lexicons, weak-supervision style.
+
+Generalization is the point, not memorization: a deployed deid system must
+mask names it never saw.  Three mechanisms force the model onto context +
+orthographic shape rather than word identity:
+
+* **Gibberish entities** — a fraction of PERSON/LOCATION fills are random
+  pronounceable syllable strings, unique per example, so their hash buckets
+  are useless as features;
+* **Held-out lexicons** — evaluation fills come from name/city/group lists
+  disjoint from training (``John``, ``Smith``, ``Boston`` are deliberately
+  held out; the acceptance test masks "John Smith from Boston" with a model
+  that never saw those words);
+* **Capitalized negatives** — drug names, scan types, sentence-initial
+  words appear title-cased with O labels, so shape alone cannot fire.
+
+Label scheme: BIO over ``NERConfig.entities`` (``models/ner.py:label_ids``).
+Supervision sits on the FIRST token of each word — the same position
+``deid/engine.py:_ner_results`` reads logits from at inference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from docqa_tpu.config import NERConfig
+from docqa_tpu.models.ner import label_ids
+from docqa_tpu.text.tokenizer import ShapeHashTokenizer, Tokenizer, _WORD_RE
+
+
+def ner_tokenizer(cfg: NERConfig) -> ShapeHashTokenizer:
+    """The tokenizer the tagger is trained with — and must serve with."""
+    return ShapeHashTokenizer(cfg.vocab_size)
+
+
+# ---------------------------------------------------------------------------
+# Lexicons.  TRAIN_* feed the generator; EVAL_* are disjoint and only used
+# by evaluate_ner / tests to measure generalization to unseen surface forms.
+# ---------------------------------------------------------------------------
+
+TRAIN_FIRST = (
+    "Liam Olivia Noah Ava Ethan Mia Lucas Amara Hugo Ines Rafael Leila "
+    "Mateo Zara Felix Nadia Omar Clara Iris Tariq Ayo Chen Priya Ravi "
+    "Sven Astrid Kenji Yuki Pablo Lucia Marta Andrei Elena Dmitri Aisha "
+    "Kofi Abena Thandi Sipho Marco Giulia Pierre Camille Anya Viktor "
+    "Soren Maren Tomas Eva Milan Petra Janek Alma Ruben Noor Idris Salma"
+).split()
+EVAL_FIRST = (
+    "John Emma Carlos Fatima Wei Hannah Diego Sofia Ahmed Grace James "
+    "Mary Robert Linda Kwame Ingrid"
+).split()
+
+TRAIN_LAST = (
+    "Moreau Lindqvist Okafor Tanaka Alvarez Petrov Haddad Kowalski Banda "
+    "Ferreira Novak Eriksen Demir Fontaine Iqbal Mensah Vargas Bergman "
+    "Castellano Dubois Yamamoto Abebe Olsen Marchetti Reyes Sokolov "
+    "Amani Laurent Bakker Jensen Costa Weber Ricci Andersson Horvat "
+    "Nakamura Osei Traore Lefevre Lombardi"
+).split()
+EVAL_LAST = (
+    "Smith Johnson Williams Brown Garcia Miller Chen Patel Nguyen Keller"
+).split()
+
+TRAIN_CITY = (
+    "Lyon Marseille Toulouse Hamburg Munich Valencia Porto Antwerp Ghent "
+    "Krakow Gdansk Brno Zagreb Vilnius Tampere Aarhus Malmo Bergen "
+    "Nagoya Osaka Busan Hanoi Mumbai Pune Lagos Accra Nairobi Kampala "
+    "Quito Lima Cordoba Montevideo Calgary Halifax Adelaide Perth "
+    "Geneva Basel Utrecht Leiden"
+).split()
+EVAL_CITY = (
+    "Boston Madrid Cairo Dublin Oslo Seattle Toronto Melbourne Kyoto "
+    "Casablanca"
+).split()
+
+TRAIN_NRP = (
+    "French German Spanish Polish Czech Croatian Finnish Danish Japanese "
+    "Korean Vietnamese Indian Nigerian Ghanaian Kenyan Peruvian Canadian "
+    "Australian Swiss Dutch Catholic Protestant Orthodox Muslim Hindu "
+    "Sikh Jain Lutheran Anglican Methodist"
+).split()
+EVAL_NRP = "Irish Buddhist Norwegian Egyptian Moroccan Jewish".split()
+
+# Capitalized non-PHI that must stay O (drugs, scans, units, days are caught
+# by the DATE_TIME pattern recognizer, not the tagger).
+_CAP_NEGATIVES = (
+    "Lisinopril Metformin Atorvastatin Tylenol Ibuprofen Warfarin "
+    "Amoxicillin Prednisone Insulin Albuterol"
+).split()
+_SCANS = "MRI CT ECG EEG X-ray".split()
+
+_SYLLABLES = (
+    "ba be bi bo bu da de di do du ka ke ki ko ku la le li lo lu ma me "
+    "mi mo mu na ne ni no nu ra re ri ro ru sa se si so su ta te ti to "
+    "tu va ve vi vo vu za ze zi zo zu"
+).split()
+
+
+def _gibberish(rng: np.random.Generator) -> str:
+    n = int(rng.integers(2, 4))
+    word = "".join(rng.choice(_SYLLABLES) for _ in range(n))
+    return word.capitalize()
+
+
+# ---------------------------------------------------------------------------
+# Sentence templates.  {P}=PERSON {L}=LOCATION {N}=NRP {D}=capitalized O-word
+# {S}=scan-type O-word.  Entity spans are computed by construction.
+# ---------------------------------------------------------------------------
+
+_TEMPLATES: Tuple[str, ...] = (
+    "Patient {P} was admitted with chest pain.",
+    "{P} reports worsening dyspnea over two days.",
+    "{P} from {L} presented to the emergency department.",
+    "{P} lives in {L} with family.",
+    "{P} resides in {L} and works as a teacher.",
+    "Spouse {P} was present at the bedside.",
+    "Discussed the discharge plan with {P} today.",
+    "{P}, a {N} male, denies tobacco use.",
+    "{P} is a {N} female with a history of hypertension.",
+    "The patient identifies as {N} and requests an interpreter.",
+    "{P} recently traveled to {L} for work.",
+    "Patient transferred from a clinic in {L}.",
+    "Per {P}, symptoms began after returning from {L}.",
+    "{P} of {N} descent presented for follow-up.",
+    "Daughter {P} will assist with medications at home.",
+    "{P} moved to {L} last year.",
+    "Caregiver {P} reports good adherence.",
+    "History obtained from {P}, the patient's brother.",
+    # short intake-header forms (sentence-initial entities, minimal context)
+    "{P} from {L}.",
+    "{P} lives in {L}.",
+    "Name: {P}.",
+    "Address: {L}.",
+    "Emergency contact: {P}, number on file.",
+    "{P} was seen today.",
+    "Referred by {P}.",
+    "{P} and spouse attended the visit.",
+    # negatives: no PHI, plenty of capitalized O words
+    "Patient presents with abdominal pain and nausea.",
+    "The {S} of the chest was unremarkable.",
+    "Started on {D} 10 mg daily.",
+    "Continue {D} and recheck labs in the morning.",
+    "Labs were drawn at the bedside without complication.",
+    "Physical exam reveals no acute distress.",
+    "{S} results were reviewed with the care team.",
+    "Plan to titrate {D} as tolerated.",
+)
+
+
+def _fill(
+    rng: np.random.Generator,
+    template: str,
+    lexicons: Dict[str, Sequence[str]],
+    gibberish_frac: float,
+) -> Tuple[str, List[Tuple[int, int, str]]]:
+    """Render one template → (text, [(char_start, char_end, entity)])."""
+    out: List[str] = []
+    spans: List[Tuple[int, int, str]] = []
+    pos = 0
+    i = 0
+    while i < len(template):
+        if template[i] == "{" and i + 2 < len(template) and template[i + 2] == "}":
+            slot = template[i + 1]
+            if slot == "P":
+                use_gib = rng.random() < gibberish_frac
+                first = _gibberish(rng) if use_gib else str(rng.choice(lexicons["first"]))
+                if rng.random() < 0.7:
+                    last = _gibberish(rng) if use_gib else str(rng.choice(lexicons["last"]))
+                    fill = f"{first} {last}"
+                else:
+                    fill = first
+                ent = "PERSON"
+            elif slot == "L":
+                fill = (
+                    _gibberish(rng)
+                    if rng.random() < gibberish_frac
+                    else str(rng.choice(lexicons["city"]))
+                )
+                ent = "LOCATION"
+            elif slot == "N":
+                fill = str(rng.choice(lexicons["nrp"]))
+                ent = "NRP"
+            elif slot == "D":
+                fill, ent = str(rng.choice(_CAP_NEGATIVES)), None
+            elif slot == "S":
+                fill, ent = str(rng.choice(_SCANS)), None
+            else:  # pragma: no cover - template typo guard
+                raise ValueError(f"unknown slot {{{slot}}}")
+            if ent is not None:
+                spans.append((pos, pos + len(fill), ent))
+            out.append(fill)
+            pos += len(fill)
+            i += 3
+        else:
+            out.append(template[i])
+            pos += 1
+            i += 1
+    return "".join(out), spans
+
+
+TRAIN_LEXICONS: Dict[str, Sequence[str]] = {
+    "first": TRAIN_FIRST, "last": TRAIN_LAST, "city": TRAIN_CITY, "nrp": TRAIN_NRP,
+}
+EVAL_LEXICONS: Dict[str, Sequence[str]] = {
+    "first": EVAL_FIRST, "last": EVAL_LAST, "city": EVAL_CITY, "nrp": EVAL_NRP,
+}
+
+
+def generate_example(
+    rng: np.random.Generator,
+    lexicons: Dict[str, Sequence[str]] = TRAIN_LEXICONS,
+    max_sentences: int = 3,
+    gibberish_frac: float = 0.35,
+) -> Tuple[str, List[Tuple[int, int, str]]]:
+    """A 1..max_sentences synthetic note with char-level entity spans."""
+    n = int(rng.integers(1, max_sentences + 1))
+    parts: List[str] = []
+    spans: List[Tuple[int, int, str]] = []
+    offset = 0
+    for _ in range(n):
+        tmpl = str(rng.choice(_TEMPLATES))
+        text, s = _fill(rng, tmpl, lexicons, gibberish_frac)
+        parts.append(text)
+        spans.extend((a + offset, b + offset, e) for a, b, e in s)
+        offset += len(text) + 1  # the join space
+    return " ".join(parts), spans
+
+
+def word_bio_labels(
+    text: str, spans: Sequence[Tuple[int, int, str]], cfg: NERConfig
+) -> Tuple[List[str], List[Tuple[int, int]], List[int]]:
+    """Split text into words and assign BIO label ids per word."""
+    lids = label_ids(cfg)
+    words: List[str] = []
+    wspans: List[Tuple[int, int]] = []
+    labels: List[int] = []
+    for m in _WORD_RE.finditer(text):
+        words.append(m.group())
+        wspans.append((m.start(), m.end()))
+        label = lids["O"]
+        for a, b, ent in spans:
+            if m.start() >= a and m.end() <= b:
+                prefix = "B" if m.start() == a else "I"
+                label = lids[f"{prefix}-{ent}"]
+                break
+        labels.append(label)
+    return words, wspans, labels
+
+
+def encode_example(
+    tokenizer: Tokenizer,
+    cfg: NERConfig,
+    text: str,
+    spans: Sequence[Tuple[int, int, str]],
+    seq: int,
+) -> Tuple[np.ndarray, int, np.ndarray, np.ndarray]:
+    """(ids[seq], length, labels[seq], mask[seq]) — label/mask on the first
+    token of each word, mirroring the read position in
+    ``deid/engine.py:_ner_results``."""
+    words, _, wlabels = word_bio_labels(text, spans, cfg)
+    ids = np.zeros((seq,), np.int32)
+    labels = np.zeros((seq,), np.int32)
+    mask = np.zeros((seq,), np.float32)
+    row: List[int] = [tokenizer.cls_id]
+    supervise: List[Tuple[int, int]] = []  # (token_idx, label)
+    for word, lab in zip(words, wlabels):
+        wids = tokenizer.word_to_ids(word)
+        if len(row) + len(wids) > seq - 1:
+            break
+        supervise.append((len(row), lab))
+        row.extend(wids)
+    row.append(tokenizer.sep_id)
+    ids[: len(row)] = row
+    for ti, lab in supervise:
+        labels[ti] = lab
+        mask[ti] = 1.0
+    return ids, len(row), labels, mask
+
+
+def sample_batch(
+    rng: np.random.Generator,
+    tokenizer: Tokenizer,
+    cfg: NERConfig,
+    batch_size: int,
+    seq: int,
+    lexicons: Dict[str, Sequence[str]] = TRAIN_LEXICONS,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """A padded training batch: ids [b,s], lengths [b], labels [b,s],
+    mask [b,s]."""
+    ids = np.zeros((batch_size, seq), np.int32)
+    lengths = np.zeros((batch_size,), np.int32)
+    labels = np.zeros((batch_size, seq), np.int32)
+    mask = np.zeros((batch_size, seq), np.float32)
+    for i in range(batch_size):
+        text, spans = generate_example(rng, lexicons)
+        ids[i], lengths[i], labels[i], mask[i] = encode_example(
+            tokenizer, cfg, text, spans, seq
+        )
+    return ids, lengths, labels, mask

@@ -9,9 +9,12 @@ Reproduces the reference deid worker's two-phase contract —
   PHONE_NUMBER, DATE_TIME, plus title/honorific cues for PERSON.  These
   carry the precision-critical structured PHI.
 * **NER recognizer** (device, jit): the ``models/ner.py`` token classifier
-  for contextual entities (PERSON, LOCATION, NRP).  Random-init weights are
-  usable for pipeline plumbing; real clinical-BERT weights load via the
-  encoder's safetensors path, and ``training/ner.py`` can fine-tune.
+  for contextual entities (PERSON, LOCATION, NRP).  ``DeidEngine.trained``
+  fits it on the synthetic PHI generator (``deid/datagen.py`` +
+  ``training/ner.py``) — the zero-egress stand-in for Presidio's pretrained
+  spaCy backbone — or loads a cached ``.npz``; real clinical-BERT weights
+  can also load via the encoder's safetensors path.  A bare ``DeidEngine``
+  keeps random-init weights (pipeline-plumbing mode only).
 
 The entity universe is the reference's 6-type list (``anonymizer.py:43``):
 PERSON, PHONE_NUMBER, EMAIL_ADDRESS, DATE_TIME, NRP, LOCATION.
@@ -135,15 +138,50 @@ class DeidEngine:
         seed: int = 0,
         use_ner_model: bool = True,
         ner_threshold: float = 0.5,
+        max_window: Optional[int] = None,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
         self.use_ner_model = use_ner_model
         self.ner_threshold = ner_threshold
+        # Window bound for NER batching: position embeddings beyond the
+        # tagger's training seq are untrained, so serving must not pack
+        # windows longer than it (training/ner.py train_ner docstring).
+        self._window = min(max_window or cfg.max_seq_len, cfg.max_seq_len)
         if params is None and use_ner_model:
             params = init_ner_params(jax.random.PRNGKey(seed), cfg)
         self.params = params
         self._forward = jax.jit(functools.partial(ner_forward, cfg=cfg))
+
+    @classmethod
+    def trained(
+        cls,
+        cfg: NERConfig,
+        *,
+        params_path: Optional[str] = None,
+        steps: Optional[int] = None,
+        seed: int = 0,
+        mesh=None,
+        **engine_kw,
+    ) -> "DeidEngine":
+        """An engine with a *functional* contextual-PHI tagger: load cached
+        params from ``params_path`` if compatible, else train on the
+        synthetic generator (and cache).  This is what the serving runtime
+        uses — random-init NER must never mask production documents."""
+        from docqa_tpu.deid.datagen import ner_tokenizer
+        from docqa_tpu.training.ner import load_or_train
+
+        train_kw = {"seed": seed, "mesh": mesh}
+        if steps is not None:
+            train_kw["steps"] = steps
+        params, train_seq = load_or_train(cfg, params_path, **train_kw)
+        return cls(
+            cfg,
+            tokenizer=ner_tokenizer(cfg),
+            params=params,
+            max_window=train_seq,
+            **engine_kw,
+        )
 
     # -- NER path ------------------------------------------------------------
 
@@ -157,7 +195,7 @@ class DeidEngine:
         documents are packed into one padded batch (bucketed on both axes to
         bound the jit cache) and results are stitched back per document.
         """
-        budget = self.cfg.max_seq_len - 2  # room for CLS/SEP
+        budget = self._window - 2  # room for CLS/SEP
         # segment: (doc_idx, [(word_ids, char_start, char_end), ...])
         segments: List[Tuple[int, List[Tuple[List[int], int, int]]]] = []
         for di, text in enumerate(texts):
@@ -187,7 +225,7 @@ class DeidEngine:
             pick_bucket(max_tokens, (64, 128, 256, 512))
             if max_tokens <= 512
             else round_up(max_tokens, 128),
-            self.cfg.max_seq_len,
+            self._window,
         )
         n_seg = len(segments)
         batch = pick_bucket(n_seg, (1, 2, 4, 8, 16, 32)) if n_seg <= 32 else n_seg

@@ -73,7 +73,20 @@ class DocQARuntime:
         else:
             self.encoder = EncoderEngine(self.cfg.encoder, mesh=self.mesh)
         self.store = VectorStore(self.cfg.store, mesh=self.mesh)
-        self.deid = DeidEngine(self.cfg.ner)
+        if self.cfg.ner.train_steps > 0 or self.cfg.ner.params_path:
+            # default cache keeps restarts load-instead-of-retrain; the npz
+            # fingerprint invalidates it on any architecture change
+            params_path = self.cfg.ner.params_path or os.path.join(
+                os.path.expanduser("~"), ".cache", "docqa_tpu", "ner.npz"
+            )
+            self.deid = DeidEngine.trained(
+                self.cfg.ner,
+                params_path=params_path,
+                steps=self.cfg.ner.train_steps,
+                mesh=self.mesh,
+            )
+        else:  # plumbing mode (tests): random-init tagger
+            self.deid = DeidEngine(self.cfg.ner)
         self.generator = GenerateEngine(
             self.cfg.decoder, gen=self.cfg.generate, mesh=self.mesh
         )

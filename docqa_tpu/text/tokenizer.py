@@ -120,6 +120,46 @@ class HashTokenizer(Tokenizer):
         return [int(bucket)]
 
 
+class ShapeHashTokenizer(HashTokenizer):
+    """Hash tokenizer that preserves orthographic shape for NER.
+
+    PHI detection hinges on casing — "Boston" vs "boston" — but an unseen
+    name hashes to a bucket whose embedding carries no case information.  So
+    each word is emitted as ``[shape_marker?, bucket]``: a TITLE / ALLCAPS /
+    HAS-DIGIT marker token (when the word has a notable shape) followed by
+    the case-insensitive hash bucket.  A token-classification model trained
+    on this stream can label a *never-seen* capitalized word from the marker
+    plus bidirectional context ("<TITLE> ? lives in <TITLE> ?"), which is
+    exactly the generalization Presidio gets from spaCy's shape features
+    (reference ``deid-service/anonymizer.py:29-35``).
+
+    ``lowercase=False`` so callers (``deid/engine.py``) pass words through
+    with case intact; the bucket itself is computed case-insensitively.
+    """
+
+    SHAPE_TITLE, SHAPE_UPPER, SHAPE_DIGIT = 5, 6, 7
+
+    def __init__(self, vocab_size: int = 30522):
+        super().__init__(vocab_size, lowercase=False)
+        self._n_reserved = 8  # 5 specials + 3 shape markers
+
+    def _shape(self, word: str) -> Optional[int]:
+        if any(c.isdigit() for c in word):
+            return self.SHAPE_DIGIT
+        if len(word) > 1 and word.isupper():
+            return self.SHAPE_UPPER
+        if word[:1].isupper():
+            return self.SHAPE_TITLE
+        return None
+
+    def word_to_ids(self, word: str) -> List[int]:
+        bucket = self._n_reserved + _fnv1a(word.lower()) % (
+            self.vocab_size - self._n_reserved
+        )
+        shape = self._shape(word)
+        return [bucket] if shape is None else [shape, int(bucket)]
+
+
 class WordPieceTokenizer(Tokenizer):
     """Greedy longest-match-first WordPiece over a BERT ``vocab.txt``."""
 

@@ -26,6 +26,7 @@ TINY = {
     "ner.num_layers": 1,
     "ner.num_heads": 2,
     "ner.mlp_dim": 64,
+    "ner.train_steps": 0,  # plumbing mode; training covered by test_ner_training
     "decoder.hidden_dim": 64,
     "decoder.num_layers": 2,
     "decoder.num_heads": 4,
