@@ -52,18 +52,31 @@ class _Request:
     error: Optional[BaseException] = None
 
 
+# One wait policy for every consumer of a Handle (qa /ask, summarize,
+# generate_texts) — change it here, not at call sites.
+DEFAULT_RESULT_TIMEOUT = 600.0
+
+
 class Handle:
     """Future-like result for a submitted request."""
 
     def __init__(self, req: _Request) -> None:
         self._req = req
 
-    def result(self, timeout: Optional[float] = None) -> List[int]:
+    def result(
+        self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
+    ) -> List[int]:
         if not self._req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if self._req.error is not None:
             raise self._req.error
         return list(self._req.tokens)
+
+    def text(
+        self, tokenizer, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
+    ) -> str:
+        """Wait and detokenize — the shared resolve path."""
+        return tokenizer.decode_ids(self.result(timeout))
 
 
 class ContinuousBatcher:
@@ -223,10 +236,7 @@ class ContinuousBatcher:
     ) -> List[str]:
         """Batch-convenience API (same contract as GenerateEngine)."""
         handles = [self.submit_text(p, max_new_tokens) for p in prompts]
-        return [
-            self.engine.tokenizer.decode_ids(h.result(timeout=600))
-            for h in handles
-        ]
+        return [h.text(self.engine.tokenizer) for h in handles]
 
     def stop(self) -> None:
         with self._cv:

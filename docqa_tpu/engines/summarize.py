@@ -45,11 +45,13 @@ class SummarizeEngine:
         cfg: Optional[SummarizerConfig] = None,
         use_fake: bool = False,
         fake_max_chars: int = 1200,
+        batcher=None,  # ContinuousBatcher: concurrent summaries share slots
     ) -> None:
         self.generator = generator
         self.cfg = cfg or SummarizerConfig()
         self.use_fake = use_fake
         self.fake_max_chars = fake_max_chars
+        self.batcher = batcher
 
     # ---- packing -------------------------------------------------------------
 
@@ -95,18 +97,50 @@ class SummarizeEngine:
 
     # ---- API -----------------------------------------------------------------
 
+    def submit_prompt(self, prompt: str, max_tokens: Optional[int] = None):
+        """Enqueue a summary; returns either the final ``str`` (fake mode /
+        no batcher) or a batcher ``Handle``.  Pass the result to
+        ``resolve()`` — the split lets the HTTP layer wait for decode without
+        occupying the device executor."""
+        if self.use_fake:
+            return prompt[-self.fake_max_chars :]
+        max_tokens = max_tokens or self.cfg.max_summary_tokens
+        if self.batcher is not None:
+            return self.batcher.submit_text(prompt, max_tokens)
+        with span("summarize", DEFAULT_REGISTRY):
+            return self.generator.generate_texts(
+                [prompt], max_new_tokens=max_tokens
+            )[0]
+
+    def resolve(self, pending, timeout: Optional[float] = None) -> str:
+        if isinstance(pending, str):
+            return pending
+        from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
+
+        return pending.text(
+            self.generator.tokenizer, timeout or DEFAULT_RESULT_TIMEOUT
+        )
+
     def summarize_prompt(
         self, prompt: str, max_tokens: Optional[int] = None
     ) -> str:
         """Free-form prompt → summary text (the ``/api/llm/summarize``
         contract the reference declared but never implemented)."""
-        if self.use_fake:
-            return prompt[-self.fake_max_chars :]
-        max_tokens = max_tokens or self.cfg.max_summary_tokens
-        with span("summarize", DEFAULT_REGISTRY):
-            return self.generator.generate_texts(
-                [prompt], max_new_tokens=max_tokens
-            )[0]
+        return self.resolve(self.submit_prompt(prompt, max_tokens))
+
+    def submit_patient(
+        self,
+        patient_id: str,
+        docs: Sequence[Tuple[str, str]],
+        max_tokens: Optional[int] = None,
+    ):
+        body = self._pack_documents(
+            docs, self._doc_budget(SINGLE_PATIENT_TEMPLATE)
+        )
+        prompt = SINGLE_PATIENT_TEMPLATE.format(
+            patient_id=patient_id, documents=body
+        )
+        return self.submit_prompt(prompt, max_tokens)
 
     def summarize_patient(
         self,
@@ -114,20 +148,14 @@ class SummarizeEngine:
         docs: Sequence[Tuple[str, str]],
         max_tokens: Optional[int] = None,
     ) -> str:
-        body = self._pack_documents(
-            docs, self._doc_budget(SINGLE_PATIENT_TEMPLATE)
-        )
-        prompt = SINGLE_PATIENT_TEMPLATE.format(
-            patient_id=patient_id, documents=body
-        )
-        return self.summarize_prompt(prompt, max_tokens)
+        return self.resolve(self.submit_patient(patient_id, docs, max_tokens))
 
-    def compare_patients(
+    def submit_compare(
         self,
         patient_docs: Sequence[Tuple[str, Sequence[Tuple[str, str]]]],
         max_tokens: Optional[int] = None,
-    ) -> str:
-        """[(patient_id, [(doc_id, text)])] → comparative summary.
+    ):
+        """[(patient_id, [(doc_id, text)])] → pending comparative summary.
         Block format mirrors the reference's ``=== PATIENT_x ===`` assembly
         (``routes.py:91-101``)."""
         n = max(1, len(patient_docs))
@@ -137,4 +165,11 @@ class SummarizeEngine:
             body = self._pack_documents(docs, per_patient)
             sections.append(f"=== PATIENT {pid} ===\n{body}")
         prompt = MULTI_PATIENT_TEMPLATE.format(documents="\n\n".join(sections))
-        return self.summarize_prompt(prompt, max_tokens)
+        return self.submit_prompt(prompt, max_tokens)
+
+    def compare_patients(
+        self,
+        patient_docs: Sequence[Tuple[str, Sequence[Tuple[str, str]]]],
+        max_tokens: Optional[int] = None,
+    ) -> str:
+        return self.resolve(self.submit_compare(patient_docs, max_tokens))

@@ -28,6 +28,7 @@ import asyncio
 import concurrent.futures
 import json
 import os
+import time
 from typing import Optional
 
 from docqa_tpu.config import Config, load_config
@@ -90,10 +91,20 @@ class DocQARuntime:
         self.generator = GenerateEngine(
             self.cfg.decoder, gen=self.cfg.generate, mesh=self.mesh
         )
+        # Continuous batcher: the serving path for ALL generation (BASELINE
+        # config 5, QPS 16) — concurrent requests share decode-slot lanes of
+        # one jit program instead of serializing whole requests.
+        if self.cfg.flags.use_fake_llm:
+            self.batcher = None
+        else:
+            from docqa_tpu.engines.serve import ContinuousBatcher
+
+            self.batcher = ContinuousBatcher(self.generator)
         self.summarizer = SummarizeEngine(
             self.generator,
             self.cfg.summarizer,
             use_fake=self.cfg.flags.use_fake_llm,
+            batcher=self.batcher,
         )
 
         self.broker = make_broker(self.cfg.broker, journal_dir=journal_dir)
@@ -113,6 +124,7 @@ class DocQARuntime:
             self.summarizer,
             k=self.cfg.store.default_k,
             use_fake_llm=self.cfg.flags.use_fake_llm,
+            batcher=self.batcher,
         )
         self.synthesis = SynthesisService(
             retrieval=self.qa.patient_snippets, summarizer=self.summarizer
@@ -124,17 +136,23 @@ class DocQARuntime:
 
     def stop(self) -> None:
         self.pipeline.stop()
+        if self.batcher is not None:
+            self.batcher.stop()
         self.broker.close()
         self.registry.close()
 
 
 # ---------------------------------------------------------------------------
-# HTTP layer (aiohttp).  HTTP-initiated device work funnels through one
-# executor thread so concurrent /ask requests queue instead of interleaving
-# decode dispatches (pipeline consumer threads still dispatch their own batch
-# programs — JAX dispatch is thread-safe; this is a latency policy, not a
-# correctness requirement).  Host-only work (extraction, registry IO) runs on
-# a separate pool so uploads don't block QA.
+# HTTP layer (aiohttp).  Three lanes:
+#
+# * device_pool (1 thread) — encode/search dispatches and generation
+#   *submission*.  Retrieval programs stay serialized (latency policy), but
+#   a submission only enqueues into the continuous batcher, so the single
+#   thread never blocks on decoding.
+# * gen_pool (max_concurrent threads) — host-side WAITS on batcher handles.
+#   Concurrent /ask requests decode together in the batcher's slot program;
+#   each waiter just parks here until its lane finishes.
+# * host_pool — extraction/registry IO, so uploads don't block QA.
 # ---------------------------------------------------------------------------
 
 def make_app(rt: DocQARuntime):
@@ -142,6 +160,10 @@ def make_app(rt: DocQARuntime):
 
     device_pool = concurrent.futures.ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="device"
+    )
+    gen_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=max(rt.cfg.generate.max_concurrent, 4),
+        thread_name_prefix="genwait",
     )
     host_pool = concurrent.futures.ThreadPoolExecutor(
         max_workers=4, thread_name_prefix="host"
@@ -152,6 +174,13 @@ def make_app(rt: DocQARuntime):
         return await loop.run_in_executor(
             device_pool, lambda: fn(*args, **kw)
         )
+
+    async def on_gen(fn, *args, **kw):
+        """Blocking waits for batcher results (and, with a batcher present,
+        synthesis flows — their generation rides the batcher, so they must
+        not occupy the single device thread while waiting)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(gen_pool, lambda: fn(*args, **kw))
 
     async def on_host(fn, *args, **kw):
         """Host-only work (extraction, registry/journal IO) — keeps large
@@ -259,7 +288,14 @@ def make_app(rt: DocQARuntime):
             # parity: llm-qa returns 503 when its index is unavailable
             # (main.py:113-114) — ours can only be *empty*, never missing
             return json_error(503, "index is empty; ingest documents first")
-        result = await on_device(rt.qa.ask, q.question)
+        # retrieval + submission on the device lane; decode wait on the gen
+        # lane so N concurrent /ask share batcher slots (≈ solo latency)
+        t0 = time.perf_counter()
+        pending = await on_device(rt.qa.ask_submit, q.question)
+        result = await on_gen(pending.resolve)
+        DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
+            (time.perf_counter() - t0) * 1000
+        )
         return web.json_response(result)
 
     async def patient_snippets(req):
@@ -280,9 +316,17 @@ def make_app(rt: DocQARuntime):
             body = SummarizeRequest(**await req.json())
         except Exception as e:
             return json_error(422, str(e))
-        summary = await on_device(
-            rt.qa.summarize, body.prompt, body.max_tokens
+        t0 = time.perf_counter()
+        pending = await on_device(
+            rt.summarizer.submit_prompt, body.prompt, body.max_tokens
         )
+        summary = await on_gen(rt.summarizer.resolve, pending)
+        if rt.batcher is not None:
+            # the batcher path skips the engine's span("summarize"); record
+            # the e2e latency here so /metrics keeps the serving histogram
+            DEFAULT_REGISTRY.histogram("summarize_ms").observe(
+                (time.perf_counter() - t0) * 1000
+            )
         return web.json_response({"summary": summary})
 
     # ---- synthesis ----------------------------------------------------------
@@ -292,9 +336,10 @@ def make_app(rt: DocQARuntime):
             body = PatientSummaryRequest(**await req.json())
         except Exception as e:
             return json_error(422, str(e))
+        # retrieval/packing on the device lane; decode wait on the gen lane
         try:
-            resp = await on_device(
-                rt.synthesis.patient_summary,
+            finish = await on_device(
+                rt.synthesis.patient_summary_submit,
                 body.patient_id,
                 body.from_date,
                 body.to_date,
@@ -302,6 +347,7 @@ def make_app(rt: DocQARuntime):
             )
         except SynthesisError as e:
             return json_error(e.status, e.detail)
+        resp = await on_gen(finish)
         return web.json_response(json.loads(resp.model_dump_json()))
 
     async def synthese_comparaison(req):
@@ -310,11 +356,14 @@ def make_app(rt: DocQARuntime):
         except Exception as e:
             return json_error(422, str(e))
         try:
-            resp = await on_device(
-                rt.synthesis.patient_comparison, body.patient_ids, body.focus
+            finish = await on_device(
+                rt.synthesis.patient_comparison_submit,
+                body.patient_ids,
+                body.focus,
             )
         except SynthesisError as e:
             return json_error(e.status, e.detail)
+        resp = await on_gen(finish)
         return web.json_response(json.loads(resp.model_dump_json()))
 
     async def index_page(_req):

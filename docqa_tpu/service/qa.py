@@ -17,6 +17,7 @@ never provided (SURVEY §1 "aspirational API layer"):
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
@@ -34,6 +35,29 @@ QA_TEMPLATE = (
 )
 
 
+@dataclass
+class PendingAnswer:
+    """An in-flight ``/ask`` answer: retrieval is done, generation may still
+    be decoding in the continuous batcher.  ``resolve()`` blocks for the
+    tokens (host-side wait — the caller must NOT hold the device executor,
+    that's the whole point of the split)."""
+
+    sources: List[str]
+    answer: Optional[str] = None  # already final (fake mode / inline path)
+    handle: Optional[Any] = None  # engines.serve.Handle when batched
+    tokenizer: Optional[Any] = None
+
+    def resolve(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        answer = self.answer
+        if answer is None:
+            from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
+
+            answer = self.handle.text(
+                self.tokenizer, timeout or DEFAULT_RESULT_TIMEOUT
+            )
+        return {"answer": answer, "sources": self.sources}
+
+
 class QAService:
     def __init__(
         self,
@@ -43,6 +67,7 @@ class QAService:
         summarizer,  # SummarizeEngine
         k: int = 3,
         use_fake_llm: bool = False,
+        batcher=None,  # ContinuousBatcher: concurrent /ask share decode slots
     ) -> None:
         self.encoder = encoder
         self.store = store
@@ -50,28 +75,45 @@ class QAService:
         self.summarizer = summarizer
         self.k = k
         self.use_fake_llm = use_fake_llm
+        self.batcher = batcher
 
     # ---- /ask/ ---------------------------------------------------------------
+
+    def ask_submit(self, question: str, k: Optional[int] = None) -> PendingAnswer:
+        """Retrieval + prompt assembly + generation *submission*.
+
+        With a batcher, returns immediately after enqueueing the decode —
+        concurrent questions ride separate slots of one decode program
+        (BASELINE config 5) instead of serializing whole-request (the round-1
+        flaw: ``make_app``'s 1-worker device executor made QPS-16 impossible).
+        """
+        with span("qa_retrieve", DEFAULT_REGISTRY):
+            emb = self.encoder.encode_texts([question])
+            hits = self.store.search(emb, k=k or self.k)[0]
+        context = "\n\n".join(
+            h.metadata.get("text_content", h.metadata.get("source", ""))
+            for h in hits
+        )
+        prompt = QA_TEMPLATE.format(context=context, question=question)
+        sources = [h.metadata.get("source", "") for h in hits]
+        if self.use_fake_llm:
+            answer = context[:500] if context else "Aucun contexte trouvé."
+            return PendingAnswer(sources=sources, answer=answer)
+        if self.batcher is not None:
+            return PendingAnswer(
+                sources=sources,
+                handle=self.batcher.submit_text(prompt),
+                tokenizer=self.batcher.engine.tokenizer,
+            )
+        return PendingAnswer(
+            sources=sources, answer=self.generator.generate_texts([prompt])[0]
+        )
 
     def ask(self, question: str, k: Optional[int] = None) -> Dict[str, Any]:
         """Returns the reference's response contract
         ``{"answer": ..., "sources": [...]}`` (``llm-qa/main.py:119-122``)."""
         with span("qa_e2e", DEFAULT_REGISTRY):
-            emb = self.encoder.encode_texts([question])
-            hits = self.store.search(emb, k=k or self.k)[0]
-            context = "\n\n".join(
-                h.metadata.get("text_content", h.metadata.get("source", ""))
-                for h in hits
-            )
-            prompt = QA_TEMPLATE.format(context=context, question=question)
-            if self.use_fake_llm:
-                answer = context[:500] if context else "Aucun contexte trouvé."
-            else:
-                answer = self.generator.generate_texts([prompt])[0]
-        return {
-            "answer": answer,
-            "sources": [h.metadata.get("source", "") for h in hits],
-        }
+            return self.ask_submit(question, k).resolve()
 
     # ---- /api/search/patient-snippets ---------------------------------------
 

@@ -18,7 +18,7 @@ The comparison table is computed, not the reference's hardcoded placeholder
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from docqa_tpu.service.schemas import (
     ComparisonRow,
@@ -99,6 +99,41 @@ class SynthesisService:
 
     # ---- POST /api/synthese/patient -----------------------------------------
 
+    def patient_summary_submit(
+        self,
+        patient_id: str,
+        from_date: Optional[str] = None,
+        to_date: Optional[str] = None,
+        focus: Optional[str] = None,
+    ) -> Callable[[], SinglePatientSummaryResponse]:
+        """Retrieval + summary *submission*; the returned thunk waits for the
+        decode and assembles the response.  The HTTP layer runs this on the
+        device lane and the thunk on the wait lane, so concurrent synthesis
+        requests share batcher slots without dispatching retrieval programs
+        from multiple threads."""
+        docs = self.retrieval(patient_id, from_date, to_date, focus)
+        if not docs:
+            raise SynthesisError(
+                404, f"no documents found for patient {patient_id}"
+            )  # parity: routes.py:41-42
+        pending = self.summarizer.submit_patient(
+            patient_id, [(d["doc_id"], d["text"]) for d in docs]
+        )
+
+        def finish() -> SinglePatientSummaryResponse:
+            summary = self.summarizer.resolve(pending)
+            return SinglePatientSummaryResponse(
+                patient_id=patient_id,
+                sections=_split_sections(summary),
+                key_points=_key_points(docs),
+                sources=[
+                    SourceSnippet(doc_id=d["doc_id"], snippet=d["text"][:300])
+                    for d in docs[:5]  # parity: routes.py:67-73
+                ],
+            )
+
+        return finish
+
     def patient_summary(
         self,
         patient_id: str,
@@ -106,31 +141,15 @@ class SynthesisService:
         to_date: Optional[str] = None,
         focus: Optional[str] = None,
     ) -> SinglePatientSummaryResponse:
-        docs = self.retrieval(patient_id, from_date, to_date, focus)
-        if not docs:
-            raise SynthesisError(
-                404, f"no documents found for patient {patient_id}"
-            )  # parity: routes.py:41-42
-        summary = self.summarizer.summarize_patient(
-            patient_id, [(d["doc_id"], d["text"]) for d in docs]
-        )
-        return SinglePatientSummaryResponse(
-            patient_id=patient_id,
-            sections=_split_sections(summary),
-            key_points=_key_points(docs),
-            sources=[
-                SourceSnippet(doc_id=d["doc_id"], snippet=d["text"][:300])
-                for d in docs[:5]  # parity: routes.py:67-73
-            ],
-        )
+        return self.patient_summary_submit(patient_id, from_date, to_date, focus)()
 
     # ---- POST /api/synthese/comparaison -------------------------------------
 
-    def patient_comparison(
+    def patient_comparison_submit(
         self,
         patient_ids: Sequence[str],
         focus: Optional[str] = None,
-    ) -> MultiPatientComparisonResponse:
+    ) -> Callable[[], MultiPatientComparisonResponse]:
         if len(patient_ids) < 2:
             raise SynthesisError(
                 400, "at least two patient_ids are required"
@@ -141,12 +160,32 @@ class SynthesisService:
             per_patient.append((pid, docs[:3]))  # parity: 3 per patient
         if all(not docs for _, docs in per_patient):
             raise SynthesisError(404, "no documents found for any patient")
-        summary = self.summarizer.compare_patients(
+        pending = self.summarizer.submit_compare(
             [
                 (pid, [(d["doc_id"], d["text"]) for d in docs])
                 for pid, docs in per_patient
             ]
         )
+
+        def finish() -> MultiPatientComparisonResponse:
+            summary = self.summarizer.resolve(pending)
+            return self._assemble_comparison(patient_ids, per_patient, summary)
+
+        return finish
+
+    def patient_comparison(
+        self,
+        patient_ids: Sequence[str],
+        focus: Optional[str] = None,
+    ) -> MultiPatientComparisonResponse:
+        return self.patient_comparison_submit(patient_ids, focus)()
+
+    def _assemble_comparison(
+        self,
+        patient_ids: Sequence[str],
+        per_patient: List[Tuple[str, List[Dict[str, str]]]],
+        summary: str,
+    ) -> MultiPatientComparisonResponse:
         table = [
             ComparisonRow(
                 criterion="documents_retrieved",
