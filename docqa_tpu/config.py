@@ -200,6 +200,29 @@ class RegistryConfig:
 
 
 @dataclass(frozen=True)
+class DataConfig:
+    """Startup data lifecycle — the reference's indexer reloaded its saved
+    index on boot and bootstrapped ``default_data/*.csv`` on first start
+    (``semantic-indexer/indexer.py:26-30,97-107``).  Here:
+
+    * ``work_dir`` — persistence root.  The store snapshots under
+      ``<work_dir>/index`` (atomic, versioned) and restores from it on boot;
+      the trained NER params cache also defaults here.  None disables
+      persistence (tests).
+    * ``bootstrap_dir`` — CSV knowledge-base directory, indexed on first
+      boot (only when the restored/fresh store is empty).
+    * ``snapshot_every`` — snapshot after this many indexed documents
+      (the reference rewrote the whole index after EVERY message,
+      ``indexer.py:125``); 0 disables periodic snapshots (shutdown still
+      snapshots when ``work_dir`` is set).
+    """
+
+    work_dir: Optional[str] = None
+    bootstrap_dir: Optional[str] = None
+    snapshot_every: int = 64
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """HTTP surface.  Ports mirror the reference deployment
     (``start_all.bat:18-35``) with the synthese port fixed to match reality
@@ -249,6 +272,7 @@ class Config:
     chunk: ChunkConfig = field(default_factory=ChunkConfig)
     broker: BrokerConfig = field(default_factory=BrokerConfig)
     registry: RegistryConfig = field(default_factory=RegistryConfig)
+    data: DataConfig = field(default_factory=DataConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
     flags: FlagsConfig = field(default_factory=FlagsConfig)
     generate: GenerateConfig = field(default_factory=GenerateConfig)

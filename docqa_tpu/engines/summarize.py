@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence, Tuple
 
 from docqa_tpu.config import SummarizerConfig
+from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
 
 SINGLE_PATIENT_TEMPLATE = (
@@ -112,14 +113,12 @@ class SummarizeEngine:
                 [prompt], max_new_tokens=max_tokens
             )[0]
 
-    def resolve(self, pending, timeout: Optional[float] = None) -> str:
+    def resolve(
+        self, pending, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
+    ) -> str:
         if isinstance(pending, str):
             return pending
-        from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
-
-        return pending.text(
-            self.generator.tokenizer, timeout or DEFAULT_RESULT_TIMEOUT
-        )
+        return pending.text(self.generator.tokenizer, timeout)
 
     def summarize_prompt(
         self, prompt: str, max_tokens: Optional[int] = None

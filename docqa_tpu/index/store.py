@@ -329,16 +329,35 @@ class VectorStore:
                 },
                 f,
             )
-        if os.path.exists(base):  # re-publishing an unchanged version
-            import shutil
+        import shutil
 
-            shutil.rmtree(tmp)
-        else:
-            os.replace(tmp, base)
+        if os.path.exists(base):
+            # Same version number does NOT imply same content: after a
+            # failed restore the runtime starts a fresh store at version 0
+            # in a work dir that still holds old index_vN dirs — publishing
+            # must REPLACE the stale dir, or data ingested since the failure
+            # would be silently dropped while LATEST points at old vectors.
+            shutil.rmtree(base)
+        os.replace(tmp, base)
         latest = os.path.join(directory, "LATEST")
         with open(latest + ".tmp", "w") as f:
             f.write(f"index_v{version}")
         os.replace(latest + ".tmp", latest)
+        # prune superseded snapshots (keep the published one + its
+        # predecessor as a rollback safety net)
+        versions = sorted(
+            (
+                int(d.split("index_v", 1)[1])
+                for d in os.listdir(directory)
+                if d.startswith("index_v")
+                and d.split("index_v", 1)[1].isdigit()
+            ),
+            reverse=True,
+        )
+        for old in versions[2:]:
+            shutil.rmtree(
+                os.path.join(directory, f"index_v{old}"), ignore_errors=True
+            )
         return base
 
     @classmethod

@@ -73,12 +73,45 @@ class DocQARuntime:
             self.encoder = HashEncoder(self.cfg.encoder)
         else:
             self.encoder = EncoderEngine(self.cfg.encoder, mesh=self.mesh)
-        self.store = VectorStore(self.cfg.store, mesh=self.mesh)
+
+        # ---- store: restore-from-snapshot on boot (parity with the
+        # reference's reload, indexer.py:97-101 — minus its unlocked-file
+        # races).  A corrupt/mismatched snapshot logs and serves fresh, the
+        # reference's own degrade-don't-die behavior (llm-qa/main.py:61-62).
+        self._index_dir = (
+            os.path.join(self.cfg.data.work_dir, "index")
+            if self.cfg.data.work_dir
+            else None
+        )
+        self._docs_since_snapshot = 0
+        self.store = None
+        if self._index_dir and os.path.exists(
+            os.path.join(self._index_dir, "LATEST")
+        ):
+            try:
+                self.store = VectorStore.restore(
+                    self._index_dir, self.cfg.store, mesh=self.mesh
+                )
+                log.info(
+                    "restored index v%d (%d rows) from %s",
+                    self.store.version, self.store.count, self._index_dir,
+                )
+            except Exception:
+                log.exception(
+                    "index restore failed; starting with an empty store"
+                )
+        if self.store is None:
+            self.store = VectorStore(self.cfg.store, mesh=self.mesh)
+
         if self.cfg.ner.train_steps > 0 or self.cfg.ner.params_path:
             # default cache keeps restarts load-instead-of-retrain; the npz
             # fingerprint invalidates it on any architecture change
-            params_path = self.cfg.ner.params_path or os.path.join(
-                os.path.expanduser("~"), ".cache", "docqa_tpu", "ner.npz"
+            params_path = self.cfg.ner.params_path or (
+                os.path.join(self.cfg.data.work_dir, "ner.npz")
+                if self.cfg.data.work_dir
+                else os.path.join(
+                    os.path.expanduser("~"), ".cache", "docqa_tpu", "ner.npz"
+                )
             )
             self.deid = DeidEngine.trained(
                 self.cfg.ner,
@@ -116,7 +149,19 @@ class DocQARuntime:
             self.deid,
             self.encoder,
             self.store,
+            on_indexed=self._on_indexed,
         )
+
+        # ---- first-boot knowledge base (parity: indexer.py:102-107 indexed
+        # default_data/*.csv into an otherwise-empty index)
+        if self.cfg.data.bootstrap_dir and self.store.count == 0:
+            from docqa_tpu.service.bootstrap import bootstrap_csv_dir
+
+            n = bootstrap_csv_dir(
+                self.cfg.data.bootstrap_dir, self.encoder, self.store
+            )
+            if n and self._index_dir:
+                self._snapshot()
         self.qa = QAService(
             self.encoder,
             self.store,
@@ -134,10 +179,34 @@ class DocQARuntime:
         self.pipeline.start()
         return self
 
+    # ---- persistence hooks ---------------------------------------------------
+
+    def _snapshot(self) -> None:
+        if not self._index_dir:
+            return
+        try:
+            self.store.snapshot(self._index_dir)
+            self._docs_since_snapshot = 0
+        except Exception:
+            log.exception("index snapshot failed")
+
+    def _on_indexed(self, n_docs: int) -> None:
+        """Called by the index worker after each indexed batch — snapshots
+        every ``data.snapshot_every`` documents (the reference rewrote the
+        full index after EVERY message, ``indexer.py:125``)."""
+        if not self._index_dir or self.cfg.data.snapshot_every <= 0:
+            return
+        self._docs_since_snapshot += n_docs
+        if self._docs_since_snapshot >= self.cfg.data.snapshot_every:
+            self._snapshot()
+
     def stop(self) -> None:
         self.pipeline.stop()
         if self.batcher is not None:
             self.batcher.stop()
+        # final snapshot so a restart resumes exactly here (kill-and-restart
+        # loses nothing; the reference lost everything after its last save)
+        self._snapshot()
         self.broker.close()
         self.registry.close()
 

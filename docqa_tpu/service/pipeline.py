@@ -46,6 +46,7 @@ class DocumentPipeline:
         encoder_engine,  # EncoderEngine
         store,  # VectorStore
         http_extractor=None,
+        on_indexed=None,  # Callable[[int], None]: docs indexed per batch
     ) -> None:
         self.cfg = cfg
         self.broker = broker
@@ -54,6 +55,7 @@ class DocumentPipeline:
         self.encoder = encoder_engine
         self.store = store
         self.http_extractor = http_extractor
+        self.on_indexed = on_indexed
         self._consumers = [
             Consumer(
                 broker,
@@ -199,6 +201,13 @@ class DocumentPipeline:
                 self.store.add(embeddings, all_meta)
         # vectors are committed past this point: never raise (a retry would
         # re-encode and re-append the whole batch)
+        if self.on_indexed is not None and per_doc:
+            # BEFORE the status writes: with snapshot_every=1 an INDEXED
+            # status then implies the vectors are already durable
+            try:
+                self.on_indexed(len(per_doc))
+            except Exception:
+                log.exception("on_indexed hook failed")
         for doc_id, n in per_doc:
             try:
                 self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)

@@ -20,6 +20,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
 
 # Our own QA template; same *shape* as the reference's French TCM-expert
@@ -47,14 +48,12 @@ class PendingAnswer:
     handle: Optional[Any] = None  # engines.serve.Handle when batched
     tokenizer: Optional[Any] = None
 
-    def resolve(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+    def resolve(
+        self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
+    ) -> Dict[str, Any]:
         answer = self.answer
         if answer is None:
-            from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
-
-            answer = self.handle.text(
-                self.tokenizer, timeout or DEFAULT_RESULT_TIMEOUT
-            )
+            answer = self.handle.text(self.tokenizer, timeout)
         return {"answer": answer, "sources": self.sources}
 
 

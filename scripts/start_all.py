@@ -31,6 +31,20 @@ def main() -> None:
     ap.add_argument(
         "--cpu", action="store_true", help="force the CPU backend (dev/test)"
     )
+    ap.add_argument(
+        "--work-dir",
+        type=str,
+        default="docqa_work",
+        help="persistence root (index snapshots + NER cache); '' disables",
+    )
+    ap.add_argument(
+        "--data-dir",
+        type=str,
+        default=None,
+        help="CSV knowledge-base dir for first-boot bootstrap "
+        "(default: the packaged default_data, parity with "
+        "semantic-indexer/default_data)",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -39,15 +53,22 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
+    import docqa_tpu
     from docqa_tpu.config import load_config
     from docqa_tpu.service.app import serve
 
-    overrides = None
+    overrides = {}
     if args.config:
         import json
 
         with open(args.config) as f:
             overrides = json.load(f)
+    overrides.setdefault("data.work_dir", args.work_dir or None)
+    overrides.setdefault(
+        "data.bootstrap_dir",
+        args.data_dir
+        or os.path.join(os.path.dirname(docqa_tpu.__file__), "default_data"),
+    )
     serve(load_config(overrides=overrides), port=args.port)
 
 

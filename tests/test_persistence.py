@@ -1,0 +1,190 @@
+"""Runtime data lifecycle: restore-on-boot, first-boot bootstrap, periodic
+snapshots (VERDICT round-1 item 4).
+
+Reference behavior being matched: the indexer reloaded its saved index on
+start, bootstrapped ``default_data/*.csv`` into an empty one, and saved
+after every message (``semantic-indexer/indexer.py:26-30,97-107,125``).
+Round 1 had all the pieces (snapshot/restore, bootstrap) but nothing called
+them — a restart lost the entire index.
+"""
+
+import os
+
+import pytest
+
+from docqa_tpu.config import load_config
+from docqa_tpu.service.app import DocQARuntime
+
+TINY = {
+    "encoder.hidden_dim": 64,
+    "encoder.num_layers": 1,
+    "encoder.num_heads": 4,
+    "encoder.mlp_dim": 128,
+    "encoder.embed_dim": 64,
+    "store.dim": 64,
+    "store.shard_capacity": 256,
+    "ner.train_steps": 0,
+    "decoder.hidden_dim": 64,
+    "decoder.num_layers": 1,
+    "decoder.num_heads": 4,
+    "decoder.num_kv_heads": 2,
+    "decoder.head_dim": 16,
+    "decoder.mlp_dim": 128,
+    "decoder.vocab_size": 512,
+    "generate.max_new_tokens": 8,
+    "flags.use_fake_llm": True,
+    "flags.use_fake_encoder": True,
+}
+
+
+def _cfg(tmp_path, **extra):
+    overrides = dict(TINY)
+    overrides["data.work_dir"] = str(tmp_path / "work")
+    overrides.update(extra)
+    return load_config(env={}, overrides=overrides)
+
+
+NOTE = "Aspirin 100 mg daily was prescribed after the cardiac event."
+
+
+class TestKillAndRestart:
+    def test_restart_preserves_ingested_documents(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        rt1 = DocQARuntime(cfg).start()
+        rec = rt1.pipeline.ingest_document(
+            "note.txt", NOTE.encode(), patient_id="p1"
+        )
+        assert rt1.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        count = rt1.store.count
+        assert count >= 1
+        rt1.stop()  # final snapshot
+
+        rt2 = DocQARuntime(cfg).start()
+        try:
+            assert rt2.store.count == count
+            # previously ingested content is still answerable
+            out = rt2.qa.ask("aspirin dose?")
+            assert out["sources"]
+            rows = rt2.qa.patient_snippets("p1")
+            assert rows and "Aspirin" in rows[0]["text"]
+        finally:
+            rt2.stop()
+
+    def test_no_workdir_means_no_persistence(self, tmp_path):
+        cfg = load_config(env={}, overrides=dict(TINY))
+        rt = DocQARuntime(cfg).start()
+        rec = rt.pipeline.ingest_document("n.txt", NOTE.encode())
+        assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        rt.stop()
+        assert not (tmp_path / "work").exists()
+
+
+class TestPeriodicSnapshot:
+    def test_snapshot_every_doc(self, tmp_path):
+        cfg = _cfg(tmp_path, **{"data.snapshot_every": 1})
+        rt = DocQARuntime(cfg).start()
+        try:
+            rec = rt.pipeline.ingest_document("n.txt", NOTE.encode())
+            assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+            # snapshot happened from the index worker, before any shutdown
+            latest = os.path.join(str(tmp_path / "work"), "index", "LATEST")
+            assert os.path.exists(latest)
+        finally:
+            rt.stop()
+
+
+class TestSnapshotVersioning:
+    def _store(self, rows, tag):
+        import numpy as np
+
+        from docqa_tpu.config import StoreConfig
+        from docqa_tpu.index.store import VectorStore
+
+        cfg = StoreConfig(dim=8, shard_capacity=128, dtype="float32")
+        s = VectorStore(cfg)
+        vecs = np.eye(8, dtype=np.float32)[:rows]
+        s.add(vecs, [{"tag": tag, "i": i} for i in range(rows)])
+        return cfg, s
+
+    def test_snapshot_replaces_stale_same_version_dir(self, tmp_path):
+        """Review regression: after a failed restore the runtime starts a
+        fresh store whose version counter restarts, so a later snapshot can
+        collide with an old index_vN dir — it must REPLACE it, not keep the
+        stale vectors while claiming success."""
+        from docqa_tpu.index.store import VectorStore
+
+        d = str(tmp_path / "index")
+        cfg, s1 = self._store(2, "old")
+        s1.snapshot(d)
+        # fresh store, version counter reset, different content
+        _, s2 = self._store(3, "new")
+        assert s2.version == s1.version  # same version number by construction
+        s2.snapshot(d)
+        s3 = VectorStore.restore(d, cfg)
+        assert s3.count == 3
+        assert all(m["tag"] == "new" for m in s3.metadata_rows())
+
+    def test_old_snapshots_pruned(self, tmp_path):
+        import os
+
+        d = str(tmp_path / "index")
+        cfg, s = self._store(1, "x")
+        import numpy as np
+
+        for i in range(5):
+            s.add(np.eye(8, dtype=np.float32)[i + 1 : i + 2], [{"i": i}])
+            s.snapshot(d)
+        dirs = [p for p in os.listdir(d) if p.startswith("index_v")]
+        assert len(dirs) <= 2  # published + one rollback predecessor
+
+
+class TestBootstrap:
+    @pytest.fixture()
+    def kb_dir(self, tmp_path):
+        d = tmp_path / "kb"
+        d.mkdir()
+        (d / "matrice_test.csv").write_text(
+            "nom_syndrome,nom_latin,nom_chinois,score_role\n"
+            "Vide de Qi,Astragalus membranaceus,Huang Qi,9\n"
+            "Vide de Qi,Panax ginseng,Ren Shen,8\n"
+        )
+        return str(d)
+
+    def test_first_boot_bootstraps_then_restore_not_rebootstrap(
+        self, tmp_path, kb_dir
+    ):
+        cfg = _cfg(tmp_path, **{"data.bootstrap_dir": kb_dir})
+        rt1 = DocQARuntime(cfg).start()
+        count = rt1.store.count
+        assert count == 2  # both CSV rows searchable on first boot
+        v1 = rt1.store.version
+        rt1.stop()
+
+        rt2 = DocQARuntime(cfg).start()
+        try:
+            # restored, not re-bootstrapped: same rows, version carried over
+            assert rt2.store.count == count
+            assert rt2.store.version == v1
+            kb = [
+                r
+                for r in rt2.store.metadata_rows()
+                if r.get("type") == "knowledge_base"
+            ]
+            assert len(kb) == 2
+        finally:
+            rt2.stop()
+
+    def test_packaged_default_data(self, tmp_path):
+        import docqa_tpu
+
+        default_dir = os.path.join(
+            os.path.dirname(docqa_tpu.__file__), "default_data"
+        )
+        cfg = _cfg(tmp_path, **{"data.bootstrap_dir": default_dir})
+        rt = DocQARuntime(cfg).start()
+        try:
+            assert rt.store.count == 20  # 10 matrice + 10 base rows
+            out = rt.qa.ask("Quelle plante pour le Vide de Qi ?")
+            assert out["sources"]
+        finally:
+            rt.stop()
