@@ -1,3 +1,4 @@
 from docqa_tpu.index.store import SearchResult, VectorStore
+from docqa_tpu.index.tiered import TieredIndex
 
-__all__ = ["VectorStore", "SearchResult"]
+__all__ = ["VectorStore", "SearchResult", "TieredIndex"]

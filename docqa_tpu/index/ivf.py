@@ -68,10 +68,15 @@ def kmeans(
     n_iters: int = 10,
     seed: int = 0,
     sample: Optional[int] = 262_144,
+    n_assign: int = 1,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Fit centroids (on a subsample for huge corpora), assign every row.
+    """Fit centroids (on a subsample for huge corpora), assign every row to
+    its ``n_assign`` nearest cells.
 
-    Returns (centroids [C, d] float32, assignments [n] int32)."""
+    Returns (centroids [C, d] float32, assignments [n, n_assign] int32).
+    ``n_assign > 1`` is redundant assignment: each row lives in several
+    cells, trading cell memory for recall at fixed nprobe (boundary rows
+    stop being missable)."""
     vectors = np.asarray(vectors, np.float32)
     n = len(vectors)
     rng = np.random.default_rng(seed)
@@ -83,12 +88,14 @@ def kmeans(
         jnp.asarray(fit_on), jnp.asarray(init), n_iters, n_clusters
     )
     # final assignment over the full corpus, blocked to bound device memory
+    n_assign = min(n_assign, n_clusters)
     assigns = []
     block = 1 << 18
     cT = centroids.T
     for start in range(0, n, block):
         scores = jnp.asarray(vectors[start : start + block]) @ cT
-        assigns.append(np.asarray(jnp.argmax(scores, axis=1)))
+        _, top = jax.lax.top_k(scores, n_assign)
+        assigns.append(np.asarray(top))
     return np.asarray(centroids), np.concatenate(assigns).astype(np.int32)
 
 
@@ -136,8 +143,11 @@ class IVFIndex:
     """Coarse-quantized cosine search over a fixed corpus snapshot.
 
     Build once from vectors+metadata (or straight from a ``VectorStore``);
-    rebuild periodically as the store grows — the serving pattern is exact
-    search over the live append buffer + IVF over the compacted bulk.
+    rebuild periodically as the store grows — the serving pattern (exact
+    search over the live append tail + IVF over the compacted bulk, with
+    background rebuild and host top-k merge) is implemented by
+    ``index/tiered.py:TieredIndex`` and enabled via
+    ``StoreConfig.serving_index="tiered"``.
     """
 
     def __init__(
@@ -150,6 +160,7 @@ class IVFIndex:
         n_iters: int = 10,
         seed: int = 0,
         dtype: str = "bfloat16",
+        n_assign: int = 2,
     ) -> None:
         vectors = np.asarray(vectors, np.float32)
         n, d = vectors.shape
@@ -161,22 +172,35 @@ class IVFIndex:
         c = n_clusters or max(1, int(np.sqrt(max(n, 1))))
         self.n_clusters = c
         self.nprobe = min(nprobe, c)
+        self.n_assign = max(1, min(n_assign, c))
         self._dtype = jnp.dtype(dtype)
 
         with span("ivf_build", DEFAULT_REGISTRY):
-            centroids, assign = kmeans(vectors, c, n_iters=n_iters, seed=seed)
-            cap = max(8, int(np.ceil(cap_factor * n / c)))
+            centroids, assign = kmeans(
+                vectors, c, n_iters=n_iters, seed=seed, n_assign=self.n_assign
+            )
+            cap = max(8, int(np.ceil(cap_factor * self.n_assign * n / c)))
             cells = np.zeros((c, cap, d), np.float32)
             cell_ids = np.full((c, cap), -1, np.int32)
             fill = np.zeros((c,), np.int64)
             spill_rows: List[int] = []
-            for i, a in enumerate(assign):
-                if fill[a] < cap:
-                    cells[a, fill[a]] = vectors[i]
-                    cell_ids[a, fill[a]] = i
-                    fill[a] += 1
+            for i in range(n):
+                # primary copy: its nearest cell, or the exact-scanned spill
+                # buffer on overflow — every row stays findable at nprobe=1
+                primary = assign[i, 0]
+                if fill[primary] < cap:
+                    cells[primary, fill[primary]] = vectors[i]
+                    cell_ids[primary, fill[primary]] = i
+                    fill[primary] += 1
                 else:
                     spill_rows.append(i)
+                # redundant copies are opportunistic: placed when the cell
+                # has room, silently dropped otherwise
+                for a in assign[i, 1:]:
+                    if fill[a] < cap:
+                        cells[a, fill[a]] = vectors[i]
+                        cell_ids[a, fill[a]] = i
+                        fill[a] += 1
             spill_n = max(1, len(spill_rows))
             spill = np.zeros((spill_n, d), np.float32)
             spill_ids = np.full((spill_n,), -1, np.int32)
@@ -226,7 +250,10 @@ class IVFIndex:
         )
         nprobe = min(nprobe or self.nprobe, self.n_clusters)
         k_eff = min(k, self.n)
-        fn = self._get_fn(len(qn), k_eff, nprobe)
+        # over-fetch when rows live in multiple cells: the raw top list can
+        # contain duplicate row ids, which the host dedups back down to k
+        fetch = min(k_eff * self.n_assign, self.n * self.n_assign)
+        fn = self._get_fn(len(qn), fetch, nprobe)
         with span("ivf_search", DEFAULT_REGISTRY):
             vals, ids = fn(
                 self._cells,
@@ -241,9 +268,13 @@ class IVFIndex:
         out = []
         for qi in range(len(qn)):
             row = []
+            seen = set()
             for score, rid in zip(vals[qi], ids[qi]):
-                if rid < 0 or score <= NEG_INF / 2:
+                if rid < 0 or score <= NEG_INF / 2 or int(rid) in seen:
                     continue
+                seen.add(int(rid))
                 row.append((float(score), int(rid), self._meta[int(rid)]))
+                if len(row) >= k_eff:
+                    break
             out.append(row)
         return out

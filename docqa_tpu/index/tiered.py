@@ -1,0 +1,236 @@
+"""Tiered serving index: IVF over the compacted bulk + exact over the tail.
+
+This is the composition ``index/ivf.py`` promises: the live ``VectorStore``
+stays the single source of truth (appends, snapshots, metadata, filters);
+an ``IVFIndex`` is periodically rebuilt from a consistent snapshot and
+serves the *bulk* of the corpus with ``nprobe/n_clusters`` of the HBM
+reads, while rows appended since the last rebuild — the *tail* — are
+scored exactly (they are few, and recall on fresh documents must be 1.0:
+"just ingested but unfindable" was the reference's defining race,
+``llm-qa/main.py:35`` loads once at startup).
+
+Query plan:
+
+* unfiltered: IVF probe over bulk  ∪  exact matmul over the tail bucket →
+  host top-k merge of ~2k candidates;
+* filtered (patient snippets): delegate to the exact store — filters
+  target small row subsets where masked exact search is both correct and
+  cheap, and IVF cells carry no metadata columns;
+* rebuild: when the tail outgrows ``rebuild_tail_rows``, a background
+  thread rebuilds from ``store.vectors_snapshot()`` and atomically swaps
+  ``(ivf, covered)``; serving never blocks on a rebuild.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from docqa_tpu.index.ivf import IVFIndex
+from docqa_tpu.index.store import NEG_INF, SearchResult, VectorStore
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+from docqa_tpu.utils import round_up
+
+log = get_logger("docqa.tiered")
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _tail_kernel(tail, queries, n_live, k: int):
+    """Exact cosine top-k over the padded tail bucket [T, d]."""
+    scores = jax.lax.dot_general(
+        queries, tail, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [q, T]
+    rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(rows < n_live, scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+class TieredIndex:
+    """Serving facade over (VectorStore, IVFIndex) with the store's search
+    signature — drop-in for ``QAService``."""
+
+    def __init__(
+        self,
+        store: VectorStore,
+        nprobe: int = 32,
+        min_rows: int = 50_000,
+        rebuild_tail_rows: int = 100_000,
+        n_clusters: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        self.store = store
+        self.nprobe = nprobe
+        self.min_rows = min_rows
+        self.rebuild_tail_rows = rebuild_tail_rows
+        self.n_clusters = n_clusters
+        self.seed = seed
+        # the active tier is published as ONE tuple (ivf, covered) — readers
+        # take a single reference so they can never pair an old IVF with a
+        # new watermark (rows in between would vanish from results)
+        self._tier: Optional[tuple] = None  # (IVFIndex, covered_rows)
+        self._rebuild_lock = threading.Lock()
+        self._rebuilding = False
+        # device-resident tail: (covered, count, padded_dev, n_live, meta);
+        # rebuilt only when the store grows, so queries between appends pay
+        # zero host→device traffic
+        self._tail_cache: Optional[tuple] = None
+
+    # ---- rebuild -------------------------------------------------------------
+
+    @property
+    def covered(self) -> int:
+        tier = self._tier
+        return tier[1] if tier else 0
+
+    @property
+    def tail_rows(self) -> int:
+        return self.store.count - self.covered
+
+    def rebuild(self) -> bool:
+        """Synchronous rebuild from a consistent store snapshot; returns
+        whether an IVF tier is now active (False below ``min_rows`` — exact
+        search is already optimal there)."""
+        vectors, meta = self.store.vectors_snapshot()
+        if len(vectors) < self.min_rows:
+            return self._tier is not None
+        with span("tiered_rebuild", DEFAULT_REGISTRY):
+            ivf = IVFIndex(
+                vectors,
+                meta,
+                n_clusters=self.n_clusters,
+                nprobe=self.nprobe,
+                seed=self.seed,
+                dtype=str(self.store.cfg.dtype),
+            )
+        self._tier = (ivf, len(vectors))  # single-reference publish
+        log.info("tiered: ivf tier now covers %d rows", len(vectors))
+        return True
+
+    def _maybe_background_rebuild(self) -> None:
+        if self.tail_rows < self.rebuild_tail_rows and self._tier is not None:
+            return
+        if self.store.count < self.min_rows:
+            return
+        with self._rebuild_lock:
+            if self._rebuilding:
+                return
+            self._rebuilding = True
+
+        def run():
+            try:
+                self.rebuild()
+            except Exception:
+                log.exception("tiered rebuild failed")
+            finally:
+                with self._rebuild_lock:
+                    self._rebuilding = False
+
+        threading.Thread(target=run, daemon=True, name="ivf-rebuild").start()
+
+    # ---- search --------------------------------------------------------------
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: Optional[int] = None,
+        where: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        filters: Optional[Dict[str, Any]] = None,
+    ) -> List[List[SearchResult]]:
+        self._maybe_background_rebuild()
+        tier = self._tier  # one read: (ivf, covered) stay consistent
+        if tier is None or where is not None or filters:
+            # filtered or pre-IVF: masked exact search is the right tool
+            return self.store.search(queries, k=k, where=where, filters=filters)
+        ivf, covered = tier
+
+        k = k or self.store.cfg.default_k
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        with span("tiered_search", DEFAULT_REGISTRY):
+            bulk = ivf.search(queries, k=k, nprobe=self.nprobe)
+
+            _, _, tail_dev, n_live, tail_meta = self._tail_device(covered)
+            if n_live == 0:
+                return [
+                    [SearchResult(s, rid, md) for s, rid, md in row[:k]]
+                    for row in bulk
+                ]
+            qn = queries / np.maximum(
+                np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+            )
+            k_tail = min(k, n_live)
+            vals, ids = _tail_kernel(
+                tail_dev,
+                jnp.asarray(qn, self.store._dtype),
+                jnp.int32(n_live),
+                k_tail,
+            )
+            vals = np.asarray(vals, np.float32)
+            ids = np.asarray(ids)
+
+        out: List[List[SearchResult]] = []
+        for qi in range(len(queries)):
+            cands: List[SearchResult] = [
+                SearchResult(s, rid, md) for s, rid, md in bulk[qi]
+            ]
+            for s, tid in zip(vals[qi], ids[qi]):
+                if s <= NEG_INF / 2:
+                    continue
+                cands.append(
+                    SearchResult(float(s), covered + int(tid), tail_meta[int(tid)])
+                )
+            cands.sort(key=lambda r: -r.score)
+            out.append(cands[:k])
+        return out
+
+    def _tail_snapshot(self, covered: int):
+        """Consistent (vectors, metadata) for rows [covered, count)."""
+        with self.store._lock:
+            count = self.store._count
+            return (
+                self.store._host[covered:count].copy(),
+                list(self.store._meta[covered:count]),
+            )
+
+    def _tail_device(self, covered: int):
+        """Device-resident padded tail, rebuilt only when the store has
+        grown — the per-query cost is zero host→device traffic (a naive
+        re-upload would move the whole tail across PCIe on every search).
+        Returns (covered, count, padded_dev, n_live, meta)."""
+        cache = self._tail_cache
+        if cache is not None and cache[0] == covered:
+            if cache[1] == self.store.count:
+                return cache
+        vecs, meta = self._tail_snapshot(covered)
+        n_live = len(vecs)
+        bucket = round_up(max(n_live, 1), 4096)  # stable jit shapes
+        padded = np.zeros((bucket, self.store.cfg.dim), np.float32)
+        padded[:n_live] = vecs
+        cache = (
+            covered,
+            covered + n_live,
+            jnp.asarray(padded, self.store._dtype),
+            n_live,
+            meta,
+        )
+        self._tail_cache = cache
+        return cache
+
+    # ---- store passthroughs (QAService drop-in) -----------------------------
+
+    @property
+    def count(self) -> int:
+        return self.store.count
+
+    def metadata_select(self, limit=None, **filters):
+        return self.store.metadata_select(limit=limit, **filters)
+
+    def metadata_rows(self):
+        return self.store.metadata_rows()

@@ -103,6 +103,21 @@ class DocQARuntime:
         if self.store is None:
             self.store = VectorStore(self.cfg.store, mesh=self.mesh)
 
+        # serving index: exact store, or the tiered IVF+tail composition
+        # for beyond-exact-scale corpora (store stays the ingest target and
+        # source of truth either way)
+        if self.cfg.store.serving_index == "tiered":
+            from docqa_tpu.index.tiered import TieredIndex
+
+            self.search_index = TieredIndex(
+                self.store,
+                nprobe=self.cfg.store.ivf_nprobe,
+                min_rows=self.cfg.store.ivf_min_rows,
+                rebuild_tail_rows=self.cfg.store.ivf_rebuild_tail,
+            )
+        else:
+            self.search_index = self.store
+
         if self.cfg.ner.train_steps > 0 or self.cfg.ner.params_path:
             # default cache keeps restarts load-instead-of-retrain; the npz
             # fingerprint invalidates it on any architecture change
@@ -164,7 +179,7 @@ class DocQARuntime:
                 self._snapshot()
         self.qa = QAService(
             self.encoder,
-            self.store,
+            self.search_index,
             self.generator,
             self.summarizer,
             k=self.cfg.store.default_k,
@@ -371,13 +386,16 @@ def make_app(rt: DocQARuntime):
         pid = req.query.get("patient_id")
         if not pid:
             return json_error(422, "patient_id is required")
-        rows = await on_device(
-            rt.qa.patient_snippets,
-            pid,
-            req.query.get("from_date"),
-            req.query.get("to_date"),
-            req.query.get("focus"),
-        )
+        try:
+            rows = await on_device(
+                rt.qa.patient_snippets,
+                pid,
+                req.query.get("from_date"),
+                req.query.get("to_date"),
+                req.query.get("focus"),
+            )
+        except ValueError as e:  # malformed date bounds reject loudly
+            return json_error(422, str(e))
         return web.json_response(rows)
 
     async def llm_summarize(req):

@@ -21,10 +21,15 @@ def test_kmeans_clusters_separate_data():
     xn = x / np.linalg.norm(x, axis=1, keepdims=True)
     centroids, assign = kmeans(xn, 8, n_iters=15)
     assert centroids.shape == (8, 64)
-    assert assign.shape == (2000,)
+    assert assign.shape == (2000, 1)  # [n, n_assign]
     # every centroid is unit-norm and at least most cells are populated
     np.testing.assert_allclose(np.linalg.norm(centroids, axis=1), 1.0, atol=1e-3)
     assert len(np.unique(assign)) >= 6
+
+    # redundant assignment: second column is the second-nearest cell
+    _, assign2 = kmeans(xn, 8, n_iters=15, n_assign=2)
+    assert assign2.shape == (2000, 2)
+    assert (assign2[:, 0] != assign2[:, 1]).all()
 
 
 def test_recall_vs_exact():

@@ -72,6 +72,116 @@ class TestFilters:
         assert res == []
 
 
+class TestColumnarFilters:
+    """Vectorized metadata filters (VERDICT round-1 item 7): numpy columns
+    instead of an O(corpus) Python predicate per search."""
+
+    def _store(self, n=60):
+        store = VectorStore(CFG)
+        v = _rand_vectors(n, 64)
+        meta = [
+            {
+                "doc_id": f"d{i}",
+                "patient_id": f"P{i % 3}" if i % 5 else None,
+                "doc_type": "consult" if i % 2 else "labs",
+                "doc_date": f"2024-0{1 + i % 9}-15" if i % 4 else None,
+            }
+            for i in range(n)
+        ]
+        store.add(v, meta)
+        return store, v, meta
+
+    def test_matches_predicate_semantics(self):
+        store, v, meta = self._store()
+
+        def belongs(md):
+            if md.get("patient_id") != "P1":
+                return False
+            d = md.get("doc_date")
+            if d is None or d < "2024-03-01":
+                return False
+            if d > "2024-07-31":
+                return False
+            return True
+
+        filters = {
+            "patient_id": "P1",
+            "date_from": "2024-03-01",
+            "date_to": "2024-07-31",
+        }
+        got = store.search(v[0], k=60, filters=filters)[0]
+        want = store.search(v[0], k=60, where=belongs)[0]
+        assert [r.row_id for r in got] == [r.row_id for r in want]
+        assert got  # the fixture produces matches
+
+    def test_doc_type_filter(self):
+        store, v, _ = self._store()
+        res = store.search(v[0], k=60, filters={"doc_type": "labs"})[0]
+        assert res and all(r.metadata["doc_type"] == "labs" for r in res)
+
+    def test_unseen_value_matches_nothing(self):
+        store, v, _ = self._store()
+        assert store.search(v[0], k=5, filters={"patient_id": "ghost"})[0] == []
+
+    def test_unknown_filter_key_raises(self):
+        store, v, _ = self._store()
+        with pytest.raises(ValueError, match="unknown filter"):
+            store.search(v[0], k=5, filters={"patiend_id": "P1"})
+
+    def test_malformed_date_bound_raises(self):
+        # silent mis-parses would change medical-record query semantics
+        store, v, _ = self._store()
+        for bad in ("2024-3-1", "05/01/24", "garbage"):
+            with pytest.raises(ValueError, match="ISO date"):
+                store.search(v[0], k=5, filters={"date_from": bad})
+            with pytest.raises(ValueError, match="ISO date"):
+                store.metadata_select(date_to=bad)
+
+    def test_filters_compose_with_where(self):
+        store, v, _ = self._store()
+        res = store.search(
+            v[0],
+            k=60,
+            filters={"patient_id": "P1"},
+            where=lambda m: m["doc_type"] == "labs",
+        )[0]
+        assert all(
+            r.metadata["patient_id"] == "P1" and r.metadata["doc_type"] == "labs"
+            for r in res
+        )
+
+    def test_metadata_select(self):
+        store, _, meta = self._store()
+        rows = store.metadata_select(patient_id="P2")
+        want = [m for m in meta if m.get("patient_id") == "P2"]
+        assert [r["doc_id"] for r in rows] == [m["doc_id"] for m in want]
+        assert store.metadata_select(patient_id="P2", limit=2) == rows[:2]
+
+    def test_mask_build_is_vectorized_at_scale(self):
+        """Host-side mask cost at 200k rows stays in the millisecond range
+        (the Python-predicate path took ~100ms+ here, ~1M calls at target
+        scale).  Generous bound to stay CI-safe."""
+        import time
+
+        store = VectorStore(StoreConfig(dim=8, shard_capacity=1024, dtype="float32"))
+        n = 200_000
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(n, 8)).astype(np.float32)
+        meta = [
+            {"doc_id": i, "patient_id": f"P{i % 997}", "doc_date": "2024-05-01"}
+            for i in range(n)
+        ]
+        store.add(vecs, meta)
+        store._filter_mask_locked({"patient_id": "P7"})  # warm
+        t0 = time.perf_counter()
+        mask = store._filter_mask_locked(
+            {"patient_id": "P7", "date_from": "2024-01-01"}
+        )
+        dt_ms = (time.perf_counter() - t0) * 1000
+        assert mask.sum() == len([i for i in range(n) if i % 997 == 7])
+        assert dt_ms < 25, dt_ms
+
+
 class TestGrowth:
     def test_grow_past_capacity(self):
         store = VectorStore(CFG)  # capacity rounds to 256
