@@ -251,8 +251,10 @@ class IVFIndex:
         nprobe = min(nprobe or self.nprobe, self.n_clusters)
         k_eff = min(k, self.n)
         # over-fetch when rows live in multiple cells: the raw top list can
-        # contain duplicate row ids, which the host dedups back down to k
-        fetch = min(k_eff * self.n_assign, self.n * self.n_assign)
+        # contain duplicate row ids, which the host dedups back down to k —
+        # clamped to the probed candidate pool (top_k beyond it would crash)
+        pool = nprobe * self.cap + int(self._spill_ids.shape[0])
+        fetch = min(k_eff * self.n_assign, pool)
         fn = self._get_fn(len(qn), fetch, nprobe)
         with span("ivf_search", DEFAULT_REGISTRY):
             vals, ids = fn(

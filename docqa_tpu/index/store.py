@@ -304,7 +304,7 @@ class VectorStore:
         dates = self._cols["doc_date"][:count]
         for bound in ("date_from", "date_to"):
             value = filters.get(bound)
-            if value is None:
+            if not value:  # None OR '' — unfilled form fields mean no bound
                 continue
             code = _date_code(value)
             if code < 0:
@@ -318,10 +318,7 @@ class VectorStore:
                 live &= dates >= code
             else:
                 live &= dates <= code
-        if (
-            filters.get("date_from") is not None
-            or filters.get("date_to") is not None
-        ):
+        if filters.get("date_from") or filters.get("date_to"):
             live &= dates >= 0  # undated rows excluded when bounds given
         mask[:count] = live
         return mask
@@ -414,13 +411,15 @@ class VectorStore:
         with self._lock:
             return list(self._meta[: self._count])
 
-    def vectors_snapshot(self) -> Tuple[np.ndarray, List[Dict[str, Any]]]:
-        """Consistent (vectors, metadata) pair under one lock acquisition —
-        the safe input for offline rebuilds (IVF) while add() runs
-        concurrently."""
+    def vectors_snapshot(
+        self, start: int = 0
+    ) -> Tuple[np.ndarray, List[Dict[str, Any]]]:
+        """Consistent (vectors, metadata) pair for rows [start, count) under
+        one lock acquisition — the safe input for offline rebuilds (IVF) and
+        tail slices (TieredIndex) while add() runs concurrently."""
         with self._lock:
-            return self._host[: self._count].copy(), list(
-                self._meta[: self._count]
+            return self._host[start : self._count].copy(), list(
+                self._meta[start : self._count]
             )
 
     # ---- versioned snapshot (checkpoint/resume parity, SURVEY §5) -----------

@@ -168,7 +168,7 @@ class TieredIndex:
             k_tail = min(k, n_live)
             vals, ids = _tail_kernel(
                 tail_dev,
-                jnp.asarray(qn, self.store._dtype),
+                jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
                 jnp.int32(n_live),
                 k_tail,
             )
@@ -190,15 +190,6 @@ class TieredIndex:
             out.append(cands[:k])
         return out
 
-    def _tail_snapshot(self, covered: int):
-        """Consistent (vectors, metadata) for rows [covered, count)."""
-        with self.store._lock:
-            count = self.store._count
-            return (
-                self.store._host[covered:count].copy(),
-                list(self.store._meta[covered:count]),
-            )
-
     def _tail_device(self, covered: int):
         """Device-resident padded tail, rebuilt only when the store has
         grown — the per-query cost is zero host→device traffic (a naive
@@ -208,7 +199,7 @@ class TieredIndex:
         if cache is not None and cache[0] == covered:
             if cache[1] == self.store.count:
                 return cache
-        vecs, meta = self._tail_snapshot(covered)
+        vecs, meta = self.store.vectors_snapshot(start=covered)
         n_live = len(vecs)
         bucket = round_up(max(n_live, 1), 4096)  # stable jit shapes
         padded = np.zeros((bucket, self.store.cfg.dim), np.float32)
@@ -216,7 +207,7 @@ class TieredIndex:
         cache = (
             covered,
             covered + n_live,
-            jnp.asarray(padded, self.store._dtype),
+            jnp.asarray(padded, jnp.dtype(self.store.cfg.dtype)),
             n_live,
             meta,
         )

@@ -186,8 +186,16 @@ class DocQARuntime:
             use_fake_llm=self.cfg.flags.use_fake_llm,
             batcher=self.batcher,
         )
+        if self.cfg.flags.use_fake_retrieval:
+            # standalone/dev parity with the reference's USE_FAKE_RETRIEVAL
+            # (core/config.py:22-23): synthesis works without any index
+            from docqa_tpu.service.synthesis import fake_patient_retrieval
+
+            retrieval = fake_patient_retrieval
+        else:
+            retrieval = self.qa.patient_snippets
         self.synthesis = SynthesisService(
-            retrieval=self.qa.patient_snippets, summarizer=self.summarizer
+            retrieval=retrieval, summarizer=self.summarizer
         )
 
     def start(self) -> "DocQARuntime":

@@ -340,60 +340,197 @@ class Consumer(threading.Thread):
 
 
 class AmqpBroker:
-    """Same interface over RabbitMQ via pika, for multi-host service planes.
+    """The full MemoryBroker contract over RabbitMQ via pika (multi-host
+    service planes).
 
     Mirrors the reference's wire usage — durable queue declare, persistent
-    delivery (``processing.py:27,40``) — behind the MemoryBroker API.  Gated:
-    raises at construction if pika is unavailable (not in this image).
+    delivery (``processing.py:27,40``) — and adds what the reference lacked
+    and MemoryBroker guarantees:
+
+    * **attempt counting** rides an ``x-attempts`` header: a requeueing
+      ``nack`` acks the original and republishes with the counter bumped
+      (AMQP redelivery itself carries no attempt count);
+    * **dead-lettering** after ``max_redelivery`` attempts publishes to a
+      durable ``<queue>.dlq`` companion queue (the reference *dropped*
+      poison messages, ``anonymizer.py:83-87``).  ``dead_letters()`` reports
+      the bodies this instance dead-lettered (the durable copy lives in the
+      DLQ queue for cross-process consumers);
+    * **introspection** (``depth``/``in_flight``) and ``drain`` so the
+      pipeline's completion signal works unchanged over AMQP.
+
+    Tested against an in-memory pika stand-in (``tests/test_amqp.py``);
+    gated at construction when pika is unavailable (not in this image).
+
+    AMQP ops are funneled through one connection/channel guarded by a lock —
+    pika's BlockingConnection is not thread-safe, and the pipeline's two
+    consumers + HTTP publishers call concurrently.
     """
 
-    def __init__(self, cfg: Optional[BrokerConfig] = None) -> None:
-        try:
-            import pika  # noqa: F401
-        except ImportError as e:  # pragma: no cover - env has no pika
-            raise RuntimeError(
-                "AmqpBroker requires pika; install it or use MemoryBroker "
-                "(backend='memory')"
-            ) from e
+    def __init__(self, cfg: Optional[BrokerConfig] = None, pika_module=None) -> None:
+        if pika_module is None:
+            try:
+                import pika as pika_module  # noqa: F811
+            except ImportError as e:
+                raise RuntimeError(
+                    "AmqpBroker requires pika; install it or use MemoryBroker "
+                    "(backend='memory')"
+                ) from e
         self.cfg = cfg or BrokerConfig()
-        self._pika = pika
-        self._params = pika.ConnectionParameters(
+        self._pika = pika_module
+        self._lock = threading.Lock()
+        self._params = pika_module.ConnectionParameters(
             host=self.cfg.amqp_host, port=self.cfg.amqp_port
         )
-        self._conn = pika.BlockingConnection(self._params)
+        self._conn = pika_module.BlockingConnection(self._params)
         self._ch = self._conn.channel()
         self._ch.basic_qos(prefetch_count=self.cfg.prefetch)
+        self._declared: set = set()
+        self._in_flight: Dict[str, set] = {}
+        self._dead: Dict[str, List[Dict[str, Any]]] = {}
+        self._n_published = 0
 
-    def publish(self, queue: str, body: Dict[str, Any]) -> int:  # pragma: no cover
-        self._ch.queue_declare(queue=queue, durable=True)
+    def _declare(self, queue: str) -> None:
+        if queue not in self._declared:
+            self._ch.queue_declare(queue=queue, durable=True)
+            self._declared.add(queue)
+
+    def _publish_locked(
+        self,
+        queue: str,
+        body: Dict[str, Any],
+        attempts: int,
+        ready_at: float = 0.0,
+    ) -> None:
+        self._declare(queue)
         self._ch.basic_publish(
             exchange="",
             routing_key=queue,
             body=json.dumps(body),
-            properties=self._pika.BasicProperties(delivery_mode=2),
+            properties=self._pika.BasicProperties(
+                delivery_mode=2,
+                headers={"x-attempts": attempts, "x-ready-at": ready_at},
+            ),
         )
-        return 0
 
-    def get_many(self, queue, max_n=None, timeout=None):  # pragma: no cover
-        self._ch.queue_declare(queue=queue, durable=True)
-        out: List[Delivery] = []
-        for _ in range(max_n or self.cfg.prefetch):
-            method, _props, payload = self._ch.basic_get(queue)
-            if method is None:
-                break
-            out.append(
-                Delivery(queue, method.delivery_tag, json.loads(payload), 1)
+    def publish(self, queue: str, body: Dict[str, Any]) -> int:
+        with self._lock:
+            self._publish_locked(queue, body, 0)
+            self._n_published += 1
+            return self._n_published
+
+    def get_many(
+        self,
+        queue: str,
+        max_n: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Delivery]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        max_n = max_n or self.cfg.prefetch
+        while True:
+            with self._lock:
+                self._declare(queue)
+                out: List[Delivery] = []
+                # bounded pops per pass: backed-off messages get requeued to
+                # the back, and an unbounded loop over a queue of only
+                # not-yet-ready messages would spin
+                for _ in range(max(4 * max_n, 16)):
+                    if len(out) >= max_n:
+                        break
+                    method, props, payload = self._ch.basic_get(queue)
+                    if method is None:
+                        break
+                    headers = getattr(props, "headers", None) or {}
+                    ready_at = float(headers.get("x-ready-at", 0.0))
+                    attempts = int(headers.get("x-attempts", 0))
+                    if ready_at > time.time():
+                        # still in retry backoff: push it to the back,
+                        # durably, and keep scanning (MemoryBroker parity —
+                        # its pending entries carry a not-before timestamp)
+                        self._publish_locked(
+                            queue, json.loads(payload), attempts, ready_at
+                        )
+                        self._ch.basic_ack(method.delivery_tag)
+                        continue
+                    self._in_flight.setdefault(queue, set()).add(
+                        method.delivery_tag
+                    )
+                    out.append(
+                        Delivery(
+                            queue,
+                            method.delivery_tag,
+                            json.loads(payload),
+                            attempts + 1,
+                        )
+                    )
+                if out:
+                    return out
+            if deadline is None or time.monotonic() >= deadline:
+                return []
+            time.sleep(0.05)
+
+    def ack(self, delivery: Delivery) -> None:
+        with self._lock:
+            self._ch.basic_ack(delivery.tag)
+            self._in_flight.get(delivery.queue, set()).discard(delivery.tag)
+
+    def nack(self, delivery: Delivery, requeue: bool = True) -> bool:
+        """Requeue with the attempt header bumped, or dead-letter to
+        ``<queue>.dlq`` after ``max_redelivery`` attempts.  Returns True if
+        dead-lettered (MemoryBroker contract)."""
+        with self._lock:
+            self._in_flight.get(delivery.queue, set()).discard(delivery.tag)
+            if requeue and delivery.attempts < self.cfg.max_redelivery:
+                # exponential backoff via a durable not-before header, so a
+                # transient failure doesn't burn every attempt within
+                # milliseconds (MemoryBroker.nack parity)
+                delay = self.cfg.retry_backoff_s * (2 ** (delivery.attempts - 1))
+                self._publish_locked(
+                    delivery.queue,
+                    delivery.body,
+                    delivery.attempts,
+                    ready_at=time.time() + delay,
+                )
+                self._ch.basic_ack(delivery.tag)
+                return False
+            self._publish_locked(f"{delivery.queue}.dlq", delivery.body, 0)
+            self._ch.basic_ack(delivery.tag)
+            self._dead.setdefault(delivery.queue, []).append(delivery.body)
+            log.warning(
+                "dead-lettered message from %s after %d attempts",
+                delivery.queue,
+                delivery.attempts,
             )
-        return out
+            return True
 
-    def ack(self, delivery: Delivery) -> None:  # pragma: no cover
-        self._ch.basic_ack(delivery.tag)
+    # ---- introspection -------------------------------------------------------
 
-    def nack(self, delivery: Delivery, requeue: bool = True) -> None:  # pragma: no cover
-        self._ch.basic_nack(delivery.tag, requeue=requeue)
+    def depth(self, queue: str) -> int:
+        with self._lock:
+            self._declare(queue)
+            method = self._ch.queue_declare(
+                queue=queue, durable=True, passive=True
+            )
+            return int(method.method.message_count)
 
-    def close(self) -> None:  # pragma: no cover
-        self._conn.close()
+    def in_flight(self, queue: str) -> int:
+        with self._lock:
+            return len(self._in_flight.get(queue, ()))
+
+    def dead_letters(self, queue: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._dead.get(queue, ()))
+
+    def drain(self, queue: str, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.depth(queue) == 0 and self.in_flight(queue) == 0:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
 
 
 def make_broker(cfg: Optional[BrokerConfig] = None, journal_dir: Optional[str] = None):

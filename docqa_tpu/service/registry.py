@@ -2,8 +2,12 @@
 
 Replaces the reference's Postgres ``documents`` table + SQLAlchemy layer
 (``doc-ingestor/models.py:5-12``, ``doc-ingestor/database.py:7-21``) with a
-pluggable store: SQLite by default (stdlib, zero deploy), same schema shape,
-no hardcoded credentials (the reference committed them, ``database.py:10``).
+SQLite registry (stdlib, zero deploy, crash-durable on disk), same schema
+shape, no hardcoded credentials (the reference committed them,
+``database.py:10``).  Only ``sqlite://`` URLs are supported — document
+metadata is not a TPU concern and SQLite covers the single-host deployment
+this framework targets; any other URL raises at construction rather than
+pretending a server adapter exists.
 
 Two deliberate extensions over the reference schema:
 

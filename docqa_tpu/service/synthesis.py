@@ -36,6 +36,37 @@ class SynthesisError(Exception):
         self.detail = detail
 
 
+def fake_patient_retrieval(
+    patient_id: str,
+    from_date: Optional[str] = None,
+    to_date: Optional[str] = None,
+    focus: Optional[str] = None,
+) -> List[Dict[str, str]]:
+    """Canned snippets for standalone/dev mode — the reference's
+    ``USE_FAKE_RETRIEVAL`` path returned two hardcoded clinical extracts
+    (``core/retrieval_client.py:31-54``).  Own wording, same contract:
+    ``[{doc_id, text}]``, non-empty for any patient id."""
+    del from_date, to_date, focus
+    return [
+        {
+            "doc_id": f"fake-{patient_id}-1",
+            "text": (
+                f"Consultation du patient {patient_id} : tension artérielle "
+                "142/88 mmHg, céphalées intermittentes depuis deux semaines. "
+                "Traitement par amlodipine 5 mg instauré."
+            ),
+        },
+        {
+            "doc_id": f"fake-{patient_id}-2",
+            "text": (
+                f"Suivi du patient {patient_id} : bilan biologique sans "
+                "anomalie, HbA1c 6,1 %. Poursuite du traitement en cours, "
+                "contrôle dans trois mois."
+            ),
+        },
+    ]
+
+
 _SECTION_TITLES = (
     "Contexte clinique",
     "Éléments marquants",

@@ -1,0 +1,205 @@
+"""HF safetensors weight-import round-trips (VERDICT round-1 item 10).
+
+Zero-egress means no real checkpoints, so these build *synthetic*
+safetensors files with the exact HF naming/shapes (BERT/MiniLM for the
+encoder, Llama/Mistral for the decoder) and prove the import path is live:
+key mapping complete, [out,in]→[in,out] transposes right, forward runs.
+This is the "drop in real weights on weight-drop day" guarantee.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from docqa_tpu.config import DecoderConfig, EncoderConfig
+from docqa_tpu.models.decoder import (
+    decoder_forward,
+    init_decoder_params,
+    init_kv_cache,
+    load_hf_llama_weights,
+)
+from docqa_tpu.models.encoder import (
+    encoder_forward,
+    init_encoder_params,
+    load_hf_bert_weights,
+)
+
+safetensors = pytest.importorskip("safetensors.numpy")
+
+ENC = EncoderConfig(
+    vocab_size=100, hidden_dim=32, num_layers=2, num_heads=2,
+    mlp_dim=64, max_seq_len=48, embed_dim=32, dtype="float32",
+)
+DEC = DecoderConfig(
+    vocab_size=100, hidden_dim=32, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=8, mlp_dim=64, max_seq_len=64, dtype="float32",
+)
+
+
+def _bert_raw(cfg: EncoderConfig, rng: np.random.Generator):
+    h, m = cfg.hidden_dim, cfg.mlp_dim
+    r = lambda *s: rng.normal(size=s).astype(np.float32) * 0.05
+    raw = {
+        "embeddings.word_embeddings.weight": r(cfg.vocab_size, h),
+        "embeddings.position_embeddings.weight": r(cfg.max_seq_len, h),
+        "embeddings.token_type_embeddings.weight": r(2, h),
+        "embeddings.LayerNorm.weight": np.ones(h, np.float32),
+        "embeddings.LayerNorm.bias": np.zeros(h, np.float32),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"encoder.layer.{i}."
+        for name, (o, inp) in {
+            "attention.self.query": (h, h),
+            "attention.self.key": (h, h),
+            "attention.self.value": (h, h),
+            "attention.output.dense": (h, h),
+            "intermediate.dense": (m, h),  # torch Linear: [out, in]
+            "output.dense": (h, m),
+        }.items():
+            raw[pre + name + ".weight"] = r(o, inp)
+            raw[pre + name + ".bias"] = r(o)
+        for ln in ("attention.output.LayerNorm", "output.LayerNorm"):
+            raw[pre + ln + ".weight"] = np.ones(h, np.float32)
+            raw[pre + ln + ".bias"] = np.zeros(h, np.float32)
+    return raw
+
+
+def _llama_raw(cfg: DecoderConfig, rng: np.random.Generator, tied=False):
+    h = cfg.hidden_dim
+    q = cfg.num_heads * cfg.head_dim
+    kv = cfg.num_kv_heads * cfg.head_dim
+    r = lambda *s: rng.normal(size=s).astype(np.float32) * 0.05
+    raw = {
+        "model.embed_tokens.weight": r(cfg.vocab_size, h),
+        "model.norm.weight": np.ones(h, np.float32),
+    }
+    if not tied:
+        raw["lm_head.weight"] = r(cfg.vocab_size, h)
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        raw[pre + "input_layernorm.weight"] = np.ones(h, np.float32)
+        raw[pre + "post_attention_layernorm.weight"] = np.ones(h, np.float32)
+        raw[pre + "self_attn.q_proj.weight"] = r(q, h)
+        raw[pre + "self_attn.k_proj.weight"] = r(kv, h)
+        raw[pre + "self_attn.v_proj.weight"] = r(kv, h)
+        raw[pre + "self_attn.o_proj.weight"] = r(h, q)
+        raw[pre + "mlp.gate_proj.weight"] = r(cfg.mlp_dim, h)
+        raw[pre + "mlp.up_proj.weight"] = r(cfg.mlp_dim, h)
+        raw[pre + "mlp.down_proj.weight"] = r(h, cfg.mlp_dim)
+    return raw
+
+
+class TestBertImport:
+    def test_roundtrip_structure_and_forward(self, tmp_path):
+        raw = _bert_raw(ENC, np.random.default_rng(0))
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+
+        params = load_hf_bert_weights(path, ENC)
+        want = init_encoder_params(jax.random.PRNGKey(0), ENC)
+        assert set(params) == set(want)
+        for k in want:
+            assert params[k].shape == want[k].shape, k
+
+        ids = np.array([[2, 7, 9, 3, 0, 0]], np.int32)
+        out = encoder_forward(params, ENC, ids, np.array([4], np.int32))
+        assert out.shape[0] == 1 and np.isfinite(np.asarray(out)).all()
+
+    def test_transpose_orientation(self, tmp_path):
+        raw = _bert_raw(ENC, np.random.default_rng(1))
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+        params = load_hf_bert_weights(path, ENC)
+        # torch [out, in] → ours [in, out]; the rectangular MLP weights
+        # catch any missed transpose by shape alone
+        np.testing.assert_array_equal(
+            np.asarray(params["l0_up_w"]),
+            raw["encoder.layer.0.intermediate.dense.weight"].T,
+        )
+        assert params["l0_up_w"].shape == (ENC.hidden_dim, ENC.mlp_dim)
+
+    def test_bert_prefix_stripped(self, tmp_path):
+        raw = {
+            "bert." + k: v for k, v in _bert_raw(ENC, np.random.default_rng(2)).items()
+        }
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+        params = load_hf_bert_weights(path, ENC)
+        assert set(params) == set(init_encoder_params(jax.random.PRNGKey(0), ENC))
+
+
+class TestLlamaImport:
+    def _forward(self, params):
+        ids = np.array([[5, 8, 11, 2]], np.int32)
+        cache = init_kv_cache(DEC, 1, max_len=16)
+        logits, _ = decoder_forward(
+            params, DEC, ids, cache,
+            np.zeros((1,), np.int32),
+            attn_lengths=np.array([4], np.int32),
+        )
+        return np.asarray(logits)
+
+    def test_roundtrip_structure_and_forward(self, tmp_path):
+        raw = _llama_raw(DEC, np.random.default_rng(0))
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+
+        params = load_hf_llama_weights(path, DEC)
+        want = init_decoder_params(jax.random.PRNGKey(0), DEC)
+        assert set(params) == set(want)
+        for k in want:
+            assert params[k].shape == want[k].shape, k
+        logits = self._forward(params)
+        assert logits.shape == (1, 4, DEC.vocab_size)
+        assert np.isfinite(logits).all()
+
+    def test_gqa_projection_transposes(self, tmp_path):
+        raw = _llama_raw(DEC, np.random.default_rng(1))
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+        params = load_hf_llama_weights(path, DEC)
+        # GQA: k/v are [hidden, kv_heads*head_dim] after transpose — the
+        # rectangular shape catches both a missed transpose and a q/kv mixup
+        kv = DEC.num_kv_heads * DEC.head_dim
+        assert params["l0_wk"].shape == (DEC.hidden_dim, kv)
+        np.testing.assert_array_equal(
+            np.asarray(params["l0_wk"]),
+            raw["model.layers.0.self_attn.k_proj.weight"].T,
+        )
+
+    def test_tied_embeddings_fallback(self, tmp_path):
+        raw = _llama_raw(DEC, np.random.default_rng(2), tied=True)
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+        params = load_hf_llama_weights(path, DEC)
+        np.testing.assert_array_equal(
+            np.asarray(params["lm_head"]),
+            raw["model.embed_tokens.weight"].T,
+        )
+        assert np.isfinite(self._forward(params)).all()
+
+    def test_multi_shard(self, tmp_path):
+        raw = _llama_raw(DEC, np.random.default_rng(3))
+        keys = sorted(raw)
+        half = len(keys) // 2
+        p1, p2 = str(tmp_path / "model-1.safetensors"), str(tmp_path / "model-2.safetensors")
+        safetensors.save_file({k: raw[k] for k in keys[:half]}, p1)
+        safetensors.save_file({k: raw[k] for k in keys[half:]}, p2)
+        params = load_hf_llama_weights([p1, p2], DEC)
+        assert set(params) == set(init_decoder_params(jax.random.PRNGKey(0), DEC))
+
+    def test_generation_with_imported_weights(self, tmp_path):
+        from docqa_tpu.config import GenerateConfig
+        from docqa_tpu.engines.generate import GenerateEngine
+
+        raw = _llama_raw(DEC, np.random.default_rng(4))
+        path = str(tmp_path / "model.safetensors")
+        safetensors.save_file(raw, path)
+        params = load_hf_llama_weights(path, DEC)
+        eng = GenerateEngine(
+            DEC, GenerateConfig(max_new_tokens=6, prefill_buckets=(16,)),
+            params=params,
+        )
+        out = eng.generate_ids([[3, 5, 7]])
+        assert len(out) == 1 and len(out[0]) <= 6

@@ -75,6 +75,15 @@ def test_spill_rows_still_findable():
     assert found_self == len(x)  # spill is scanned exactly for every query
 
 
+def test_overfetch_clamped_to_candidate_pool():
+    # regression: k*n_assign could exceed nprobe*cap + spill and crash top_k
+    x = _clustered_corpus(n=1000, n_centers=8)
+    meta = [{"row": i} for i in range(len(x))]
+    ivf = IVFIndex(x, meta, n_clusters=16, nprobe=1, dtype="float32")
+    res = ivf.search(x[:2], k=500, nprobe=1)  # k*2 > one cell's pool
+    assert len(res) == 2 and res[0][0][1] == 0
+
+
 def test_from_store_roundtrip():
     x = _clustered_corpus(n=600, n_centers=8)
     store = VectorStore(StoreConfig(dim=64, shard_capacity=1024))

@@ -137,6 +137,13 @@ class TestColumnarFilters:
             with pytest.raises(ValueError, match="ISO date"):
                 store.metadata_select(date_to=bad)
 
+    def test_empty_string_date_bound_means_no_bound(self):
+        # unfilled HTML form fields submit '' — that's 'no bound', not 422
+        store, v, _ = self._store()
+        got = store.search(v[0], k=60, filters={"patient_id": "P1", "date_from": ""})[0]
+        want = store.search(v[0], k=60, filters={"patient_id": "P1"})[0]
+        assert [r.row_id for r in got] == [r.row_id for r in want]
+
     def test_filters_compose_with_where(self):
         store, v, _ = self._store()
         res = store.search(
