@@ -266,6 +266,10 @@ class GenerateConfig:
     # handful of compiled programs cover all requests.
     prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
     max_concurrent: int = 16  # continuous batching lanes (QPS 16 target)
+    # tokens per batcher decode dispatch: larger chunks amortize dispatch
+    # round-trips (dominant over a tunneled TPU) at the cost of coarser
+    # slot-retirement granularity
+    decode_chunk: int = 16
 
 
 @dataclass(frozen=True)

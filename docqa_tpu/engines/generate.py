@@ -53,13 +53,25 @@ class GenerateEngine:
         tokenizer: Optional[Tokenizer] = None,
         seed: int = 0,
         use_flash: Optional[bool] = None,
+        param_dtype=None,
     ):
+        """``param_dtype``: storage dtype for the weights.  Defaults to
+        ``cfg.dtype`` (bf16 for serving configs) — decode is HBM-bandwidth
+        bound, and storing f32 masters in an inference-only engine doubles
+        the bytes read per token (measured ~2x tok/s on v5e from this alone).
+        Pass float32 explicitly to share a training master copy."""
         self.cfg = cfg
         self.gen = gen or GenerateConfig()
         self.mesh = mesh
         self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
         if params is None:
-            params = init_decoder_params(jax.random.PRNGKey(seed), cfg)
+            params = init_decoder_params(
+                jax.random.PRNGKey(seed),
+                cfg,
+                param_dtype=param_dtype or jnp.dtype(cfg.dtype),
+            )
+        elif param_dtype is not None:
+            params = {k: v.astype(param_dtype) for k, v in params.items()}
         if mesh is not None:
             params = shard_decoder_params(params, cfg, mesh)
         self.params = params

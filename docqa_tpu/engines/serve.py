@@ -86,7 +86,7 @@ class ContinuousBatcher:
         self,
         engine,  # GenerateEngine: supplies cfg/gen/params/tokenizer/mesh
         n_slots: Optional[int] = None,
-        chunk: int = 8,
+        chunk: Optional[int] = None,
         cache_len: Optional[int] = None,
         seed: int = 0,
     ) -> None:
@@ -97,7 +97,7 @@ class ContinuousBatcher:
         self.n_slots = n_slots or self.gen.max_concurrent
         if self.mesh is not None and self.n_slots % self.mesh.n_data:
             self.n_slots = round_up(self.n_slots, self.mesh.n_data)
-        self.chunk = chunk
+        self.chunk = chunk or getattr(self.gen, "decode_chunk", 8)
         self.cache_len = round_up(cache_len or self.cfg.max_seq_len, 128)
         self._seed = seed
         self._rng_counter = 0
@@ -160,7 +160,10 @@ class ContinuousBatcher:
 
         Returns out [S, chunk] (pad on inactive steps), valid [S, chunk]
         (True where the token is a real emission, EOS excluded — so a
-        legitimately *sampled* pad_id is preserved), plus updated state."""
+        legitimately *sampled* pad_id is preserved), plus updated state.
+        The host-facing results are additionally packed into ONE int32
+        array so the worker fetches them in a single device→host transfer
+        (three separate fetches cost three round-trips on a tunneled TPU)."""
         S = self.n_slots
         out0 = jnp.full((S, self.chunk), self.gen.pad_id, jnp.int32)
         valid0 = jnp.zeros((S, self.chunk), bool)
@@ -195,7 +198,11 @@ class ContinuousBatcher:
             body,
             (cache, tok, lengths, active, out0, valid0, rng),
         )
-        return cache, tok, lengths, active, out, valid
+        packed = jnp.concatenate(
+            [out, valid.astype(jnp.int32), active.astype(jnp.int32)[:, None]],
+            axis=1,
+        )  # [S, 2*chunk + 1] — one D2H fetch for the worker
+        return cache, tok, lengths, active, packed
 
     def _get_prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -258,7 +265,12 @@ class ContinuousBatcher:
 
     # ---- worker loop ---------------------------------------------------------
 
-    def _admit(self, slot: int, req: _Request) -> None:
+    def _admit_dispatch(self, slot: int, req: _Request):
+        """Dispatch one prefill ASYNCHRONOUSLY (no device sync) and mark the
+        slot occupied.  Returns (slot, req, n_prompt_ids, first_token_dev);
+        a whole admission round is then finalized with ONE host sync in
+        ``_finalize_admissions`` — per-admit ``int(first)`` syncs cost a
+        full round-trip each on a tunneled TPU."""
         usable = self.cache_len - 1
         ids = req.prompt_ids[-usable:] or [self.gen.pad_id]
         bucket = min(
@@ -279,23 +291,39 @@ class ContinuousBatcher:
                 jnp.int32(slot),
                 self._next_rng(),
             )
-        first = int(first)
         self._slot_req[slot] = req
-        # remaining decode budget; the prefill-sampled token counts as one
-        budget = min(req.max_new, self.cache_len - len(ids) - 1)
-        self._slot_budget[slot] = budget
-        alive = True
-        if first == self.gen.eos_id or budget <= 0:
-            alive = False
-            self._retire(slot)
-        else:
-            req.tokens.append(first)
-            if len(req.tokens) >= budget:
+        return slot, req, len(ids), first
+
+    def _finalize_admissions(self, admitted) -> None:
+        """One device fetch for every first token of the admission round,
+        then batch the slot-state updates into three device ops."""
+        firsts = np.asarray(jnp.stack([a[3] for a in admitted]))
+        slots: List[int] = []
+        toks: List[int] = []
+        lens: List[int] = []
+        alive_flags: List[bool] = []
+        for (slot, req, n_ids, _), first in zip(admitted, firsts):
+            first = int(first)
+            # remaining decode budget; the prefill token counts as one
+            budget = min(req.max_new, self.cache_len - n_ids - 1)
+            self._slot_budget[slot] = budget
+            alive = True
+            if first == self.gen.eos_id or budget <= 0:
                 alive = False
                 self._retire(slot)
-        self._tok = self._tok.at[slot].set(first)
-        self._lengths = self._lengths.at[slot].set(len(ids))
-        self._active = self._active.at[slot].set(alive)
+            else:
+                req.tokens.append(first)
+                if len(req.tokens) >= budget:
+                    alive = False
+                    self._retire(slot)
+            slots.append(slot)
+            toks.append(first)
+            lens.append(n_ids)
+            alive_flags.append(alive)
+        idx = jnp.asarray(slots, jnp.int32)
+        self._tok = self._tok.at[idx].set(jnp.asarray(toks, jnp.int32))
+        self._lengths = self._lengths.at[idx].set(jnp.asarray(lens, jnp.int32))
+        self._active = self._active.at[idx].set(jnp.asarray(alive_flags))
 
     def _fail_active(self, err: BaseException) -> None:
         """Fail all in-flight requests and rebuild clean device state."""
@@ -324,6 +352,7 @@ class ContinuousBatcher:
 
     def _run(self) -> None:
         while True:
+            admitted = []
             with self._cv:
                 while (
                     not self._stopped
@@ -333,19 +362,29 @@ class ContinuousBatcher:
                     self._cv.wait(0.5)
                 if self._stopped:
                     return
-                # admission: fill free slots from the queue
+                # admission: async-dispatch a prefill per free slot; the
+                # round is finalized with a single device sync below
                 for slot in range(self.n_slots):
                     if not self._queue:
                         break
                     if self._slot_req[slot] is None:
                         req = self._queue.popleft()
                         try:
-                            self._admit(slot, req)
+                            admitted.append(self._admit_dispatch(slot, req))
                         except Exception as e:  # bad request; fail it alone
-                            log.exception("prefill failed")
+                            log.exception("prefill dispatch failed")
                             req.error = e
                             req.done.set()
                             self._slot_req[slot] = None
+            if admitted:
+                try:
+                    self._finalize_admissions(admitted)
+                except Exception as e:
+                    # a prefill died inside the dispatched batch; the cache
+                    # was donated through it — fail in-flight and reset
+                    log.exception("admission finalize failed; resetting")
+                    self._fail_active(e)
+                    continue
             if not any(self._slot_req):
                 continue
             # one decode chunk for every live slot
@@ -357,8 +396,7 @@ class ContinuousBatcher:
                         self._tok,
                         self._lengths,
                         self._active,
-                        out,
-                        valid,
+                        packed,
                     ) = fn(
                         self.engine.params,
                         self._cache,
@@ -367,6 +405,7 @@ class ContinuousBatcher:
                         self._active,
                         self._next_rng(),
                     )
+                    packed_h = np.asarray(packed)  # ONE fetch per chunk
             except Exception as e:
                 # the cache was donated into a failed dispatch — fail every
                 # in-flight request, reset device state, and keep serving
@@ -375,9 +414,9 @@ class ContinuousBatcher:
                 log.exception("decode chunk failed; resetting slot state")
                 self._fail_active(e)
                 continue
-            out_h = np.asarray(out)
-            valid_h = np.asarray(valid)
-            active_h = np.asarray(self._active)
+            out_h = packed_h[:, : self.chunk]
+            valid_h = packed_h[:, self.chunk : 2 * self.chunk].astype(bool)
+            active_h = packed_h[:, -1].astype(bool)
             deactivate = []
             for slot in range(self.n_slots):
                 req = self._slot_req[slot]

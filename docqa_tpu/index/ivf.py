@@ -40,6 +40,35 @@ NEG_INF = -1e30
 # On-device k-means
 # ---------------------------------------------------------------------------
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def _kcenter_init(vectors: jax.Array, c: int):
+    """Greedy k-center (farthest-point) seeding, fully on device.
+
+    Random seeding collapses on clustered corpora: by coupon-collector a
+    large fraction of natural clusters get no seed, and with near-
+    orthogonal clusters Lloyd cannot migrate centroids across them — the
+    orphaned clusters' rows scatter over arbitrary cells and coarse
+    ranking never finds them (measured recall@10 0.28 at 200k rows /
+    2000 natural clusters with random init).  Farthest-point seeding
+    covers distinct clusters first by construction.  Cost: ``c``
+    sequential [n,d]@[d] matvecs under one jit."""
+    n, d = vectors.shape
+
+    def body(i, carry):
+        best_sim, chosen = carry
+        idx = jnp.argmin(best_sim)  # farthest from every chosen seed
+        cvec = vectors[idx]
+        chosen = chosen.at[i].set(cvec)
+        best_sim = jnp.maximum(best_sim, vectors @ cvec)
+        return best_sim, chosen
+
+    best0 = jnp.full((n,), -2.0, vectors.dtype).at[0].set(2.0)
+    chosen0 = jnp.zeros((c, d), vectors.dtype).at[0].set(vectors[0])
+    best0 = jnp.maximum(best0, vectors @ vectors[0])
+    _, chosen = jax.lax.fori_loop(1, c, body, (best0, chosen0))
+    return chosen
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _kmeans_fit(vectors: jax.Array, init: jax.Array, n_iters: int, c: int):
     """Lloyd iterations, fully on device.  vectors [n, d] (L2-normalized),
@@ -83,7 +112,17 @@ def kmeans(
     fit_on = vectors
     if sample is not None and n > sample:
         fit_on = vectors[rng.choice(n, sample, replace=False)]
-    init = fit_on[rng.choice(len(fit_on), n_clusters, replace=n_clusters > len(fit_on))]
+    # greedy k-center seeding on a bounded subsample (cluster coverage),
+    # random fallback only when the corpus is smaller than the seed count
+    if len(fit_on) > n_clusters:
+        seed_pool = fit_on
+        if len(seed_pool) > 65536:
+            seed_pool = seed_pool[rng.choice(len(seed_pool), 65536, replace=False)]
+        init = np.asarray(_kcenter_init(jnp.asarray(seed_pool), n_clusters))
+    else:
+        init = fit_on[
+            rng.choice(len(fit_on), n_clusters, replace=n_clusters > len(fit_on))
+        ]
     centroids, _ = _kmeans_fit(
         jnp.asarray(fit_on), jnp.asarray(init), n_iters, n_clusters
     )

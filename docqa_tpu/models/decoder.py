@@ -33,31 +33,39 @@ Params = Dict[str, jax.Array]
 KVCache = Dict[str, jax.Array]  # "k0".."k{L-1}", "v0".."v{L-1}"
 
 
-def init_decoder_params(rng: jax.Array, cfg: DecoderConfig) -> Params:
+def init_decoder_params(
+    rng: jax.Array, cfg: DecoderConfig, param_dtype=jnp.float32
+) -> Params:
+    """``param_dtype``: float32 default (training master weights); bf16 for
+    inference-only at target scale — a 7B f32 tree (29 GB) cannot even be
+    *materialized* on a 16 GB chip, so the cast happens per-tensor here,
+    never on a whole f32 tree."""
     keys = iter(jax.random.split(rng, 8 + 8 * cfg.num_layers))
     h = cfg.hidden_dim
     qd = cfg.num_heads * cfg.head_dim
     kvd = cfg.num_kv_heads * cfg.head_dim
+    param_dtype = jnp.dtype(param_dtype)
 
     def norm(shape, fan_in):
-        return jax.random.normal(next(keys), shape, jnp.float32) * (
-            fan_in ** -0.5
-        )
+        return (
+            jax.random.normal(next(keys), shape, jnp.float32)
+            * (fan_in ** -0.5)
+        ).astype(param_dtype)
 
     p: Params = {
         "tok_emb": norm((cfg.vocab_size, h), h),
-        "final_norm_g": jnp.ones((h,)),
+        "final_norm_g": jnp.ones((h,), param_dtype),
         "lm_head": norm((h, cfg.vocab_size), h),
     }
     for i in range(cfg.num_layers):
         p.update(
             {
-                f"l{i}_attn_norm_g": jnp.ones((h,)),
+                f"l{i}_attn_norm_g": jnp.ones((h,), param_dtype),
                 f"l{i}_wq": norm((h, qd), h),
                 f"l{i}_wk": norm((h, kvd), h),
                 f"l{i}_wv": norm((h, kvd), h),
                 f"l{i}_wo": norm((qd, h), qd),
-                f"l{i}_mlp_norm_g": jnp.ones((h,)),
+                f"l{i}_mlp_norm_g": jnp.ones((h,), param_dtype),
                 f"l{i}_w_gate": norm((h, cfg.mlp_dim), h),
                 f"l{i}_w_up": norm((h, cfg.mlp_dim), h),
                 f"l{i}_w_down": norm((cfg.mlp_dim, h), cfg.mlp_dim),

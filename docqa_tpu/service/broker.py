@@ -430,10 +430,17 @@ class AmqpBroker:
             with self._lock:
                 self._declare(queue)
                 out: List[Delivery] = []
-                # bounded pops per pass: backed-off messages get requeued to
-                # the back, and an unbounded loop over a queue of only
-                # not-yet-ready messages would spin
-                for _ in range(max(4 * max_n, 16)):
+                # budget = queue depth at pass start: each message is popped
+                # at most once per pass — a republished (backed-off) message
+                # lands at the back, beyond the budget, so a queue of only
+                # not-yet-ready messages costs one cycle per pass, not a
+                # pop/republish spin
+                depth0 = int(
+                    self._ch.queue_declare(
+                        queue=queue, durable=True, passive=True
+                    ).method.message_count
+                )
+                for _ in range(depth0):
                     if len(out) >= max_n:
                         break
                     method, props, payload = self._ch.basic_get(queue)
