@@ -376,8 +376,46 @@ def main() -> None:
         except Exception as e:
             # one v5e chip has 16 GB HBM; a 14.5 GB weight tree may not
             # leave room — record the honest outcome either way
-            log(f"config3b 7B attempt failed: {e!r}")
+            log(f"config3b 7B bf16 attempt failed: {e!r}")
             DETAILS["decode_7b"] = {"error": repr(e)[:500]}
+            gen7 = params7 = None  # noqa: F841 — drop refs before int8 try
+            gc.collect()
+
+        # ---- config 3c: the same 7B in int8 weights (w8a16) — the path
+        # that actually fits one v5e chip (~7.2 GB tree, half the bytes
+        # per decode step; models/quant.py)
+        try:
+            from docqa_tpu.models.quant import init_quantized_decoder_params
+
+            cfg7 = DecoderConfig.mistral_7b()
+            params8 = init_quantized_decoder_params(jax.random.PRNGKey(0), cfg7)
+            pb8 = param_bytes(params8)
+            gen8 = GenerateEngine(
+                cfg7,
+                GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
+                params=params8,
+            )
+            gen8.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
+            t8, _ = timed(
+                lambda: gen8.generate_ids([[5, 9, 11]], max_new_tokens=64), n=3
+            )
+            tok8 = 64 / t8
+            util8 = tok8 * pb8 / (V5E_HBM_GBPS * 1e9) if on_tpu else None
+            DETAILS["decode_7b_int8"] = {
+                "tokens_per_s": round(tok8, 1),
+                "param_bytes_gb": round(pb8 / 1e9, 2),
+                "hbm_utilization": round(util8, 3) if util8 else None,
+            }
+            log(
+                f"config3c Mistral-7B-class int8 ({pb8/1e9:.1f}GB): "
+                f"{tok8:.1f} tok/s"
+                + (f", HBM util {util8:.0%}" if util8 else "")
+            )
+            del gen8, params8
+            gc.collect()
+        except Exception as e:
+            log(f"config3c 7B int8 attempt failed: {e!r}")
+            DETAILS["decode_7b_int8"] = {"error": repr(e)[:500]}
 
     # ---- emit ---------------------------------------------------------------
     try:

@@ -107,6 +107,10 @@ class DecoderConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     sliding_window: Optional[int] = None
+    # int8 weight-only quantization (models/quant.py): halves the weight
+    # tree AND the bytes read per decode step — the configuration that
+    # fits a Mistral-7B-class decoder on one 16 GB v5e chip
+    quantize_weights: bool = False
 
     @staticmethod
     def mistral_7b() -> "DecoderConfig":

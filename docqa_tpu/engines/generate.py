@@ -65,13 +65,41 @@ class GenerateEngine:
         self.mesh = mesh
         self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
         if params is None:
-            params = init_decoder_params(
-                jax.random.PRNGKey(seed),
-                cfg,
-                param_dtype=param_dtype or jnp.dtype(cfg.dtype),
+            if cfg.quantize_weights:
+                from docqa_tpu.models.quant import (
+                    init_quantized_decoder_params,
+                )
+
+                params = init_quantized_decoder_params(
+                    jax.random.PRNGKey(seed), cfg
+                )
+            else:
+                params = init_decoder_params(
+                    jax.random.PRNGKey(seed),
+                    cfg,
+                    param_dtype=param_dtype or jnp.dtype(cfg.dtype),
+                )
+        else:
+            from docqa_tpu.models.quant import (
+                SCALE_SUFFIX,
+                is_quantized,
+                quantize_decoder_params,
             )
-        elif param_dtype is not None:
-            params = {k: v.astype(param_dtype) for k, v in params.items()}
+
+            if cfg.quantize_weights and not is_quantized(params):
+                # honor the knob for SUPPLIED weights too (the path real
+                # HF checkpoints take) — requires the float tree to fit
+                # transiently; the tensor-by-tensor init path covers
+                # random-init at scales where it doesn't
+                params = quantize_decoder_params(params)
+            if param_dtype is not None:
+                # never cast int8 weights or their scales
+                params = {
+                    k: v
+                    if v.dtype == jnp.int8 or k.endswith(SCALE_SUFFIX)
+                    else v.astype(param_dtype)
+                    for k, v in params.items()
+                }
         if mesh is not None:
             params = shard_decoder_params(params, cfg, mesh)
         self.params = params

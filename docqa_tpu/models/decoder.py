@@ -33,6 +33,31 @@ Params = Dict[str, jax.Array]
 KVCache = Dict[str, jax.Array]  # "k0".."k{L-1}", "v0".."v{L-1}"
 
 
+def decoder_param_schema(cfg: DecoderConfig):
+    """The single source of truth for the decoder's parameter tree:
+    yields ``(name, kind, shape, fan_in)`` with kind ∈ {"normal", "ones"}.
+    Both ``init_decoder_params`` and the int8 incremental init
+    (``models/quant.py``) consume this — the RNG stream order is defined
+    by the order of "normal" entries here, so the two inits can never
+    desynchronize."""
+    h = cfg.hidden_dim
+    qd = cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    yield ("tok_emb", "normal", (cfg.vocab_size, h), h)
+    yield ("final_norm_g", "ones", (h,), None)
+    yield ("lm_head", "normal", (h, cfg.vocab_size), h)
+    for i in range(cfg.num_layers):
+        yield (f"l{i}_attn_norm_g", "ones", (h,), None)
+        yield (f"l{i}_wq", "normal", (h, qd), h)
+        yield (f"l{i}_wk", "normal", (h, kvd), h)
+        yield (f"l{i}_wv", "normal", (h, kvd), h)
+        yield (f"l{i}_wo", "normal", (qd, h), qd)
+        yield (f"l{i}_mlp_norm_g", "ones", (h,), None)
+        yield (f"l{i}_w_gate", "normal", (h, cfg.mlp_dim), h)
+        yield (f"l{i}_w_up", "normal", (h, cfg.mlp_dim), h)
+        yield (f"l{i}_w_down", "normal", (cfg.mlp_dim, h), cfg.mlp_dim)
+
+
 def init_decoder_params(
     rng: jax.Array, cfg: DecoderConfig, param_dtype=jnp.float32
 ) -> Params:
@@ -41,36 +66,16 @@ def init_decoder_params(
     *materialized* on a 16 GB chip, so the cast happens per-tensor here,
     never on a whole f32 tree."""
     keys = iter(jax.random.split(rng, 8 + 8 * cfg.num_layers))
-    h = cfg.hidden_dim
-    qd = cfg.num_heads * cfg.head_dim
-    kvd = cfg.num_kv_heads * cfg.head_dim
     param_dtype = jnp.dtype(param_dtype)
-
-    def norm(shape, fan_in):
-        return (
-            jax.random.normal(next(keys), shape, jnp.float32)
-            * (fan_in ** -0.5)
-        ).astype(param_dtype)
-
-    p: Params = {
-        "tok_emb": norm((cfg.vocab_size, h), h),
-        "final_norm_g": jnp.ones((h,), param_dtype),
-        "lm_head": norm((h, cfg.vocab_size), h),
-    }
-    for i in range(cfg.num_layers):
-        p.update(
-            {
-                f"l{i}_attn_norm_g": jnp.ones((h,), param_dtype),
-                f"l{i}_wq": norm((h, qd), h),
-                f"l{i}_wk": norm((h, kvd), h),
-                f"l{i}_wv": norm((h, kvd), h),
-                f"l{i}_wo": norm((qd, h), qd),
-                f"l{i}_mlp_norm_g": jnp.ones((h,), param_dtype),
-                f"l{i}_w_gate": norm((h, cfg.mlp_dim), h),
-                f"l{i}_w_up": norm((h, cfg.mlp_dim), h),
-                f"l{i}_w_down": norm((cfg.mlp_dim, h), cfg.mlp_dim),
-            }
-        )
+    p: Params = {}
+    for name, kind, shape, fan_in in decoder_param_schema(cfg):
+        if kind == "ones":
+            p[name] = jnp.ones(shape, param_dtype)
+        else:
+            p[name] = (
+                jax.random.normal(next(keys), shape, jnp.float32)
+                * (fan_in ** -0.5)
+            ).astype(param_dtype)
     return p
 
 
@@ -96,6 +101,20 @@ def _write_cache(cache_layer: jax.Array, new: jax.Array, offsets: jax.Array):
         return jax.lax.dynamic_update_slice_in_dim(c, n, off, axis=0)
 
     return jax.vmap(one)(cache_layer, new, offsets)
+
+
+def _weight(params: Params, name: str, dtype) -> jax.Array:
+    """Weight fetch with transparent int8 dequantization (models/quant.py):
+    ``q.astype(dtype) * scale`` feeds the consuming matmul directly — XLA
+    fuses the convert+scale into the dot's operand read, so int8 halves the
+    HBM bytes per decode step without a materialized float copy."""
+    from docqa_tpu.models.quant import SCALE_SUFFIX
+
+    w = params[name]
+    scale = params.get(name + SCALE_SUFFIX)
+    if scale is None:
+        return w.astype(dtype)
+    return w.astype(dtype) * scale.astype(dtype)[None, :]
 
 
 def decoder_forward(
@@ -133,13 +152,13 @@ def decoder_forward(
 
     for i in range(cfg.num_layers):
         y = rms_norm(x, params[f"l{i}_attn_norm_g"], cfg.norm_eps)
-        q = (y @ params[f"l{i}_wq"].astype(dtype)).reshape(
+        q = (y @ _weight(params, f"l{i}_wq", dtype)).reshape(
             b, s, cfg.num_heads, cfg.head_dim
         )
-        k = (y @ params[f"l{i}_wk"].astype(dtype)).reshape(
+        k = (y @ _weight(params, f"l{i}_wk", dtype)).reshape(
             b, s, cfg.num_kv_heads, cfg.head_dim
         )
-        v = (y @ params[f"l{i}_wv"].astype(dtype)).reshape(
+        v = (y @ _weight(params, f"l{i}_wv", dtype)).reshape(
             b, s, cfg.num_kv_heads, cfg.head_dim
         )
         q = apply_rope(q, cos, sin, positions)
@@ -158,20 +177,20 @@ def decoder_forward(
             sliding_window=cfg.sliding_window,
         )
         attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim)
-        x = x + (attn @ params[f"l{i}_wo"].astype(dtype))
+        x = x + (attn @ _weight(params, f"l{i}_wo", dtype))
 
         y = rms_norm(x, params[f"l{i}_mlp_norm_g"], cfg.norm_eps)
-        gate = y @ params[f"l{i}_w_gate"].astype(dtype)
-        up = y @ params[f"l{i}_w_up"].astype(dtype)
+        gate = y @ _weight(params, f"l{i}_w_gate", dtype)
+        up = y @ _weight(params, f"l{i}_w_up", dtype)
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(dtype) * up
-        x = x + (act @ params[f"l{i}_w_down"].astype(dtype))
+        x = x + (act @ _weight(params, f"l{i}_w_down", dtype))
 
     if last_token_only and s > 1:
         # prefill path: only the last valid row per lane feeds sampling —
         # skip the [s, vocab] lm_head matmul for the rest (~s x fewer FLOPs)
         x = jnp.take_along_axis(x, (new_lengths - 1)[:, None, None], axis=1)
     x = rms_norm(x, params["final_norm_g"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    logits = (x @ _weight(params, "lm_head", dtype)).astype(jnp.float32)
     return logits, cache
 
 

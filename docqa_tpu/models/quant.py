@@ -1,0 +1,107 @@
+"""Int8 weight-only quantization (w8a16) for the decoder.
+
+Why this exists: BASELINE config 3 names a Mistral-7B-class generator
+(reference: Ollama/llama.cpp host-side, ``llm-qa/main.py:66-69``), but one
+v5e chip has 16 GB HBM and a 7B bf16 weight tree is ~14.5 GB — it OOMs
+once the KV cache and XLA workspace join it (measured).  Weight-only int8
+halves the tree to ~7.2 GB *and* halves the bytes read per decode step,
+which is the whole cost of bandwidth-bound decoding.
+
+Scheme: per-output-channel absmax.  For each 2-D weight ``w [in, out]``:
+
+    scale[out] = max(|w|, axis=in) / 127
+    q[in, out] = round(w / scale)  as int8
+
+The forward pass dequantizes in-kernel — ``q.astype(bf16) * scale`` feeds
+the matmul directly, and XLA fuses the convert+multiply into the dot's
+operand read, so the dequantized tree never materializes in HBM.
+Activations stay bf16 (w8a16): no calibration data needed, and per-channel
+absmax keeps the worst-case relative weight error ≤ 1/254.
+
+Embeddings and norm gains stay in bf16/f32: ``tok_emb`` is a gather (only
+``seq`` rows read per step — no bandwidth win) and norm vectors are tiny.
+
+Memory discipline: ``init_quantized_decoder_params`` quantizes tensor-by-
+tensor as it initializes, so peak HBM is the int8 tree plus ONE float
+tensor — a quantize-after-full-init would need bf16 + int8 simultaneously
+(~21 GB at 7B, un-materializable on the target chip).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from docqa_tpu.config import DecoderConfig
+
+Params = Dict[str, jax.Array]
+
+SCALE_SUFFIX = "__scale"
+
+# 2-D matmul weights that quantize; everything else passes through
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(params: Params) -> bool:
+    return any(k.endswith(SCALE_SUFFIX) for k in params)
+
+
+def should_quantize(name: str) -> bool:
+    if name == "lm_head":
+        return True
+    return any(name.endswith(f"_{k}") for k in _QUANT_KEYS)
+
+
+@jax.jit
+def quantize_array(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """w [in, out] → (int8 [in, out], f32 scale [out]) per-column absmax."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=0) / 127.0
+    scale = jnp.maximum(scale, 1e-12)  # dead column → scale 0 → NaN guard
+    q = jnp.clip(jnp.round(w32 / scale[None, :]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_decoder_params(params: Params) -> Params:
+    """Quantize an existing float tree (fits when the float tree fits)."""
+    out: Params = {}
+    for name, w in params.items():
+        if should_quantize(name) and w.ndim == 2:
+            q, scale = quantize_array(w)
+            out[name] = q
+            out[name + SCALE_SUFFIX] = scale
+        else:
+            out[name] = w
+    return out
+
+
+def init_quantized_decoder_params(
+    rng: jax.Array, cfg: DecoderConfig
+) -> Params:
+    """Random-init directly into int8 — tensor-by-tensor, so a 7B tree
+    peaks at ~7.2 GB + one float tensor instead of bf16+int8 together.
+
+    Consumes ``decoder_param_schema`` (the same generator
+    ``init_decoder_params`` uses), drawing RNG keys in the identical
+    order — so this IS the float init, quantized, by construction."""
+    from docqa_tpu.models.decoder import decoder_param_schema
+
+    keys = iter(jax.random.split(rng, 8 + 8 * cfg.num_layers))
+    out: Params = {}
+    for name, kind, shape, fan_in in decoder_param_schema(cfg):
+        if kind == "ones":
+            out[name] = jnp.ones(shape, jnp.bfloat16)
+            continue
+        w = jax.random.normal(next(keys), shape, jnp.float32) * (
+            fan_in ** -0.5
+        )
+        if should_quantize(name):
+            q, scale = quantize_array(w)
+            out[name] = q
+            out[name + SCALE_SUFFIX] = scale
+        else:
+            out[name] = w.astype(jnp.bfloat16)
+        del w
+    return out

@@ -59,9 +59,20 @@ def cache_pspecs(cfg: DecoderConfig, mesh: MeshContext) -> Dict[str, P]:
 
 
 def shard_decoder_params(params, cfg: DecoderConfig, mesh: MeshContext):
+    from docqa_tpu.models.quant import SCALE_SUFFIX
+
     specs = decoder_param_pspecs(cfg, mesh.model_axis)
+
+    def spec_for(name):
+        if name.endswith(SCALE_SUFFIX):
+            # per-output-channel int8 scale [out] follows its weight's
+            # output-dim sharding (models/quant.py)
+            base = specs[name[: -len(SCALE_SUFFIX)]]
+            return P(base[1])
+        return specs[name]
+
     return {
-        k: jax.device_put(v, NamedSharding(mesh.mesh, specs[k]))
+        k: jax.device_put(v, NamedSharding(mesh.mesh, spec_for(k)))
         for k, v in params.items()
     }
 
