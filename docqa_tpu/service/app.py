@@ -155,8 +155,21 @@ class DocQARuntime:
             batcher=self.batcher,
         )
 
+        if journal_dir is None and self.cfg.data.work_dir:
+            # queue journal rides the persistence root: un-acked pipeline
+            # messages replay after a crash (at-least-once across restarts)
+            journal_dir = os.path.join(self.cfg.data.work_dir, "journal")
         self.broker = make_broker(self.cfg.broker, journal_dir=journal_dir)
-        self.registry = DocumentRegistry(self.cfg.registry.url)
+        registry_url = self.cfg.registry.url
+        if registry_url == "sqlite://" and self.cfg.data.work_dir:
+            # persistence on → document records must survive restarts too
+            # (an index that outlives its registry would serve vectors for
+            # documents /documents/ no longer lists)
+            os.makedirs(self.cfg.data.work_dir, exist_ok=True)
+            registry_url = "sqlite:///" + os.path.join(
+                self.cfg.data.work_dir, "registry.db"
+            )
+        self.registry = DocumentRegistry(registry_url)
         self.pipeline = DocumentPipeline(
             self.cfg,
             self.broker,
@@ -166,6 +179,35 @@ class DocQARuntime:
             self.store,
             on_indexed=self._on_indexed,
         )
+
+        # ---- registry ↔ index reconciliation: a crash between periodic
+        # snapshots can leave durable INDEXED rows whose vectors never made
+        # it into the restored snapshot.  The registry must not lie —
+        # re-mark those documents ERROR_INDEXING (their raw text is gone;
+        # re-upload is the recovery path, and /documents/ now says so).
+        if self._index_dir:
+            try:
+                indexed_ids = {
+                    md.get("doc_id") for md in self.store.metadata_rows()
+                }
+                from docqa_tpu.service import registry as reg
+
+                lost = [
+                    rec
+                    for rec in self.registry.list_documents()
+                    if rec.status == reg.INDEXED
+                    and rec.doc_id not in indexed_ids
+                ]
+                for rec in lost:
+                    self.registry.set_status(rec.doc_id, reg.ERROR_INDEXING)
+                if lost:
+                    log.warning(
+                        "reconciled %d registry rows whose vectors predate "
+                        "the restored snapshot (re-marked ERROR_INDEXING)",
+                        len(lost),
+                    )
+            except Exception:
+                log.exception("registry/index reconciliation failed")
 
         # ---- first-boot knowledge base (parity: indexer.py:102-107 indexed
         # default_data/*.csv into an otherwise-empty index)

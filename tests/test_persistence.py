@@ -67,6 +67,40 @@ class TestKillAndRestart:
             assert out["sources"]
             rows = rt2.qa.patient_snippets("p1")
             assert rows and "Aspirin" in rows[0]["text"]
+            # ... and the document REGISTRY survived too (work_dir routes
+            # the default in-memory registry onto disk): /documents/ lists
+            # the pre-restart upload with its terminal status
+            docs = rt2.registry.list_documents()
+            assert any(
+                d.filename == "note.txt" and d.status == "INDEXED"
+                for d in docs
+            )
+        finally:
+            rt2.stop()
+
+    def test_crash_between_snapshots_reconciles_registry(self, tmp_path):
+        """Review regression: with snapshot_every=64 a crash can lose
+        vectors that the now-durable registry already recorded as INDEXED.
+        The restart must re-mark them ERROR_INDEXING — a registry that
+        claims INDEXED for unretrievable documents is lying."""
+        from docqa_tpu.service import registry as reg
+
+        cfg = _cfg(tmp_path, **{"data.snapshot_every": 10_000})
+        rt1 = DocQARuntime(cfg).start()
+        rec = rt1.pipeline.ingest_document("lost.txt", NOTE.encode())
+        assert rt1.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        # simulate SIGKILL: tear down WITHOUT the shutdown snapshot
+        rt1.pipeline.stop()
+        if rt1.batcher is not None:
+            rt1.batcher.stop()
+        rt1.broker.close()
+        rt1.registry.close()
+
+        rt2 = DocQARuntime(cfg).start()
+        try:
+            rec2 = rt2.registry.get(rec.doc_id)
+            assert rec2.status == reg.ERROR_INDEXING  # not a lying INDEXED
+            assert rt2.store.count == 0  # vectors really were lost
         finally:
             rt2.stop()
 

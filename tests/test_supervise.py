@@ -1,0 +1,134 @@
+"""Supervisor loop (scripts/start_all.py --supervise): crash → restart →
+resume from the persistence root.
+
+The reference had no failure-recovery story at all (SURVEY §2c: single
+host, Windows batch launcher; §5: no retry budget, no supervision).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+START = os.path.join(REPO, "scripts", "start_all.py")
+
+TINY = {
+    "encoder.hidden_dim": 64, "encoder.num_layers": 1, "encoder.num_heads": 4,
+    "encoder.mlp_dim": 128, "encoder.embed_dim": 64, "store.dim": 64,
+    "ner.train_steps": 0, "decoder.hidden_dim": 64, "decoder.num_layers": 1,
+    "decoder.num_heads": 4, "decoder.num_kv_heads": 2, "decoder.head_dim": 16,
+    "decoder.mlp_dim": 128, "decoder.vocab_size": 512,
+    "generate.max_new_tokens": 8, "flags.use_fake_llm": True,
+    "flags.use_fake_encoder": True, "data.snapshot_every": 1,
+}
+
+PORT = 18921
+
+
+def _get(path, timeout=2):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{PORT}{path}", timeout=timeout
+    ) as r:
+        return json.loads(r.read())
+
+
+def _post(path, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{PORT}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_health(deadline_s=120):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            if _get("/health")["status"] == "ok":
+                return True
+        except Exception:
+            time.sleep(0.5)
+    return False
+
+
+def test_supervisor_restarts_after_kill(tmp_path):
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(TINY))
+    pid_file = tmp_path / "server.pid"
+    env = dict(os.environ)
+    sup = subprocess.Popen(
+        [
+            sys.executable, START, "--cpu", "--supervise",
+            "--port", str(PORT),
+            "--work-dir", str(tmp_path / "work"),
+            "--data-dir", str(tmp_path / "empty"),
+            "--config", str(cfg_path),
+            "--pid-file", str(pid_file),
+        ],
+        env=env,
+        cwd=REPO,
+    )
+    try:
+        assert _wait_health(), "server never became healthy"
+        out = _post(
+            "/ingest/?wait=1",
+            {"filename": "n.txt", "text": "Aspirin 100 mg daily.", "patient_id": "p1"},
+        )
+        assert out["status"] == "INDEXED"
+        pid1 = int(pid_file.read_text())
+
+        os.kill(pid1, signal.SIGKILL)  # crash the server, not the supervisor
+        # supervisor notices the exit and restarts with backoff
+        deadline = time.time() + 180
+        pid2 = pid1
+        while time.time() < deadline:
+            try:
+                pid2 = int(pid_file.read_text())
+                if pid2 != pid1 and _get("/health")["status"] == "ok":
+                    break
+            except Exception:
+                pass
+            time.sleep(1)
+        assert pid2 != pid1, "supervisor did not restart the server"
+        assert _wait_health(60)
+        # resumed from the persistence root: the pre-crash document is
+        # still listed AND still answerable
+        docs = _get("/documents/")
+        assert any(d["filename"] == "n.txt" and d["status"] == "INDEXED" for d in docs)
+        ans = _post("/ask/", {"question": "aspirin dose?"})
+        assert ans["sources"]
+
+        # SIGTERM to the SUPERVISOR must take the child down too (no
+        # orphaned server holding the port)
+        child_pid = int(pid_file.read_text())
+        sup.send_signal(signal.SIGTERM)
+        sup.wait(timeout=30)
+        deadline = time.time() + 20
+        child_gone = False
+        while time.time() < deadline:
+            try:
+                os.kill(child_pid, 0)
+            except ProcessLookupError:
+                child_gone = True
+                break
+            time.sleep(0.5)
+        assert child_gone, "supervisor exit orphaned the server"
+    finally:
+        if sup.poll() is None:
+            sup.send_signal(signal.SIGTERM)
+        try:
+            os.kill(int(pid_file.read_text()), signal.SIGKILL)
+        except Exception:
+            pass
+        try:
+            sup.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            sup.kill()
