@@ -149,18 +149,28 @@ def main() -> None:
         f"What formula treats syndrome {i} with highest score and why?"
         for i in range(n_queries + 2)
     ]
+    from docqa_tpu.engines.retrieve import FusedRetriever
+
+    retriever = FusedRetriever(encoder, store)
     emb0 = encoder.encode_texts([q_texts[0]])  # compile
     store.search(emb0, k=3)
     store.search(emb0, k=10)  # the timed shape (jit key includes k)
+    retriever.search_texts([q_texts[0]], k=3)  # compile fused (headline shape)
+    retriever.search_texts([q_texts[0]], k=10)
     t_enc, _ = timed(lambda: encoder.encode_texts([q_texts[1]]), n=5)
     t_search, _ = timed(lambda: store.search(emb0, k=10), n=5)
+    t_fused, _ = timed(
+        lambda: retriever.search_texts([q_texts[1]], k=10), n=5
+    )
     DETAILS["retrieval"] = {
         "encode_ms": round(t_enc * 1e3, 2),
         "exact_top10_ms": round(t_search * 1e3, 2),
+        "fused_query_top10_ms": round(t_fused * 1e3, 2),
     }
     log(
         f"config1 retrieval: encode {t_enc*1e3:.1f}ms, "
-        f"exact top-10 @ {n_chunks}: {t_search*1e3:.1f}ms"
+        f"exact top-10 @ {n_chunks}: {t_search*1e3:.1f}ms, "
+        f"fused text->top-10: {t_fused*1e3:.1f}ms"
     )
 
     # ---- IVF / tiered: recall@10 + latency vs exact -------------------------
@@ -187,16 +197,28 @@ def main() -> None:
             hits += len(want & {r.row_id for r in a_row})
             total += len(want)
         t_exact20, _ = timed(lambda: store.search(probes, k=10))
+        # batch-1 is IVF's regime: a single query probes nprobe*cap rows
+        # (~3% of the corpus) while exact must stream every row; at batch-20
+        # the exact matmul amortizes its one corpus read over all queries
+        # and wins — both numbers are reported so the crossover is explicit
+        one = probes[:1]
+        store.search(one, k=10)
+        tiered.search(one, k=10)  # compile batch-1 shapes
+        t_tier1, _ = timed(lambda: tiered.search(one, k=10), n=5)
+        t_exact1, _ = timed(lambda: store.search(one, k=10), n=5)
         DETAILS["ivf"] = {
             "recall_at_10": round(hits / max(total, 1), 4),
             "build_s": round(t_build, 1),
             "tiered_batch20_ms": round(t_tier * 1e3, 2),
             "exact_batch20_ms": round(t_exact20 * 1e3, 2),
+            "tiered_batch1_ms": round(t_tier1 * 1e3, 2),
+            "exact_batch1_ms": round(t_exact1 * 1e3, 2),
         }
         log(
             f"ivf: recall@10 {hits/max(total,1):.3f}, build {t_build:.1f}s, "
-            f"batch-20 search tiered {t_tier*1e3:.1f}ms vs exact "
-            f"{t_exact20*1e3:.1f}ms"
+            f"batch-20 tiered {t_tier*1e3:.1f}ms vs exact "
+            f"{t_exact20*1e3:.1f}ms; batch-1 tiered {t_tier1*1e3:.1f}ms "
+            f"vs exact {t_exact1*1e3:.1f}ms"
         )
         del tiered
         gc.collect()
@@ -205,48 +227,83 @@ def main() -> None:
         DETAILS["ivf"] = {"error": repr(e)}
 
     # ---- headline: e2e QA latency (solo requests) ---------------------------
-    def ask(q: str) -> None:
-        emb = encoder.encode_texts([q])
-        hits = store.search(emb, k=3)[0]
-        ctx = "\n".join(
-            f"[{h.metadata['doc_id']}] {h.metadata['source']}" for h in hits
-        )
-        prompt = f"Context:\n{ctx}\n\nQuestion: {q}\nAnswer:"
-        gen.generate_texts([prompt], max_new_tokens=max_new)
+    # The serving default is int8 weight-only (w8a16, models/quant.py):
+    # decode is HBM-bandwidth bound, so halving the weight bytes read per
+    # step is the single biggest latency lever, and the scheme's worst-case
+    # relative weight error (<=1/254 per channel) is quality-neutral at
+    # serving precision.  The bf16 engine is measured alongside for
+    # comparability with round 1.
+    def make_ask(engine):
+        def ask(q: str) -> None:
+            hits = retriever.search_texts([q], k=3)[0]
+            ctx = "\n".join(
+                f"[{h.metadata['doc_id']}] {h.metadata['source']}" for h in hits
+            )
+            prompt = f"Context:\n{ctx}\n\nQuestion: {q}\nAnswer:"
+            engine.generate_texts([prompt], max_new_tokens=max_new)
 
-    for q in q_texts[:2]:  # compile prefill/decode
-        ask(q)
-    lat = []
-    for q in q_texts[2:]:
-        t0 = time.perf_counter()
-        ask(q)
-        lat.append((time.perf_counter() - t0) * 1000.0)
-    p50 = float(np.percentile(lat, 50))
-    p95 = float(np.percentile(lat, 95))
+        return ask
+
+    def measure_e2e(engine, queries, tag):
+        ask = make_ask(engine)
+        for q in q_texts[:2]:  # compile prefill/decode at the served shapes
+            ask(q)
+        lat = []
+        for q in queries:
+            t0 = time.perf_counter()
+            ask(q)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        p50 = float(np.percentile(lat, 50))
+        p95 = float(np.percentile(lat, 95))
+        log(f"{tag} e2e: p50 {p50:.1f}ms p95 {p95:.1f}ms ({max_new} new tokens)")
+        return p50, p95
+
+    def measure_decode(engine, key, tag):
+        pb = param_bytes(engine.params)
+        n_tok = 64 if not small else 8
+        engine.generate_ids([[5, 9, 11]], max_new_tokens=n_tok)  # compile
+        t_dec, _ = timed(
+            lambda: engine.generate_ids([[5, 9, 11]], max_new_tokens=n_tok),
+            n=3,
+        )
+        tok_s = n_tok / t_dec
+        hbm_util = tok_s * pb / (V5E_HBM_GBPS * 1e9) if on_tpu else None
+        DETAILS[key] = {
+            "tokens_per_s": round(tok_s, 1),
+            "param_bytes_gb": round(pb / 1e9, 2),
+            "hbm_utilization": round(hbm_util, 3) if hbm_util else None,
+        }
+        log(
+            f"{tag} decode ({pb/1e9:.1f}GB params): {tok_s:.0f} tok/s"
+            + (f", HBM util {hbm_util:.0%}" if hbm_util else "")
+        )
+
+    # bf16 companion numbers (round-1 comparability)
+    p50_bf16, p95_bf16 = measure_e2e(gen, q_texts[2:7], "bf16")
+    DETAILS["qa_e2e_bf16"] = {
+        "p50_ms": round(p50_bf16, 2),
+        "p95_ms": round(p95_bf16, 2),
+        "new_tokens": max_new,
+        "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}",
+    }
+    measure_decode(gen, "decode_1b", "config3a bf16")
+    del gen
+    gc.collect()
+
+    # the served engine: same architecture, int8 weights
+    import dataclasses
+
+    gen = GenerateEngine(
+        dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+    )
+    p50, p95 = measure_e2e(gen, q_texts[2:], "headline (int8 serving)")
     DETAILS["qa_e2e"] = {
         "p50_ms": round(p50, 2),
         "p95_ms": round(p95, 2),
         "new_tokens": max_new,
-        "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}",
+        "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8",
     }
-    log(f"headline e2e: p50 {p50:.1f}ms p95 {p95:.1f}ms ({max_new} new tokens)")
-
-    # ---- config 3a: decode tokens/s + HBM utilization (serving model) ------
-    pb = param_bytes(gen.params)
-    n_tok = 64 if not small else 8
-    gen.generate_ids([[5, 9, 11]], max_new_tokens=n_tok)  # compile
-    t_dec, _ = timed(lambda: gen.generate_ids([[5, 9, 11]], max_new_tokens=n_tok), n=3)
-    tok_s = n_tok / t_dec
-    hbm_util = tok_s * pb / (V5E_HBM_GBPS * 1e9) if on_tpu else None
-    DETAILS["decode_1b"] = {
-        "tokens_per_s": round(tok_s, 1),
-        "param_bytes_gb": round(pb / 1e9, 2),
-        "hbm_utilization": round(hbm_util, 3) if hbm_util else None,
-    }
-    log(
-        f"config3a decode ({pb/1e9:.1f}GB params): {tok_s:.0f} tok/s"
-        + (f", HBM util {hbm_util:.0%}" if hbm_util else "")
-    )
+    measure_decode(gen, "decode_1b_int8", "config3a int8")
 
     # ---- config 5: sustained QPS through the continuous batcher -------------
     try:
@@ -256,7 +313,14 @@ def main() -> None:
             gen, n_slots=16, chunk=32, cache_len=1024 if not small else 256
         )
         prompt_ids = [[7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(64)]
-        # warm: compile prefill + slot decode
+        # warm: compile the batched admission prefill at the shapes the
+        # loaded rounds hit (full-slot rounds) plus trickle shapes, and the
+        # slot decode program
+        for h in [
+            batcher.submit_ids(p, max_new_tokens=4)
+            for p in prompt_ids[: batcher.n_slots]
+        ]:
+            h.result()
         batcher.submit_ids(prompt_ids[0], max_new_tokens=max_new).result()
         n_req = 64 if not small else 8
         t0 = time.perf_counter()
@@ -347,7 +411,8 @@ def main() -> None:
 
             cfg7 = DecoderConfig.mistral_7b()
             params7 = init_decoder_params(
-                jax.random.PRNGKey(0), cfg7, param_dtype=jnp.bfloat16
+                jax.random.PRNGKey(0), cfg7, param_dtype=jnp.bfloat16,
+                host_init=True,
             )
             pb7 = param_bytes(params7)
             gen7 = GenerateEngine(
@@ -388,7 +453,9 @@ def main() -> None:
             from docqa_tpu.models.quant import init_quantized_decoder_params
 
             cfg7 = DecoderConfig.mistral_7b()
-            params8 = init_quantized_decoder_params(jax.random.PRNGKey(0), cfg7)
+            params8 = init_quantized_decoder_params(
+                jax.random.PRNGKey(0), cfg7, host_init=True
+            )
             pb8 = param_bytes(params8)
             gen8 = GenerateEngine(
                 cfg7,

@@ -115,24 +115,55 @@ def _gibberish(rng: np.random.Generator) -> str:
 # {S}=scan-type O-word.  Entity spans are computed by construction.
 # ---------------------------------------------------------------------------
 
+# Compositional clause bank: subjects x predicates gives combinatorial
+# coverage of entity-in-context positions.  Fixed whole-sentence templates
+# alone left composition gaps — a tagger trained on "Patient {P} was
+# admitted..." AND "{P} from {L} presented..." still missed the live
+# composition "Patient {P} from {L} was admitted on <date>..." (observed in
+# the round-2 service drive).
+_SUBJECTS: Tuple[str, ...] = (
+    "Patient {P}",
+    "{P}",
+    "Mr {P}",
+    "Ms {P}",
+    "Dr {P}",
+    "Spouse {P}",
+    "Daughter {P}",
+    "Caregiver {P}",
+    "{P} from {L}",
+    "Patient {P} from {L}",
+    "{P} of {L}",
+    "{P}, a {N} male,",
+    "{P}, a {N} female,",
+    "Patient {P}, who is {N},",
+)
+_PREDICATES: Tuple[str, ...] = (
+    "was admitted with chest pain.",
+    "was admitted on Monday with shortness of breath.",
+    "reports worsening dyspnea over two days.",
+    "presented to the emergency department.",
+    "was seen today in clinic.",
+    "denies tobacco use.",
+    "has a history of hypertension.",
+    "will follow up in two weeks.",
+    "was discharged home in stable condition.",
+    "requests an interpreter for the next visit.",
+    "tolerated the procedure well.",
+    "reports good adherence to medications.",
+)
+
 _TEMPLATES: Tuple[str, ...] = (
-    "Patient {P} was admitted with chest pain.",
-    "{P} reports worsening dyspnea over two days.",
-    "{P} from {L} presented to the emergency department.",
+    # fixed forms the clause bank cannot express (entity mid/late sentence,
+    # multi-entity, possessives)
     "{P} lives in {L} with family.",
     "{P} resides in {L} and works as a teacher.",
-    "Spouse {P} was present at the bedside.",
     "Discussed the discharge plan with {P} today.",
-    "{P}, a {N} male, denies tobacco use.",
-    "{P} is a {N} female with a history of hypertension.",
     "The patient identifies as {N} and requests an interpreter.",
     "{P} recently traveled to {L} for work.",
     "Patient transferred from a clinic in {L}.",
     "Per {P}, symptoms began after returning from {L}.",
     "{P} of {N} descent presented for follow-up.",
-    "Daughter {P} will assist with medications at home.",
     "{P} moved to {L} last year.",
-    "Caregiver {P} reports good adherence.",
     "History obtained from {P}, the patient's brother.",
     # short intake-header forms (sentence-initial entities, minimal context)
     "{P} from {L}.",
@@ -140,7 +171,6 @@ _TEMPLATES: Tuple[str, ...] = (
     "Name: {P}.",
     "Address: {L}.",
     "Emergency contact: {P}, number on file.",
-    "{P} was seen today.",
     "Referred by {P}.",
     "{P} and spouse attended the visit.",
     # negatives: no PHI, plenty of capitalized O words
@@ -226,7 +256,12 @@ def generate_example(
     spans: List[Tuple[int, int, str]] = []
     offset = 0
     for _ in range(n):
-        tmpl = str(rng.choice(_TEMPLATES))
+        if rng.random() < 0.5:  # compositional subject + predicate
+            tmpl = (
+                str(rng.choice(_SUBJECTS)) + " " + str(rng.choice(_PREDICATES))
+            )
+        else:
+            tmpl = str(rng.choice(_TEMPLATES))
         text, s = _fill(rng, tmpl, lexicons, gibberish_frac)
         parts.append(text)
         spans.extend((a + offset, b + offset, e) for a, b, e in s)

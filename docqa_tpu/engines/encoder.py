@@ -27,6 +27,35 @@ SEQ_BUCKETS = (64, 128, 256, 512)
 BATCH_BUCKETS = (8, 32, 128)
 
 
+def marshal_texts(
+    tokenizer,
+    cfg: EncoderConfig,
+    texts: Sequence[str],
+    batch_buckets: Tuple[int, ...] = BATCH_BUCKETS,
+    n_data: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Tokenize + seq/batch bucket + pad — THE query/document marshalling
+    path, shared by :class:`EncoderEngine` and the fused retrieval program
+    (``engines/retrieve.py``) so the two can never drift apart.  Returns
+    (ids [B, S] int32, lengths [B] int32) with rows beyond ``len(texts)``
+    zero-padded (zero-length lanes pool to a zero vector downstream)."""
+    n = len(texts)
+    ids, lengths = tokenizer.batch(
+        texts, max_len=min(cfg.max_seq_len, SEQ_BUCKETS[-1])
+    )
+    seq_b = min(
+        _bucket(int(lengths.max()) if n else 1, SEQ_BUCKETS), ids.shape[1]
+    )
+    batch_b = _bucket(n, batch_buckets) if n <= batch_buckets[-1] else n
+    if n_data is not None:
+        batch_b = round_up(batch_b, n_data)
+    ids_p = np.zeros((batch_b, seq_b), np.int32)
+    len_p = np.zeros((batch_b,), np.int32)
+    ids_p[:n] = ids[:, :seq_b]
+    len_p[:n] = np.minimum(lengths, seq_b)
+    return ids_p, len_p
+
+
 class EncoderEngine:
     def __init__(
         self,
@@ -61,21 +90,13 @@ class EncoderEngine:
 
     def _encode_one_batch(self, texts: Sequence[str]) -> np.ndarray:
         n = len(texts)
-        ids, lengths = self.tokenizer.batch(
-            texts, max_len=min(self.cfg.max_seq_len, SEQ_BUCKETS[-1])
-        )
-        seq_b = min(
-            _bucket(int(lengths.max()) if n else 1, SEQ_BUCKETS), ids.shape[1]
-        )
-        batch_b = _bucket(n, BATCH_BUCKETS)
-        if self.mesh is not None:
+        ids_p, len_p = marshal_texts(
+            self.tokenizer,
+            self.cfg,
+            texts,
             # batch axis must divide evenly over the data axis
-            batch_b = round_up(batch_b, self.mesh.n_data)
-        ids_p = np.zeros((batch_b, seq_b), np.int32)
-        len_p = np.zeros((batch_b,), np.int32)
-        ids_p[:n] = ids[:, :seq_b]
-        len_p[:n] = np.minimum(lengths, seq_b)
-
+            n_data=self.mesh.n_data if self.mesh is not None else None,
+        )
         ids_j, len_j = jnp.asarray(ids_p), jnp.asarray(len_p)
         if self.mesh is not None and self.mesh.n_data > 1:
             ids_j = jax.device_put(ids_j, self.mesh.batch_sharded)

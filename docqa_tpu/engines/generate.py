@@ -71,13 +71,18 @@ class GenerateEngine:
                 )
 
                 params = init_quantized_decoder_params(
-                    jax.random.PRNGKey(seed), cfg
+                    jax.random.PRNGKey(seed), cfg, host_init=True
                 )
             else:
+                # host_init: draw on host + device_put per tensor — the same
+                # transfer path real checkpoints take, and it avoids the
+                # tunneled-client degradation the device-side random-init
+                # sequence was measured to trigger (see init_decoder_params)
                 params = init_decoder_params(
                     jax.random.PRNGKey(seed),
                     cfg,
                     param_dtype=param_dtype or jnp.dtype(cfg.dtype),
+                    host_init=True,
                 )
         else:
             from docqa_tpu.models.quant import (

@@ -1,0 +1,128 @@
+"""Fused query path: tokenize on host, then ONE device dispatch runs the
+query encoder forward -> L2 normalize -> exact top-k over the store buffer.
+
+The reference's query path was two host libraries glued by a host-side
+embedding round-trip: sentence-transformers batch-1 encode, then FAISS
+``IndexFlatL2.search`` (``llm-qa/main.py:25,101``; SURVEY §3.2 HOT marks).
+The round-1 build kept that two-dispatch shape (encoder program, then
+search program) — measured on the tunneled single chip, each dispatch
+carries a fixed host<->device round-trip cost that dwarfs the ~1 ms of
+device time either program needs, and the intermediate embedding paid an
+extra device->host->device hop.  Fusing collapses /ask retrieval to one
+XLA program and keeps the embedding on-device.
+
+Mesh caveat: with a row-sharded store (n_model > 1) search runs under
+``shard_map`` while the encoder is replicated-batch — the fused program
+would need the query broadcast inside the shard_map body.  That
+composition is left to the store's own kernel; the retriever transparently
+falls back to the two-dispatch path there (the multi-chip case amortizes
+dispatch overhead over 8 programs anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from docqa_tpu.engines.encoder import marshal_texts
+from docqa_tpu.index.store import SearchResult, VectorStore, _search_single
+from docqa_tpu.models.encoder import encode_batch
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+
+QUERY_BATCH_BUCKETS = (1, 4, 16)
+
+
+class FusedRetriever:
+    """Text-in, ranked-rows-out retrieval in a single dispatch.
+
+    Wraps an :class:`EncoderEngine` (for its params/config/tokenizer) and a
+    :class:`VectorStore` (for its device buffer + host metadata).  The
+    compiled program is cached per (batch-bucket, seq-bucket, k, masked,
+    store-capacity) — capacity participates because the store reallocates
+    its buffer when it doubles.
+    """
+
+    def __init__(self, encoder, store: VectorStore):
+        self.encoder = encoder
+        self.store = store
+        self._fns: Dict[Any, Any] = {}
+
+    @property
+    def _fusable(self) -> bool:
+        mesh = self.store.mesh
+        return mesh is None or getattr(mesh, "n_model", 1) == 1
+
+    def _get_fn(self, k: int, masked: bool):
+        key = (k, masked)
+        fn = self._fns.get(key)
+        if fn is None:
+            enc_cfg = self.encoder.cfg
+
+            def program(enc_params, ids, lengths, buf, count, mask):
+                emb = encode_batch(enc_params, enc_cfg, ids, lengths)
+                vals, row_ids = _search_single(
+                    buf, emb.astype(buf.dtype), count, mask, k
+                )
+                return vals, row_ids, emb
+
+            if masked:
+                fn = jax.jit(program)
+            else:
+                fn = jax.jit(
+                    lambda p, i, l, b, c: program(p, i, l, b, c, None)
+                )
+            self._fns[key] = fn
+        return fn
+
+    def search_texts(
+        self,
+        texts: Sequence[str],
+        k: Optional[int] = None,
+        filters: Optional[Dict[str, Any]] = None,
+    ) -> List[List[SearchResult]]:
+        """Same contract as ``store.search`` but from raw query texts."""
+        store = self.store
+        k = k or store.cfg.default_k
+        if not len(texts):
+            return []
+        if not self._fusable:
+            emb = self.encoder.encode_texts(texts)
+            return store.search(emb, k=k, filters=filters)
+
+        n = len(texts)
+        ids_p, len_p = marshal_texts(
+            self.encoder.tokenizer,
+            self.encoder.cfg,
+            texts,
+            batch_buckets=QUERY_BATCH_BUCKETS,
+        )
+
+        # Dispatch under the store lock: add() donates the device buffer
+        # (same discipline as store.search).
+        with store._lock:
+            count = store._count
+            if count == 0:
+                return [[] for _ in texts]
+            k_eff = min(k, count)
+            mask = None
+            if filters:
+                mask = store._filter_mask_locked(filters)
+            fn = self._get_fn(k_eff, masked=mask is not None)
+            args = [
+                self.encoder.params,
+                jnp.asarray(ids_p),
+                jnp.asarray(len_p),
+                store._dev,
+                jnp.int32(count),
+            ]
+            if mask is not None:
+                args.append(jnp.asarray(mask))
+            with span("fused_query", DEFAULT_REGISTRY):
+                vals, row_ids, _emb = fn(*args)
+        vals = np.asarray(vals)[:n]
+        row_ids = np.asarray(row_ids)[:n]
+        return store.assemble_results(vals, row_ids)

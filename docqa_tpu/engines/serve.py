@@ -5,8 +5,11 @@ request (``llm-qa/main.py:66-69``) — no batching, no admission control.
 Here a fixed pool of decode *slots* shares one KV cache and one jit decode
 program:
 
-* admission: a queued request prefills into any free slot (its own jit
-  program per prompt bucket) while the other slots keep decoding;
+* admission: every free slot is filled from the queue in ONE batched
+  prefill dispatch per round (requests ride the batch axis, each lane
+  scattering its prompt K/V into its slot of the shared cache) — measured
+  on the tunneled chip, per-request prefill dispatches were the QPS
+  ceiling: 16 sequential batch-1 forwards cost ~12x one batch-16 forward;
 * decode: ONE program advances all slots a chunk of tokens per dispatch
   (``lax.fori_loop`` inside jit — no host round-trip per token, SURVEY §7
   hard part (b)); finished lanes go inactive inside the chunk;
@@ -25,7 +28,7 @@ import collections
 import functools
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -119,7 +122,7 @@ class ContinuousBatcher:
         self._queue: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stopped = False
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fn = None
         self._decode_fn = None
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="continuous-batcher"
@@ -132,28 +135,37 @@ class ContinuousBatcher:
         self._rng_counter += 1
         return jax.random.PRNGKey(self._seed * 100_003 + self._rng_counter)
 
-    def _prefill_program(self, params, cache, ids, length, slot, rng):
-        """Prefill one request into slot ``slot`` of the shared cache."""
-        local = init_kv_cache(self.cfg, 1, max_len=self.cache_len)
+    def _prefill_program(self, params, cache, ids, lengths, slots, rng):
+        """Prefill a whole admission round in ONE dispatch.
+
+        ``ids`` [B, bucket] right-padded prompts, ``lengths`` [B] true
+        lengths, ``slots`` [B] destination slots — padding lanes carry
+        ``slots[i] == n_slots`` (out of bounds) so their scatter is dropped.
+        The per-lane prompt K/V lives in a local [B, bucket] cache and only
+        those ``bucket`` rows are scattered into each target slot (decode
+        steps write later rows directly), so the transient is O(B x bucket),
+        not O(B x cache_len)."""
+        B, bucket = ids.shape
+        local = init_kv_cache(self.cfg, B, max_len=bucket)
         logits, local = decoder_forward(
             params,
             self.cfg,
             ids,
             local,
-            jnp.zeros((1,), jnp.int32),
-            attn_lengths=length,
+            jnp.zeros((B,), jnp.int32),
+            attn_lengths=lengths,
             use_flash=self.engine.use_flash,
             last_token_only=True,
         )
-        tok = sample(
+        toks = sample(
             logits[:, -1], rng, self.gen.temperature, self.gen.top_k,
             self.gen.top_p,
         )
         for key in cache:
-            cache[key] = jax.lax.dynamic_update_slice(
-                cache[key], local[key].astype(cache[key].dtype), (slot, 0, 0, 0)
+            cache[key] = cache[key].at[slots, :bucket].set(
+                local[key].astype(cache[key].dtype), mode="drop"
             )
-        return cache, tok[0]
+        return cache, toks
 
     def _decode_program(self, params, cache, tok, lengths, active, rng):
         """Advance every active slot by ``self.chunk`` tokens in one dispatch.
@@ -204,12 +216,15 @@ class ContinuousBatcher:
         )  # [S, 2*chunk + 1] — one D2H fetch for the worker
         return cache, tok, lengths, active, packed
 
-    def _get_prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
-        if fn is None:
-            fn = jax.jit(self._prefill_program, donate_argnums=(1,))
-            self._prefill_fns[bucket] = fn
-        return fn
+    def _get_prefill_fn(self):
+        """One jit object; XLA re-specializes per prompt-bucket shape (the
+        batch axis is always padded to ``n_slots``, so prompt buckets are
+        the only compile dimension)."""
+        if self._prefill_fn is None:
+            self._prefill_fn = jax.jit(
+                self._prefill_program, donate_argnums=(1,)
+            )
+        return self._prefill_fn
 
     def _get_decode_fn(self):
         if self._decode_fn is None:
@@ -265,44 +280,77 @@ class ContinuousBatcher:
 
     # ---- worker loop ---------------------------------------------------------
 
-    def _admit_dispatch(self, slot: int, req: _Request):
-        """Dispatch one prefill ASYNCHRONOUSLY (no device sync) and mark the
-        slot occupied.  Returns (slot, req, n_prompt_ids, first_token_dev);
-        a whole admission round is then finalized with ONE host sync in
-        ``_finalize_admissions`` — per-admit ``int(first)`` syncs cost a
-        full round-trip each on a tunneled TPU."""
+    def _admit_round(self, pairs: List[Tuple[int, "_Request"]]):
+        """Prefill every (slot, request) pair of this round in ONE batched
+        dispatch (async — no device sync; the round is finalized with one
+        host fetch in ``_finalize_admissions``).
+
+        The batch axis is always padded to ``n_slots`` — a batch-16 prefill
+        forward costs barely more device time than batch-1 (the weight read
+        dominates), and a single batch shape per prompt bucket means every
+        program the loaded path needs is compiled by one warm round (batch
+        buckets would leave sizes 2..8 to jit-compile *inside* a latency
+        measurement the first time slots retire raggedly).  Padding lanes
+        scatter out of bounds (dropped) and their sampled tokens are
+        ignored.  A request whose prompt cannot be marshalled fails alone,
+        before the dispatch — not with the whole round."""
         usable = self.cache_len - 1
-        ids = req.prompt_ids[-usable:] or [self.gen.pad_id]
+        good: List[Tuple[int, "_Request", List[int]]] = []
+        longest = 1
+        for slot, req in pairs:
+            try:
+                ids = [int(t) for t in req.prompt_ids][-usable:] or [
+                    self.gen.pad_id
+                ]
+            except (TypeError, ValueError) as e:  # bad request; fail it alone
+                req.error = e
+                req.done.set()
+                continue
+            good.append((slot, req, ids))
+            longest = max(longest, len(ids))
+        if not good:
+            return [], None
         bucket = min(
-            pick_bucket(len(ids), self.gen.prefill_buckets)
-            if len(ids) <= self.gen.prefill_buckets[-1]
-            else round_up(len(ids), 128),
+            pick_bucket(longest, self.gen.prefill_buckets)
+            if longest <= self.gen.prefill_buckets[-1]
+            else round_up(longest, 128),
             usable,
         )
-        padded = np.full((1, bucket), self.gen.pad_id, np.int32)
-        padded[0, : len(ids)] = ids
-        fn = self._get_prefill_fn(bucket)
+        B = self.n_slots
+        padded = np.full((B, bucket), self.gen.pad_id, np.int32)
+        lengths = np.ones((B,), np.int32)
+        slots_arr = np.full((B,), self.n_slots, np.int32)  # OOB == dropped
+        for i, (slot, _req, ids) in enumerate(good):
+            ids = ids[-bucket:]
+            padded[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+            slots_arr[i] = slot
+            good[i] = (slot, _req, ids)
+        fn = self._get_prefill_fn()
         with span("serve_prefill", DEFAULT_REGISTRY):
-            self._cache, first = fn(
+            self._cache, toks = fn(
                 self.engine.params,
                 self._cache,
                 jnp.asarray(padded),
-                jnp.asarray([len(ids)], jnp.int32),
-                jnp.int32(slot),
+                jnp.asarray(lengths),
+                jnp.asarray(slots_arr),
                 self._next_rng(),
             )
-        self._slot_req[slot] = req
-        return slot, req, len(ids), first
+        for slot, req, _ids in good:
+            self._slot_req[slot] = req
+        meta = [(slot, req, len(ids)) for slot, req, ids in good]
+        return meta, toks
 
     def _finalize_admissions(self, admitted) -> None:
         """One device fetch for every first token of the admission round,
         then batch the slot-state updates into three device ops."""
-        firsts = np.asarray(jnp.stack([a[3] for a in admitted]))
+        meta, round_toks = admitted
+        firsts = np.asarray(round_toks)[: len(meta)]
         slots: List[int] = []
         toks: List[int] = []
         lens: List[int] = []
         alive_flags: List[bool] = []
-        for (slot, req, n_ids, _), first in zip(admitted, firsts):
+        for (slot, req, n_ids), first in zip(meta, firsts):
             first = int(first)
             # remaining decode budget; the prefill token counts as one
             budget = min(req.max_new, self.cache_len - n_ids - 1)
@@ -352,7 +400,7 @@ class ContinuousBatcher:
 
     def _run(self) -> None:
         while True:
-            admitted = []
+            pairs: List[Tuple[int, _Request]] = []
             with self._cv:
                 while (
                     not self._stopped
@@ -362,27 +410,26 @@ class ContinuousBatcher:
                     self._cv.wait(0.5)
                 if self._stopped:
                     return
-                # admission: async-dispatch a prefill per free slot; the
-                # round is finalized with a single device sync below
+                # admission: fill every free slot from the queue; the whole
+                # round prefills in one batched dispatch below
                 for slot in range(self.n_slots):
                     if not self._queue:
                         break
                     if self._slot_req[slot] is None:
-                        req = self._queue.popleft()
-                        try:
-                            admitted.append(self._admit_dispatch(slot, req))
-                        except Exception as e:  # bad request; fail it alone
-                            log.exception("prefill dispatch failed")
-                            req.error = e
-                            req.done.set()
-                            self._slot_req[slot] = None
-            if admitted:
+                        pairs.append((slot, self._queue.popleft()))
+            if pairs:
                 try:
-                    self._finalize_admissions(admitted)
+                    admitted = self._admit_round(pairs)
+                    if admitted[0]:
+                        self._finalize_admissions(admitted)
                 except Exception as e:
-                    # a prefill died inside the dispatched batch; the cache
-                    # was donated through it — fail in-flight and reset
-                    log.exception("admission finalize failed; resetting")
+                    # the round's dispatch died; the cache was donated
+                    # through it — fail in-flight and reset
+                    log.exception("admission round failed; resetting")
+                    for _slot, req in pairs:
+                        if not req.done.is_set():
+                            req.error = RuntimeError(f"prefill failed: {e!r}")
+                            req.done.set()
                     self._fail_active(e)
                     continue
             if not any(self._slot_req):

@@ -153,12 +153,21 @@ def _probe_kernel(
     nprobe: int,
     k: int,
 ):
-    c_scores = queries @ centroids.T  # [q, C]
+    # All scores accumulate to f32 (preferred_element_type): a bf16 score
+    # output loses ~3 significant digits and near-tie rankings with it —
+    # measured recall@10 0.91 vs 1.0 (f32 scores) on a clustered 60k corpus
+    # with identical cells; the exact store's kernel already did this.
+    c_scores = jax.lax.dot_general(
+        queries, centroids, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [q, C]
     _, probe = jax.lax.top_k(c_scores, nprobe)  # [q, nprobe]
 
     def one_query(qv, cells_q, ids_q):
         # cells_q [nprobe, cap, d], ids_q [nprobe, cap]
-        s = jnp.einsum("pcd,d->pc", cells_q, qv)  # [nprobe, cap]
+        s = jnp.einsum(
+            "pcd,d->pc", cells_q, qv, preferred_element_type=jnp.float32
+        )  # [nprobe, cap]
         s = jnp.where(ids_q >= 0, s, NEG_INF)
         return s.reshape(-1), ids_q.reshape(-1)
 
@@ -166,7 +175,10 @@ def _probe_kernel(
     probed_ids = cell_ids[probe]  # [q, nprobe, cap]
     cell_s, cell_i = jax.vmap(one_query)(queries, probed_cells, probed_ids)
 
-    spill_s = queries @ spill.T  # [q, S]
+    spill_s = jax.lax.dot_general(
+        queries, spill, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [q, S]
     spill_s = jnp.where(spill_ids[None, :] >= 0, spill_s, NEG_INF)
 
     all_s = jnp.concatenate([cell_s, jnp.broadcast_to(spill_s, (queries.shape[0], spill_s.shape[1]))], axis=1)
@@ -215,31 +227,68 @@ class IVFIndex:
         self._dtype = jnp.dtype(dtype)
 
         with span("ivf_build", DEFAULT_REGISTRY):
+            # rank more choices than copies: the placement cascade needs
+            # fallback cells when a row's best cells are full
+            n_choices = max(4, self.n_assign)
             centroids, assign = kmeans(
-                vectors, c, n_iters=n_iters, seed=seed, n_assign=self.n_assign
+                vectors, c, n_iters=n_iters, seed=seed,
+                n_assign=min(n_choices, c),
             )
             cap = max(8, int(np.ceil(cap_factor * self.n_assign * n / c)))
             cells = np.zeros((c, cap, d), np.float32)
             cell_ids = np.full((c, cap), -1, np.int32)
             fill = np.zeros((c,), np.int64)
-            spill_rows: List[int] = []
-            for i in range(n):
-                # primary copy: its nearest cell, or the exact-scanned spill
-                # buffer on overflow — every row stays findable at nprobe=1
-                primary = assign[i, 0]
-                if fill[primary] < cap:
-                    cells[primary, fill[primary]] = vectors[i]
-                    cell_ids[primary, fill[primary]] = i
-                    fill[primary] += 1
-                else:
-                    spill_rows.append(i)
-                # redundant copies are opportunistic: placed when the cell
-                # has room, silently dropped otherwise
-                for a in assign[i, 1:]:
-                    if fill[a] < cap:
-                        cells[a, fill[a]] = vectors[i]
-                        cell_ids[a, fill[a]] = i
-                        fill[a] += 1
+
+            def place(rows: np.ndarray, target_cells: np.ndarray) -> np.ndarray:
+                """Vectorized cap-aware placement: rows[i] -> its slot in
+                target_cells[i] when the cell has room.  Returns the boolean
+                placed-mask.  (The round-1 build looped this in Python over
+                1M rows — and let copies overflow into a spill buffer that
+                every query then scanned exactly: 22% of a 1M clustered
+                corpus spilled, adding ~170 MB of HBM reads per query.)"""
+                if len(rows) == 0:
+                    return np.zeros((0,), bool)
+                order = np.argsort(target_cells, kind="stable")
+                tc = target_cells[order]
+                # position of each row within its cell group
+                group_change = np.r_[True, tc[1:] != tc[:-1]]
+                group_start = np.nonzero(group_change)[0]
+                within = np.arange(len(tc)) - np.repeat(
+                    group_start, np.diff(np.r_[group_start, len(tc)])
+                )
+                slot = fill[tc] + within
+                ok = slot < cap
+                r_ok, c_ok, s_ok = rows[order][ok], tc[ok], slot[ok]
+                cells[c_ok, s_ok] = vectors[r_ok]
+                cell_ids[c_ok, s_ok] = r_ok
+                placed_per_cell = np.bincount(c_ok, minlength=c)
+                fill[:] = fill + placed_per_cell
+                placed = np.zeros((len(rows),), bool)
+                placed[order[ok]] = True
+                return placed
+
+            # pass 1 — primary copy, cascading to the best cell with room:
+            # rank-r failures retry at rank r+1 instead of spilling
+            primary_cell = np.full((n,), -1, np.int64)
+            pending = np.arange(n)
+            for r in range(n_choices):
+                if len(pending) == 0:
+                    break
+                targets = assign[pending, r]
+                placed = place(pending, targets)
+                primary_cell[pending[placed]] = targets[placed]
+                pending = pending[~placed]
+            spill_rows = list(pending)
+            # pass 2 — redundant copies (recall: boundary rows reachable
+            # from either side), best-effort within remaining capacity.
+            # Skip rows whose primary already cascaded into this rank's
+            # cell: a duplicate (vector, id) in the same cell burns a slot
+            # in exactly the overfull cells the cascade is relieving.
+            for r in range(1, self.n_assign):
+                everyone = np.arange(n)
+                fresh = assign[everyone, r] != primary_cell[everyone]
+                rows = everyone[fresh]
+                place(rows, assign[rows, r])
             spill_n = max(1, len(spill_rows))
             spill = np.zeros((spill_n, d), np.float32)
             spill_ids = np.full((spill_n,), -1, np.int32)
@@ -293,7 +342,7 @@ class IVFIndex:
         # contain duplicate row ids, which the host dedups back down to k —
         # clamped to the probed candidate pool (top_k beyond it would crash)
         pool = nprobe * self.cap + int(self._spill_ids.shape[0])
-        fetch = min(k_eff * self.n_assign, pool)
+        fetch = min(k_eff * (self.n_assign + 1), pool)
         fn = self._get_fn(len(qn), fetch, nprobe)
         with span("ivf_search", DEFAULT_REGISTRY):
             vals, ids = fn(

@@ -413,9 +413,17 @@ class VectorStore:
                 vals, ids = fn(*args)
         vals = np.asarray(vals)
         ids = np.asarray(ids)
+        return self.assemble_results(vals, ids)
 
+    def assemble_results(
+        self, vals: np.ndarray, ids: np.ndarray
+    ) -> List[List[SearchResult]]:
+        """Host-side (score, row-id) -> SearchResult rows with metadata;
+        shared by ``search`` and the fused text-query path
+        (``engines/retrieve.py``).  ``_meta`` is append-only, so reading it
+        lock-free for rows the device has already scored is safe."""
         out: List[List[SearchResult]] = []
-        for qi in range(len(qn)):
+        for qi in range(len(vals)):
             row: List[SearchResult] = []
             for score, rid in zip(vals[qi], ids[qi]):
                 if score <= NEG_INF / 2:

@@ -59,15 +59,37 @@ def decoder_param_schema(cfg: DecoderConfig):
 
 
 def init_decoder_params(
-    rng: jax.Array, cfg: DecoderConfig, param_dtype=jnp.float32
+    rng: jax.Array, cfg: DecoderConfig, param_dtype=jnp.float32,
+    host_init: bool = False,
 ) -> Params:
     """``param_dtype``: float32 default (training master weights); bf16 for
     inference-only at target scale — a 7B f32 tree (29 GB) cannot even be
     *materialized* on a 16 GB chip, so the cast happens per-tensor here,
-    never on a whole f32 tree."""
-    keys = iter(jax.random.split(rng, 8 + 8 * cfg.num_layers))
+    never on a whole f32 tree.
+
+    ``host_init``: draw on the host (numpy) and ``device_put`` per tensor —
+    the same transfer path real safetensors checkpoints take.  Exists
+    because on the tunneled single-chip runtime the device-side
+    ``jax.random`` init sequence was measured to leave the client in a
+    degraded mode where EVERY later dispatch pays a flat ~70 ms; host init
+    sidesteps it (and is what production weight-loading does anyway)."""
     param_dtype = jnp.dtype(param_dtype)
     p: Params = {}
+    if host_init:
+        import numpy as _np
+
+        seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
+        host_rng = _np.random.default_rng(seed)
+        for name, kind, shape, fan_in in decoder_param_schema(cfg):
+            if kind == "ones":
+                p[name] = jax.device_put(_np.ones(shape, param_dtype))
+            else:
+                w = host_rng.standard_normal(shape, _np.float32) * (
+                    fan_in ** -0.5
+                )
+                p[name] = jax.device_put(w.astype(param_dtype))
+        return p
+    keys = iter(jax.random.split(rng, 8 + 8 * cfg.num_layers))
     for name, kind, shape, fan_in in decoder_param_schema(cfg):
         if kind == "ones":
             p[name] = jnp.ones(shape, param_dtype)

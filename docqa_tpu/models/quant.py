@@ -78,18 +78,53 @@ def quantize_decoder_params(params: Params) -> Params:
 
 
 def init_quantized_decoder_params(
-    rng: jax.Array, cfg: DecoderConfig
+    rng: jax.Array, cfg: DecoderConfig, host_init: bool = False
 ) -> Params:
     """Random-init directly into int8 — tensor-by-tensor, so a 7B tree
     peaks at ~7.2 GB + one float tensor instead of bf16+int8 together.
 
     Consumes ``decoder_param_schema`` (the same generator
     ``init_decoder_params`` uses), drawing RNG keys in the identical
-    order — so this IS the float init, quantized, by construction."""
+    order — so this IS the float init, quantized, by construction.
+
+    ``host_init``: draw AND quantize on the host (numpy), ``device_put``
+    only the int8/scale/bf16 results — mirrors
+    ``init_decoder_params(host_init=True)``'s numpy stream (so the int8
+    engine at seed s is the quantization of the float engine at seed s) and
+    avoids the tunneled-client degradation the device-side random-init
+    sequence triggers (see decoder.py).  Rounding is numpy's round-half-to-
+    even, same as XLA's."""
     from docqa_tpu.models.decoder import decoder_param_schema
 
+    import numpy as _np
+
+    if host_init:
+        seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
+        host_rng = _np.random.default_rng(seed)
+        out: Params = {}
+        for name, kind, shape, fan_in in decoder_param_schema(cfg):
+            if kind == "ones":
+                out[name] = jax.device_put(_np.ones(shape, jnp.bfloat16))
+                continue
+            w = host_rng.standard_normal(shape, _np.float32) * (
+                fan_in ** -0.5
+            )
+            if should_quantize(name):
+                scale = _np.maximum(
+                    _np.max(_np.abs(w), axis=0) / 127.0, 1e-12
+                ).astype(_np.float32)
+                q = _np.clip(
+                    _np.round(w / scale[None, :]), -127, 127
+                ).astype(_np.int8)
+                out[name] = jax.device_put(q)
+                out[name + SCALE_SUFFIX] = jax.device_put(scale)
+            else:
+                out[name] = jax.device_put(w.astype(jnp.bfloat16))
+            del w
+        return out
+
     keys = iter(jax.random.split(rng, 8 + 8 * cfg.num_layers))
-    out: Params = {}
+    out = {}
     for name, kind, shape, fan_in in decoder_param_schema(cfg):
         if kind == "ones":
             out[name] = jnp.ones(shape, jnp.bfloat16)

@@ -219,6 +219,15 @@ class DocQARuntime:
             )
             if n and self._index_dir:
                 self._snapshot()
+        # Fused encode+search retrieval (one dispatch) applies when serving
+        # exact search over the plain store with a real device encoder;
+        # tiered/IVF serving and the hash-encoder fake keep the generic
+        # two-step path.
+        retriever = None
+        if self.search_index is self.store and not self.cfg.flags.use_fake_encoder:
+            from docqa_tpu.engines.retrieve import FusedRetriever
+
+            retriever = FusedRetriever(self.encoder, self.store)
         self.qa = QAService(
             self.encoder,
             self.search_index,
@@ -227,6 +236,7 @@ class DocQARuntime:
             k=self.cfg.store.default_k,
             use_fake_llm=self.cfg.flags.use_fake_llm,
             batcher=self.batcher,
+            retriever=retriever,
         )
         if self.cfg.flags.use_fake_retrieval:
             # standalone/dev parity with the reference's USE_FAKE_RETRIEVAL

@@ -56,6 +56,14 @@ class DocumentPipeline:
         self.store = store
         self.http_extractor = http_extractor
         self.on_indexed = on_indexed
+        # Replay idempotence: a crash between store snapshot and queue ack
+        # redelivers an already-indexed message on restart (at-least-once);
+        # seeding from the restored store and checking before store.add
+        # keeps redelivered docs from duplicating their chunks.  doc_ids are
+        # per-upload uuids, so a same-id body always IS the same document.
+        self._indexed_doc_ids = {
+            md.get("doc_id") for md in store.metadata_rows()
+        }
         self._consumers = [
             Consumer(
                 broker,
@@ -169,7 +177,14 @@ class DocumentPipeline:
         all_chunks: List[str] = []
         all_meta: List[Dict[str, Any]] = []
         per_doc: List[tuple] = []
+        replayed: List[str] = []
         for body in bodies:
+            if body["doc_id"] in self._indexed_doc_ids:
+                log.info(
+                    "skipping replayed already-indexed doc %s", body["doc_id"]
+                )
+                replayed.append(body["doc_id"])
+                continue
             text = body["original_text_masked"]
             md = body.get("metadata", {})
             chunks = chunk_text(text, self.cfg.chunk)
@@ -199,6 +214,7 @@ class DocumentPipeline:
                 # Consumer's individual retry cannot duplicate vectors
                 embeddings = self.encoder.encode_texts(all_chunks)
                 self.store.add(embeddings, all_meta)
+            self._indexed_doc_ids.update(d for d, _n in per_doc)
         # vectors are committed past this point: never raise (a retry would
         # re-encode and re-append the whole batch)
         if self.on_indexed is not None and per_doc:
@@ -211,6 +227,14 @@ class DocumentPipeline:
         for doc_id, n in per_doc:
             try:
                 self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
+            except Exception:
+                log.exception("status write failed for %s", doc_id)
+        for doc_id in replayed:
+            # the crash the replay recovers from may have hit between the
+            # snapshot and the status write — make the registry agree with
+            # the vectors it already has (idempotent overwrite)
+            try:
+                self.registry.set_status(doc_id, reg.INDEXED)
             except Exception:
                 log.exception("status write failed for %s", doc_id)
 

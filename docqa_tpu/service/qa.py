@@ -67,6 +67,7 @@ class QAService:
         k: int = 3,
         use_fake_llm: bool = False,
         batcher=None,  # ContinuousBatcher: concurrent /ask share decode slots
+        retriever=None,  # FusedRetriever: encode+search in one dispatch
     ) -> None:
         self.encoder = encoder
         self.store = store
@@ -75,6 +76,16 @@ class QAService:
         self.k = k
         self.use_fake_llm = use_fake_llm
         self.batcher = batcher
+        self.retriever = retriever
+
+    def _retrieve(self, text: str, k: int, filters=None):
+        """One fused dispatch when a retriever is wired (encoder forward +
+        store top-k in a single XLA program — half the tunnel round-trips);
+        otherwise the classic encode-then-search pair."""
+        if self.retriever is not None:
+            return self.retriever.search_texts([text], k=k, filters=filters)[0]
+        emb = self.encoder.encode_texts([text])
+        return self.store.search(emb, k=k, filters=filters)[0]
 
     # ---- /ask/ ---------------------------------------------------------------
 
@@ -87,8 +98,7 @@ class QAService:
         flaw: ``make_app``'s 1-worker device executor made QPS-16 impossible).
         """
         with span("qa_retrieve", DEFAULT_REGISTRY):
-            emb = self.encoder.encode_texts([question])
-            hits = self.store.search(emb, k=k or self.k)[0]
+            hits = self._retrieve(question, k=k or self.k)
         context = "\n\n".join(
             h.metadata.get("text_content", h.metadata.get("source", ""))
             for h in hits
@@ -137,8 +147,7 @@ class QAService:
             "date_to": to_date,
         }
         if focus:
-            emb = self.encoder.encode_texts([focus])
-            hits = self.store.search(emb, k=limit, filters=filters)[0]
+            hits = self._retrieve(focus, k=limit, filters=filters)
             rows = [h.metadata for h in hits]
         else:
             rows = self.store.metadata_select(limit=limit, **filters)

@@ -111,6 +111,18 @@ class TestContextualPHI:
         out = engine.anonymize("John Smith, lives in Boston")
         assert out == "<PERSON>, lives in <LOCATION>"
 
+    def test_composed_clause_regression(self, engine):
+        # Round-2 service drive caught this exact composition slipping
+        # through a tagger trained on fixed whole-sentence templates:
+        # subject decoration ("Patient ... from ...") + admission predicate.
+        out = engine.anonymize(
+            "Patient John Smith from Boston was admitted on 2024-03-12 "
+            "with chest pain."
+        )
+        assert "John" not in out and "Smith" not in out, out
+        assert "Boston" not in out, out
+        assert "<PERSON>" in out and "<LOCATION>" in out, out
+
     def test_unseen_nrp(self, engine):
         out = engine.anonymize(
             "The patient identifies as Buddhist and requests an interpreter."

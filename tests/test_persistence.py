@@ -78,6 +78,30 @@ class TestKillAndRestart:
         finally:
             rt2.stop()
 
+    def test_replayed_index_message_does_not_duplicate_chunks(self, tmp_path):
+        # at-least-once window: crash after snapshot but before queue ack →
+        # the clean-queue message redelivers on restart; the index handler
+        # must be idempotent or the doc's chunks double in the store
+        cfg = _cfg(tmp_path)
+        rt = DocQARuntime(cfg).start()
+        try:
+            rec = rt.pipeline.ingest_document(
+                "note.txt", NOTE.encode(), patient_id="p1"
+            )
+            assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+            count = rt.store.count
+            # simulate the broker redelivering the already-processed message
+            body = {
+                "doc_id": rec.doc_id,
+                "original_text_masked": NOTE,
+                "metadata": {"patient_id": "p1", "filename": "note.txt"},
+            }
+            rt.pipeline._index_handler([body])
+            assert rt.store.count == count  # no duplicate vectors
+            assert rt.registry.get(rec.doc_id).status == "INDEXED"
+        finally:
+            rt.stop()
+
     def test_crash_between_snapshots_reconciles_registry(self, tmp_path):
         """Review regression: with snapshot_every=64 a crash can lose
         vectors that the now-durable registry already recorded as INDEXED.

@@ -173,6 +173,34 @@ class TestConfigKnob:
             if a[k].dtype == jnp.int8 or k.endswith("__scale"):
                 np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
 
+    def test_host_init_coherent_with_host_float_init(self):
+        # the serving engines' path: both host_init branches share one numpy
+        # stream, so the int8 tree is the quantization of the float tree
+        # (within numpy-vs-XLA rounding of the quantizer itself)
+        rng = jax.random.PRNGKey(7)
+        f = init_decoder_params(rng, CFG, param_dtype=jnp.float32,
+                                host_init=True)
+        q = init_quantized_decoder_params(rng, CFG, host_init=True)
+        assert set(q) == {
+            k2
+            for k in f
+            for k2 in (
+                [k, k + "__scale"]
+                if q.get(k) is not None and q[k].dtype == jnp.int8
+                else [k]
+            )
+        }
+        for k, w in f.items():
+            if q[k].dtype != jnp.int8:
+                continue
+            deq = np.asarray(q[k], np.float32) * np.asarray(
+                q[k + "__scale"], np.float32
+            )[None, :]
+            err = np.abs(deq - np.asarray(w, np.float32))
+            # quantization error bounded by scale/2 per element
+            bound = np.asarray(q[k + "__scale"], np.float32)[None, :] * 0.51
+            assert (err <= bound).all()
+
 
 class TestQuantizedTP:
     def test_sharded_quantized_generation(self, mesh_tp8):

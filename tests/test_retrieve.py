@@ -1,0 +1,85 @@
+"""FusedRetriever: the one-dispatch text->top-k path must rank exactly like
+the two-dispatch encode-then-search pair (same program pieces, fused)."""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import EncoderConfig, StoreConfig
+from docqa_tpu.engines.encoder import EncoderEngine
+from docqa_tpu.engines.retrieve import FusedRetriever
+from docqa_tpu.index.store import VectorStore
+
+
+TINY = EncoderConfig(
+    vocab_size=512, hidden_dim=64, num_layers=2, num_heads=4,
+    mlp_dim=128, max_seq_len=64, embed_dim=64, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    enc = EncoderEngine(TINY)
+    store = VectorStore(StoreConfig(dim=64, shard_capacity=256))
+    texts = [
+        "aspirin 100mg daily for cardiac prevention",
+        "metformin manages type 2 diabetes",
+        "ginseng root in traditional formulas",
+        "patient reports persistent headache",
+        "chest pain radiating to the left arm",
+        "seasonal influenza vaccination schedule",
+    ]
+    vecs = enc.encode_texts(texts)
+    store.add(
+        vecs,
+        [
+            {
+                "doc_id": f"d{i}",
+                "source": t,
+                "text_content": t,
+                "patient_id": "p1" if i % 2 == 0 else "p2",
+            }
+            for i, t in enumerate(texts)
+        ],
+    )
+    return enc, store, texts
+
+
+class TestFusedMatchesTwoStep:
+    def test_same_ranking_and_scores(self, setup):
+        enc, store, texts = setup
+        retr = FusedRetriever(enc, store)
+        queries = ["medication for diabetes", "heart related symptoms"]
+        fused = retr.search_texts(queries, k=3)
+        emb = enc.encode_texts(queries)
+        plain = store.search(emb, k=3)
+        assert len(fused) == len(plain) == 2
+        for f_row, p_row in zip(fused, plain):
+            assert [r.row_id for r in f_row] == [r.row_id for r in p_row]
+            np.testing.assert_allclose(
+                [r.score for r in f_row],
+                [r.score for r in p_row],
+                rtol=2e-4,  # fused keeps the embedding on-device (no f32
+                # host round-trip); bf16 store dot tolerance
+            )
+
+    def test_filters_compose(self, setup):
+        enc, store, _ = setup
+        retr = FusedRetriever(enc, store)
+        rows = retr.search_texts(
+            ["any clinical text"], k=6, filters={"patient_id": "p1"}
+        )[0]
+        assert rows, "filtered fused search returned nothing"
+        assert all(r.metadata["patient_id"] == "p1" for r in rows)
+
+    def test_empty_store(self):
+        enc = EncoderEngine(TINY)
+        empty = VectorStore(StoreConfig(dim=64, shard_capacity=128))
+        retr = FusedRetriever(enc, empty)
+        assert retr.search_texts(["q"], k=3) == [[]]
+
+    def test_metadata_carried(self, setup):
+        enc, store, texts = setup
+        retr = FusedRetriever(enc, store)
+        rows = retr.search_texts(["ginseng formulas"], k=1)[0]
+        assert rows[0].metadata["doc_id"].startswith("d")
+        assert rows[0].metadata["text_content"] in texts
