@@ -274,6 +274,13 @@ class GenerateConfig:
     # round-trips (dominant over a tunneled TPU) at the cost of coarser
     # slot-retirement granularity
     decode_chunk: int = 16
+    # prompt-lookup speculative decoding (GenerateEngine, greedy only):
+    # verify width per step; 0/1 disables.  Decode is HBM-bound, so a
+    # K-token verify costs one weight read like a single step but emits the
+    # matched draft prefix + 1 — RAG answers that quote retrieved context
+    # draft well from the prompt's own bigrams.  Output-exact vs plain
+    # greedy by construction.
+    speculative_k: int = 0
 
 
 @dataclass(frozen=True)

@@ -198,7 +198,152 @@ class GenerateEngine:
         _, _, _, out, _, n_emitted, _ = jax.lax.while_loop(cond, body, state)
         return out, n_emitted
 
+    # ---- speculative decoding (prompt-lookup / self-lookup drafting) --------
+
+    def _build_bigram(self, ids, lengths):
+        """Per-lane bigram table over the prompt: table[lane, prev] = next.
+        Misses are -1.  The drafting source for prompt-lookup speculative
+        decoding — RAG answers quote retrieved context, so the prompt's own
+        bigrams predict long runs of the continuation."""
+        b, s = ids.shape
+        vocab = self.cfg.vocab_size
+        prev = ids[:, :-1]
+        nxt = ids[:, 1:]
+        valid = (jnp.arange(s - 1)[None, :] + 1) < lengths[:, None]
+        prev = jnp.where(valid, prev, vocab)  # out of bounds -> dropped
+        lane = jnp.broadcast_to(jnp.arange(b)[:, None], prev.shape)
+        table = jnp.full((b, vocab), -1, jnp.int32)
+        return table.at[lane, prev].set(nxt, mode="drop")
+
+    def _generate_spec_fn(
+        self,
+        params: Params,
+        ids: jax.Array,  # [b, prompt_bucket]
+        prompt_lengths: jax.Array,  # [b]
+        *,
+        max_new: int,
+        K: int,
+    ):
+        """Greedy decode with prompt-lookup speculation: each loop step
+        drafts K-1 tokens by chained bigram lookup, verifies all of them in
+        ONE forward of q_len=K, and emits the matched prefix plus the bonus
+        token — so a step costs one weight read (the same as emitting a
+        single token, decode being HBM-bound) but can emit up to K tokens.
+
+        Output-exact with plain greedy by construction: every emitted token
+        is an argmax of the model's own logits; drafts only decide how many
+        of those argmaxes one weight-read yields.  Mis-speculated K/V rows
+        are never attended (``attn_lengths`` windows the freshly-written
+        region) and are overwritten by the next verify, which always starts
+        at or before them.
+        """
+        b, bucket = ids.shape
+        eos, pad = self.gen.eos_id, self.gen.pad_id
+        cache_len = round_up(bucket + max_new + K, 128)
+        cache = init_kv_cache(self.cfg, b, max_len=cache_len)
+        cache = self._constrain_cache(cache)
+        lane = jnp.arange(b)
+        karange = jnp.arange(K)[None, :]
+
+        logits, cache = decoder_forward(
+            params, self.cfg, ids, cache, jnp.zeros((b,), jnp.int32),
+            attn_lengths=prompt_lengths, use_flash=self.use_flash,
+            last_token_only=True,
+        )
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        table = self._build_bigram(ids, prompt_lengths)
+        # the (last prompt token -> first) pair is confirmed; record it
+        last_prompt = jnp.take_along_axis(
+            ids, jnp.maximum(prompt_lengths - 1, 0)[:, None], 1
+        )[:, 0]
+        table = table.at[lane, last_prompt].set(first)
+
+        out = jnp.full((b, max_new + K), pad, jnp.int32)
+        out = out.at[:, 0].set(first)
+        done = first == eos
+        n_emit = jnp.where(done, 0, 1).astype(jnp.int32)
+        done = done | (n_emit >= max_new)
+        cur = first
+
+        def cond(state):
+            return ~jnp.all(state[4])
+
+        def body(state):
+            cache, lengths, out, n_emit, done, table, cur = state
+
+            def draft_step(tok, _):
+                nt = table[lane, tok]
+                nt = jnp.where(nt < 0, tok, nt)  # miss: repeat (cheap guess)
+                return nt, nt
+
+            _, drafts_t = jax.lax.scan(draft_step, cur, None, length=K - 1)
+            drafts = jnp.swapaxes(drafts_t, 0, 1)  # [b, K-1]
+            verify_in = jnp.concatenate([cur[:, None], drafts], axis=1)
+            logits, cache = decoder_forward(
+                params, self.cfg, verify_in, cache, lengths,
+                attn_lengths=lengths + K, use_flash=self.use_flash,
+            )
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, K]
+            match = (drafts == g[:, :-1]).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
+            cand = karange <= m[:, None]  # emission candidates g0..gm
+            is_eos = (g == eos) & cand
+            eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
+            budget = max_new - n_emit
+            emit_valid = (
+                cand
+                & (karange < eos_pos[:, None])
+                & (karange < budget[:, None])
+                & (~done)[:, None]
+            )
+            emitted = jnp.where(emit_valid, g, pad)
+            out = jax.vmap(
+                lambda o, v, off: jax.lax.dynamic_update_slice(o, v, (off,))
+            )(out, emitted, n_emit)
+            n_valid = jnp.sum(emit_valid.astype(jnp.int32), axis=1)
+            n_emit_new = n_emit + n_valid
+            done_new = (
+                done
+                | (jnp.any(is_eos, 1) & (eos_pos < budget))
+                | (n_emit_new >= max_new)
+            )
+            last_tok = jnp.take_along_axis(
+                emitted, jnp.maximum(n_valid - 1, 0)[:, None], 1
+            )[:, 0]
+            cur_new = jnp.where(done_new | (n_valid == 0), cur, last_tok)
+            lengths_new = jnp.where(done, lengths, lengths + n_valid)
+            # record confirmed bigrams (cur, g0), (g0, g1), ... so the
+            # answer's own phrases become draftable (self-lookup)
+            prev_seq = jnp.concatenate([cur[:, None], g[:, :-1]], axis=1)
+            prev_scatter = jnp.where(emit_valid, prev_seq, self.cfg.vocab_size)
+            table = table.at[
+                jnp.broadcast_to(lane[:, None], prev_scatter.shape),
+                prev_scatter,
+            ].set(g, mode="drop")
+            return cache, lengths_new, out, n_emit_new, done_new, table, cur_new
+
+        state = (cache, prompt_lengths, out, n_emit, done, table, cur)
+        _, _, out, n_emit, _, _, _ = jax.lax.while_loop(cond, body, state)
+        return out, n_emit
+
     def _get_fn(self, b: int, bucket: int, max_new: int, greedy: bool):
+        spec_k = self.gen.speculative_k
+        if greedy and spec_k >= 2:
+            key = (b, bucket, max_new, "spec", spec_k)
+            fn = self._fns.get(key)
+            if fn is None:
+                spec = functools.partial(
+                    self._generate_spec_fn, max_new=max_new, K=spec_k
+                )
+                # same call signature as _generate_fn (rng/temperature
+                # ignored: speculation is greedy-only)
+                fn = jax.jit(
+                    lambda params, ids, lengths, rng, temperature: spec(
+                        params, ids, lengths
+                    )
+                )
+                self._fns[key] = fn
+            return fn
         key = (b, bucket, max_new, greedy)
         fn = self._fns.get(key)
         if fn is None:

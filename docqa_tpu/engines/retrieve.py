@@ -53,8 +53,17 @@ class FusedRetriever:
 
     @property
     def _fusable(self) -> bool:
+        """Single-device only: a row-sharded store searches under
+        ``shard_map`` and a data-parallel mesh needs the encoder's batch
+        rounding + ``batch_sharded`` placement — both keep the generic
+        two-step path."""
         mesh = self.store.mesh
-        return mesh is None or getattr(mesh, "n_model", 1) == 1
+        if mesh is None:
+            return True
+        return (
+            getattr(mesh, "n_model", 1) == 1
+            and getattr(mesh, "n_data", 1) == 1
+        )
 
     def _get_fn(self, k: int, masked: bool):
         key = (k, masked)
@@ -64,6 +73,12 @@ class FusedRetriever:
 
             def program(enc_params, ids, lengths, buf, count, mask):
                 emb = encode_batch(enc_params, enc_cfg, ids, lengths)
+                # store.search L2-normalizes queries unconditionally (scores
+                # are cosine); match it even when the encoder config skips
+                # its own normalize — idempotent when it doesn't
+                emb = emb / jnp.maximum(
+                    jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+                )
                 vals, row_ids = _search_single(
                     buf, emb.astype(buf.dtype), count, mask, k
                 )

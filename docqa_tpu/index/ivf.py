@@ -271,7 +271,10 @@ class IVFIndex:
             # rank-r failures retry at rank r+1 instead of spilling
             primary_cell = np.full((n,), -1, np.int64)
             pending = np.arange(n)
-            for r in range(n_choices):
+            # assign has min(n_choices, c) columns — iterate what exists
+            # (tiny-c builds with small cap_factor can exhaust every rank
+            # and still have pending rows; they spill below)
+            for r in range(assign.shape[1]):
                 if len(pending) == 0:
                     break
                 targets = assign[pending, r]
