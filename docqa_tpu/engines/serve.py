@@ -104,6 +104,14 @@ class ContinuousBatcher:
         self.cache_len = round_up(cache_len or self.cfg.max_seq_len, 128)
         self._seed = seed
         self._rng_counter = 0
+        # prompt-lookup speculation in the served path (greedy only): each
+        # chunk iteration verifies spec_k tokens per slot in one weight
+        # read; served output stays exactly the solo greedy output
+        self.spec_k = (
+            self.gen.speculative_k
+            if self.gen.speculative_k >= 2 and self.gen.temperature == 0.0
+            else 0
+        )
 
         # device state (host-held references; donated through each dispatch)
         self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
@@ -114,6 +122,12 @@ class ContinuousBatcher:
         self._tok = jnp.zeros((self.n_slots,), jnp.int32)
         self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
         self._active = jnp.zeros((self.n_slots,), bool)
+        # per-slot bigram tables (speculation only): table[slot, prev]=next
+        self._table = (
+            jnp.full((self.n_slots, self.cfg.vocab_size), -1, jnp.int32)
+            if self.spec_k
+            else None
+        )
 
         # host-side slot bookkeeping
         self._slot_req: List[Optional[_Request]] = [None] * self.n_slots
@@ -135,7 +149,8 @@ class ContinuousBatcher:
         self._rng_counter += 1
         return jax.random.PRNGKey(self._seed * 100_003 + self._rng_counter)
 
-    def _prefill_program(self, params, cache, ids, lengths, slots, rng):
+    def _prefill_program(self, params, cache, ids, lengths, slots, rng,
+                         table=None):
         """Prefill a whole admission round in ONE dispatch.
 
         ``ids`` [B, bucket] right-padded prompts, ``lengths`` [B] true
@@ -144,7 +159,12 @@ class ContinuousBatcher:
         The per-lane prompt K/V lives in a local [B, bucket] cache and only
         those ``bucket`` rows are scattered into each target slot (decode
         steps write later rows directly), so the transient is O(B x bucket),
-        not O(B x cache_len)."""
+        not O(B x cache_len).
+
+        With speculation on, ``table`` rows for the admitted slots are
+        REPLACED by each prompt's bigram table (plus the confirmed
+        last-prompt-token -> first-token pair) — the drafting source for
+        the speculative decode chunks."""
         B, bucket = ids.shape
         local = init_kv_cache(self.cfg, B, max_len=bucket)
         logits, local = decoder_forward(
@@ -165,7 +185,15 @@ class ContinuousBatcher:
             cache[key] = cache[key].at[slots, :bucket].set(
                 local[key].astype(cache[key].dtype), mode="drop"
             )
-        return cache, toks
+        if table is None:
+            return cache, toks
+        rows = self.engine._build_bigram(ids, lengths)
+        last_prompt = jnp.take_along_axis(
+            ids, jnp.maximum(lengths - 1, 0)[:, None], 1
+        )[:, 0]
+        rows = rows.at[jnp.arange(B), last_prompt].set(toks)
+        table = table.at[slots, :].set(rows, mode="drop")
+        return cache, table, toks
 
     def _decode_program(self, params, cache, tok, lengths, active, rng):
         """Advance every active slot by ``self.chunk`` tokens in one dispatch.
@@ -216,19 +244,129 @@ class ContinuousBatcher:
         )  # [S, 2*chunk + 1] — one D2H fetch for the worker
         return cache, tok, lengths, active, packed
 
+    def _decode_spec_program(self, params, cache, table, tok, lengths, active):
+        """Speculative decode chunk: loop verify-steps until every live slot
+        has emitted >= ``chunk`` tokens (or retired on EOS).  Each step
+        drafts ``spec_k - 1`` tokens per slot from its bigram table and
+        verifies them in ONE forward of q_len=spec_k — the same weight read
+        a single-token step costs — emitting the matched prefix + bonus.
+        Output-exact with the plain chunk program (every emitted token is an
+        argmax of the model's logits).
+
+        Returns (cache, table, tok, lengths, active, packed) with packed
+        [S, chunk + 2K + 2]: token slab (sized so the K-wide slice write
+        can never clamp — see the ``width`` comment), per-slot emission
+        count, active flag."""
+        S, K = self.n_slots, self.spec_k
+        eos, pad = self.gen.eos_id, self.gen.pad_id
+        # Slab sizing vs the write window: an emitting iteration starts at
+        # n_out < chunk and can add up to K tokens, so n_out caps at
+        # chunk-1+K; the unconditional K-wide dynamic_update_slice then
+        # spans at most chunk-1+2K.  Anything tighter lets the slice CLAMP
+        # its start downward and overwrite already-emitted tokens with the
+        # pad tail (observed as trailing pads inside a slot's count).
+        width = self.chunk + 2 * K
+        lane = jnp.arange(S)
+        karange = jnp.arange(K)[None, :]
+        out0 = jnp.full((S, width), pad, jnp.int32)
+        n0 = jnp.zeros((S,), jnp.int32)
+
+        def cond(st):
+            _, _, _, _, active, _, n_out = st
+            return jnp.any(active & (n_out < self.chunk))
+
+        def body(st):
+            cache, table, tok, lengths, active, out, n_out = st
+
+            def draft_step(t, _):
+                nt = table[lane, t]
+                nt = jnp.where(nt < 0, t, nt)
+                return nt, nt
+
+            _, drafts_t = jax.lax.scan(draft_step, tok, None, length=K - 1)
+            drafts = jnp.swapaxes(drafts_t, 0, 1)  # [S, K-1]
+            verify_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+            logits, cache = decoder_forward(
+                params, self.cfg, verify_in, cache, lengths,
+                attn_lengths=lengths + K, use_flash=self.engine.use_flash,
+            )
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K]
+            match = (drafts == g[:, :-1]).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            cand = karange <= m[:, None]
+            is_eos = (g == eos) & cand
+            eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
+            # freeze slots that already filled their chunk quota: the loop
+            # keeps running for slower slots, and a frozen slot must not
+            # emit, advance, or retire until the next dispatch
+            live = active & (n_out < self.chunk)
+            emit_valid = (
+                cand
+                & (karange < eos_pos[:, None])
+                & live[:, None]
+            )
+            emitted = jnp.where(emit_valid, g, pad)
+            out = jax.vmap(
+                lambda o, v, off: jax.lax.dynamic_update_slice(o, v, (off,))
+            )(out, emitted, n_out)
+            n_valid = jnp.sum(emit_valid.astype(jnp.int32), axis=1)
+            n_out = n_out + n_valid
+            # a frozen slot's un-consumed EOS re-derives next dispatch
+            saw_eos = live & jnp.any(is_eos, 1)
+            last_tok = jnp.take_along_axis(
+                emitted, jnp.maximum(n_valid - 1, 0)[:, None], 1
+            )[:, 0]
+            # confirmed bigrams (tok, g0), (g0, g1), ... extend the table so
+            # the answer's own phrases become draftable
+            prev_seq = jnp.concatenate([tok[:, None], g[:, :-1]], axis=1)
+            prev_scatter = jnp.where(
+                emit_valid, prev_seq, self.cfg.vocab_size
+            )
+            table = table.at[
+                jnp.broadcast_to(lane[:, None], prev_scatter.shape),
+                prev_scatter,
+            ].set(g, mode="drop")
+            lengths = lengths + jnp.where(active, n_valid, 0)
+            active = active & ~saw_eos
+            tok = jnp.where(active & (n_valid > 0), last_tok, tok)
+            return cache, table, tok, lengths, active, out, n_out
+
+        cache, table, tok, lengths, active, out, n_out = jax.lax.while_loop(
+            cond, body, (cache, table, tok, lengths, active, out0, n0)
+        )
+        packed = jnp.concatenate(
+            [out, n_out[:, None], active.astype(jnp.int32)[:, None]], axis=1
+        )  # [S, width + 2] — one D2H fetch for the worker
+        return cache, table, tok, lengths, active, packed
+
     def _get_prefill_fn(self):
         """One jit object; XLA re-specializes per prompt-bucket shape (the
         batch axis is always padded to ``n_slots``, so prompt buckets are
         the only compile dimension)."""
         if self._prefill_fn is None:
-            self._prefill_fn = jax.jit(
-                self._prefill_program, donate_argnums=(1,)
-            )
+            if self.spec_k:
+                self._prefill_fn = jax.jit(
+                    lambda p, c, t, i, l, s, r: self._prefill_program(
+                        p, c, i, l, s, r, table=t
+                    ),
+                    donate_argnums=(1, 2),
+                )
+            else:
+                self._prefill_fn = jax.jit(
+                    self._prefill_program, donate_argnums=(1,)
+                )
         return self._prefill_fn
 
     def _get_decode_fn(self):
         if self._decode_fn is None:
-            self._decode_fn = jax.jit(self._decode_program, donate_argnums=(1,))
+            if self.spec_k:
+                self._decode_fn = jax.jit(
+                    self._decode_spec_program, donate_argnums=(1, 2)
+                )
+            else:
+                self._decode_fn = jax.jit(
+                    self._decode_program, donate_argnums=(1,)
+                )
         return self._decode_fn
 
     # ---- public API ----------------------------------------------------------
@@ -328,14 +466,25 @@ class ContinuousBatcher:
             good[i] = (slot, _req, ids)
         fn = self._get_prefill_fn()
         with span("serve_prefill", DEFAULT_REGISTRY):
-            self._cache, toks = fn(
-                self.engine.params,
-                self._cache,
-                jnp.asarray(padded),
-                jnp.asarray(lengths),
-                jnp.asarray(slots_arr),
-                self._next_rng(),
-            )
+            if self.spec_k:
+                self._cache, self._table, toks = fn(
+                    self.engine.params,
+                    self._cache,
+                    self._table,
+                    jnp.asarray(padded),
+                    jnp.asarray(lengths),
+                    jnp.asarray(slots_arr),
+                    self._next_rng(),
+                )
+            else:
+                self._cache, toks = fn(
+                    self.engine.params,
+                    self._cache,
+                    jnp.asarray(padded),
+                    jnp.asarray(lengths),
+                    jnp.asarray(slots_arr),
+                    self._next_rng(),
+                )
         for slot, req, _ids in good:
             self._slot_req[slot] = req
         meta = [(slot, req, len(ids)) for slot, req, ids in good]
@@ -352,8 +501,15 @@ class ContinuousBatcher:
         alive_flags: List[bool] = []
         for (slot, req, n_ids), first in zip(meta, firsts):
             first = int(first)
-            # remaining decode budget; the prefill token counts as one
-            budget = min(req.max_new, self.cache_len - n_ids - 1)
+            # remaining decode budget; the prefill token counts as one.
+            # Speculation reserves spec_k rows of headroom: a verify writes
+            # K rows from the current length, and dynamic_update_slice
+            # CLAMPS an out-of-range window downward — which would silently
+            # overwrite confirmed K/V rows while in-budget tokens still
+            # depend on them.
+            budget = min(
+                req.max_new, self.cache_len - n_ids - 1 - self.spec_k
+            )
             self._slot_budget[slot] = budget
             alive = True
             if first == self.gen.eos_id or budget <= 0:
@@ -389,6 +545,10 @@ class ContinuousBatcher:
         self._tok = jnp.zeros((self.n_slots,), jnp.int32)
         self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
         self._active = jnp.zeros((self.n_slots,), bool)
+        if self.spec_k:
+            self._table = jnp.full(
+                (self.n_slots, self.cfg.vocab_size), -1, jnp.int32
+            )
         DEFAULT_REGISTRY.counter("serve_decode_failures").inc()
 
     def _retire(self, slot: int) -> None:
@@ -438,20 +598,37 @@ class ContinuousBatcher:
             fn = self._get_decode_fn()
             try:
                 with span("serve_decode_chunk", DEFAULT_REGISTRY):
-                    (
-                        self._cache,
-                        self._tok,
-                        self._lengths,
-                        self._active,
-                        packed,
-                    ) = fn(
-                        self.engine.params,
-                        self._cache,
-                        self._tok,
-                        self._lengths,
-                        self._active,
-                        self._next_rng(),
-                    )
+                    if self.spec_k:
+                        (
+                            self._cache,
+                            self._table,
+                            self._tok,
+                            self._lengths,
+                            self._active,
+                            packed,
+                        ) = fn(
+                            self.engine.params,
+                            self._cache,
+                            self._table,
+                            self._tok,
+                            self._lengths,
+                            self._active,
+                        )
+                    else:
+                        (
+                            self._cache,
+                            self._tok,
+                            self._lengths,
+                            self._active,
+                            packed,
+                        ) = fn(
+                            self.engine.params,
+                            self._cache,
+                            self._tok,
+                            self._lengths,
+                            self._active,
+                            self._next_rng(),
+                        )
                     packed_h = np.asarray(packed)  # ONE fetch per chunk
             except Exception as e:
                 # the cache was donated into a failed dispatch — fail every
@@ -461,15 +638,27 @@ class ContinuousBatcher:
                 log.exception("decode chunk failed; resetting slot state")
                 self._fail_active(e)
                 continue
-            out_h = packed_h[:, : self.chunk]
-            valid_h = packed_h[:, self.chunk : 2 * self.chunk].astype(bool)
-            active_h = packed_h[:, -1].astype(bool)
+            if self.spec_k:
+                width = self.chunk + 2 * self.spec_k
+                out_h = packed_h[:, :width]
+                counts_h = packed_h[:, width]
+                active_h = packed_h[:, width + 1].astype(bool)
+                # every emitted token is real (EOS excluded in-program)
+                valid_h = (
+                    np.arange(width)[None, :] < counts_h[:, None]
+                )
+                n_cols = width
+            else:
+                out_h = packed_h[:, : self.chunk]
+                valid_h = packed_h[:, self.chunk : 2 * self.chunk].astype(bool)
+                active_h = packed_h[:, -1].astype(bool)
+                n_cols = self.chunk
             deactivate = []
             for slot in range(self.n_slots):
                 req = self._slot_req[slot]
                 if req is None:
                     continue
-                for t in range(self.chunk):
+                for t in range(n_cols):
                     if not valid_h[slot, t]:
                         continue
                     if len(req.tokens) >= self._slot_budget[slot]:
