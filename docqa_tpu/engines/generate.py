@@ -215,6 +215,54 @@ class GenerateEngine:
         table = jnp.full((b, vocab), -1, jnp.int32)
         return table.at[lane, prev].set(nxt, mode="drop")
 
+    def spec_verify_step(self, params, cache, table, cur, lengths, *, K):
+        """The draft → verify → accept core shared by the solo speculative
+        loop and the batcher's speculative chunk program (the two MUST stay
+        output-exact; sharing the subtle part keeps them from diverging).
+
+        Drafts K-1 tokens per lane by chained bigram lookup, verifies them
+        in one forward of q_len=K, and returns
+        ``(cache, g, m, cand, is_eos, eos_pos)``: greedy targets [b, K],
+        accepted-draft count [b], emission-candidate mask (g0..gm), EOS
+        hits among candidates, and the first-EOS position (K = none).
+        Callers apply their own emission masking (budget / live slots) and
+        state updates."""
+        b = cur.shape[0]
+        lane = jnp.arange(b)
+        karange = jnp.arange(K)[None, :]
+
+        def draft_step(tok, _):
+            nt = table[lane, tok]
+            nt = jnp.where(nt < 0, tok, nt)  # miss: repeat (cheap guess)
+            return nt, nt
+
+        _, drafts_t = jax.lax.scan(draft_step, cur, None, length=K - 1)
+        drafts = jnp.swapaxes(drafts_t, 0, 1)  # [b, K-1]
+        verify_in = jnp.concatenate([cur[:, None], drafts], axis=1)
+        logits, cache = decoder_forward(
+            params, self.cfg, verify_in, cache, lengths,
+            attn_lengths=lengths + K, use_flash=self.use_flash,
+        )
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, K]
+        match = (drafts == g[:, :-1]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
+        cand = karange <= m[:, None]  # emission candidates g0..gm
+        is_eos = (g == self.gen.eos_id) & cand
+        eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
+        return cache, g, m, cand, is_eos, eos_pos
+
+    def confirm_bigrams(self, table, cur, g, emit_valid):
+        """Record confirmed bigrams (cur, g0), (g0, g1), ... in the lookup
+        table so the answer's own phrases become draftable (self-lookup)."""
+        b, K = g.shape
+        lane = jnp.arange(b)
+        prev_seq = jnp.concatenate([cur[:, None], g[:, :-1]], axis=1)
+        prev_scatter = jnp.where(emit_valid, prev_seq, self.cfg.vocab_size)
+        return table.at[
+            jnp.broadcast_to(lane[:, None], prev_scatter.shape),
+            prev_scatter,
+        ].set(g, mode="drop")
+
     def _generate_spec_fn(
         self,
         params: Params,
@@ -270,25 +318,9 @@ class GenerateEngine:
 
         def body(state):
             cache, lengths, out, n_emit, done, table, cur = state
-
-            def draft_step(tok, _):
-                nt = table[lane, tok]
-                nt = jnp.where(nt < 0, tok, nt)  # miss: repeat (cheap guess)
-                return nt, nt
-
-            _, drafts_t = jax.lax.scan(draft_step, cur, None, length=K - 1)
-            drafts = jnp.swapaxes(drafts_t, 0, 1)  # [b, K-1]
-            verify_in = jnp.concatenate([cur[:, None], drafts], axis=1)
-            logits, cache = decoder_forward(
-                params, self.cfg, verify_in, cache, lengths,
-                attn_lengths=lengths + K, use_flash=self.use_flash,
+            cache, g, m, cand, is_eos, eos_pos = self.spec_verify_step(
+                params, cache, table, cur, lengths, K=K
             )
-            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, K]
-            match = (drafts == g[:, :-1]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
-            cand = karange <= m[:, None]  # emission candidates g0..gm
-            is_eos = (g == eos) & cand
-            eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
             budget = max_new - n_emit
             emit_valid = (
                 cand
@@ -312,14 +344,7 @@ class GenerateEngine:
             )[:, 0]
             cur_new = jnp.where(done_new | (n_valid == 0), cur, last_tok)
             lengths_new = jnp.where(done, lengths, lengths + n_valid)
-            # record confirmed bigrams (cur, g0), (g0, g1), ... so the
-            # answer's own phrases become draftable (self-lookup)
-            prev_seq = jnp.concatenate([cur[:, None], g[:, :-1]], axis=1)
-            prev_scatter = jnp.where(emit_valid, prev_seq, self.cfg.vocab_size)
-            table = table.at[
-                jnp.broadcast_to(lane[:, None], prev_scatter.shape),
-                prev_scatter,
-            ].set(g, mode="drop")
+            table = self.confirm_bigrams(table, cur, g, emit_valid)
             return cache, lengths_new, out, n_emit_new, done_new, table, cur_new
 
         state = (cache, prompt_lengths, out, n_emit, done, table, cur)

@@ -82,6 +82,12 @@ class Handle:
         return tokenizer.decode_ids(self.result(timeout))
 
 
+class QueueFull(RuntimeError):
+    """Admission control: the wait queue is at capacity.  The HTTP layer
+    maps this to 503 — bounded queueing beats unbounded latency growth
+    when arrival rate exceeds decode throughput."""
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching over a ``GenerateEngine``'s model."""
 
@@ -92,6 +98,7 @@ class ContinuousBatcher:
         chunk: Optional[int] = None,
         cache_len: Optional[int] = None,
         seed: int = 0,
+        max_queue: Optional[int] = 256,
     ) -> None:
         self.engine = engine
         self.cfg = engine.cfg
@@ -104,6 +111,7 @@ class ContinuousBatcher:
         self.cache_len = round_up(cache_len or self.cfg.max_seq_len, 128)
         self._seed = seed
         self._rng_counter = 0
+        self.max_queue = max_queue
         # prompt-lookup speculation in the served path (greedy only): each
         # chunk iteration verifies spec_k tokens per slot in one weight
         # read; served output stays exactly the solo greedy output
@@ -258,7 +266,7 @@ class ContinuousBatcher:
         can never clamp — see the ``width`` comment), per-slot emission
         count, active flag."""
         S, K = self.n_slots, self.spec_k
-        eos, pad = self.gen.eos_id, self.gen.pad_id
+        pad = self.gen.pad_id
         # Slab sizing vs the write window: an emitting iteration starts at
         # n_out < chunk and can add up to K tokens, so n_out caps at
         # chunk-1+K; the unconditional K-wide dynamic_update_slice then
@@ -266,7 +274,6 @@ class ContinuousBatcher:
         # its start downward and overwrite already-emitted tokens with the
         # pad tail (observed as trailing pads inside a slot's count).
         width = self.chunk + 2 * K
-        lane = jnp.arange(S)
         karange = jnp.arange(K)[None, :]
         out0 = jnp.full((S, width), pad, jnp.int32)
         n0 = jnp.zeros((S,), jnp.int32)
@@ -277,25 +284,9 @@ class ContinuousBatcher:
 
         def body(st):
             cache, table, tok, lengths, active, out, n_out = st
-
-            def draft_step(t, _):
-                nt = table[lane, t]
-                nt = jnp.where(nt < 0, t, nt)
-                return nt, nt
-
-            _, drafts_t = jax.lax.scan(draft_step, tok, None, length=K - 1)
-            drafts = jnp.swapaxes(drafts_t, 0, 1)  # [S, K-1]
-            verify_in = jnp.concatenate([tok[:, None], drafts], axis=1)
-            logits, cache = decoder_forward(
-                params, self.cfg, verify_in, cache, lengths,
-                attn_lengths=lengths + K, use_flash=self.engine.use_flash,
+            cache, g, m, cand, is_eos, eos_pos = self.engine.spec_verify_step(
+                params, cache, table, tok, lengths, K=K
             )
-            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K]
-            match = (drafts == g[:, :-1]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
-            cand = karange <= m[:, None]
-            is_eos = (g == eos) & cand
-            eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
             # freeze slots that already filled their chunk quota: the loop
             # keeps running for slower slots, and a frozen slot must not
             # emit, advance, or retire until the next dispatch
@@ -316,16 +307,7 @@ class ContinuousBatcher:
             last_tok = jnp.take_along_axis(
                 emitted, jnp.maximum(n_valid - 1, 0)[:, None], 1
             )[:, 0]
-            # confirmed bigrams (tok, g0), (g0, g1), ... extend the table so
-            # the answer's own phrases become draftable
-            prev_seq = jnp.concatenate([tok[:, None], g[:, :-1]], axis=1)
-            prev_scatter = jnp.where(
-                emit_valid, prev_seq, self.cfg.vocab_size
-            )
-            table = table.at[
-                jnp.broadcast_to(lane[:, None], prev_scatter.shape),
-                prev_scatter,
-            ].set(g, mode="drop")
+            table = self.engine.confirm_bigrams(table, tok, g, emit_valid)
             lengths = lengths + jnp.where(active, n_valid, 0)
             active = active & ~saw_eos
             tok = jnp.where(active & (n_valid > 0), last_tok, tok)
@@ -379,6 +361,14 @@ class ContinuousBatcher:
         with self._cv:
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
+            if (
+                self.max_queue is not None
+                and len(self._queue) >= self.max_queue
+            ):
+                DEFAULT_REGISTRY.counter("serve_shed").inc()
+                raise QueueFull(
+                    f"generation queue at capacity ({self.max_queue})"
+                )
             self._queue.append(req)
             self._cv.notify_all()
         DEFAULT_REGISTRY.counter("serve_submitted").inc()
@@ -432,7 +422,12 @@ class ContinuousBatcher:
         scatter out of bounds (dropped) and their sampled tokens are
         ignored.  A request whose prompt cannot be marshalled fails alone,
         before the dispatch — not with the whole round."""
-        usable = self.cache_len - 1
+        # Truncation limit mirrors the budget formula in
+        # _finalize_admissions (cache_len - n_ids - 1 - spec_k) with one
+        # extra row reserved, so a maximally-long prompt still gets
+        # budget >= 1 — otherwise prompts in the band truncate "in bounds"
+        # but retire with zero output (a 200 with an empty answer).
+        usable = self.cache_len - 2 - self.spec_k
         good: List[Tuple[int, "_Request", List[int]]] = []
         longest = 1
         for slot, req in pairs:
@@ -654,6 +649,7 @@ class ContinuousBatcher:
                 active_h = packed_h[:, -1].astype(bool)
                 n_cols = self.chunk
             deactivate = []
+            n_appended = 0
             for slot in range(self.n_slots):
                 req = self._slot_req[slot]
                 if req is None:
@@ -664,12 +660,19 @@ class ContinuousBatcher:
                     if len(req.tokens) >= self._slot_budget[slot]:
                         break
                     req.tokens.append(int(out_h[slot, t]))
+                    n_appended += 1
                 if (
                     not active_h[slot]
                     or len(req.tokens) >= self._slot_budget[slot]
                 ):
                     deactivate.append(slot)
                     self._retire(slot)
+            # tokens delivered per dispatch: with speculation this exceeds
+            # chunk x live-slots when drafts accept — the acceptance signal
+            # an operator watches on /metrics
+            DEFAULT_REGISTRY.histogram("serve_tokens_per_chunk").observe(
+                float(n_appended)
+            )
             if deactivate:
                 idx = jnp.asarray(deactivate, jnp.int32)
                 self._active = self._active.at[idx].set(False)

@@ -32,6 +32,7 @@ import time
 from typing import Optional
 
 from docqa_tpu.config import Config, load_config
+from docqa_tpu.engines.serve import QueueFull
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
 from docqa_tpu.service.broker import make_broker
 from docqa_tpu.service.pipeline import DocumentPipeline
@@ -435,7 +436,10 @@ def make_app(rt: DocQARuntime):
         # retrieval + submission on the device lane; decode wait on the gen
         # lane so N concurrent /ask share batcher slots (≈ solo latency)
         t0 = time.perf_counter()
-        pending = await on_device(rt.qa.ask_submit, q.question)
+        try:
+            pending = await on_device(rt.qa.ask_submit, q.question)
+        except QueueFull as e:
+            return json_error(503, str(e))
         result = await on_gen(pending.resolve)
         DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
             (time.perf_counter() - t0) * 1000
@@ -464,9 +468,12 @@ def make_app(rt: DocQARuntime):
         except Exception as e:
             return json_error(422, str(e))
         t0 = time.perf_counter()
-        pending = await on_device(
-            rt.summarizer.submit_prompt, body.prompt, body.max_tokens
-        )
+        try:
+            pending = await on_device(
+                rt.summarizer.submit_prompt, body.prompt, body.max_tokens
+            )
+        except QueueFull as e:
+            return json_error(503, str(e))
         summary = await on_gen(rt.summarizer.resolve, pending)
         if rt.batcher is not None:
             # the batcher path skips the engine's span("summarize"); record
@@ -494,6 +501,8 @@ def make_app(rt: DocQARuntime):
             )
         except SynthesisError as e:
             return json_error(e.status, e.detail)
+        except QueueFull as e:
+            return json_error(503, str(e))
         resp = await on_gen(finish)
         return web.json_response(json.loads(resp.model_dump_json()))
 
@@ -510,6 +519,8 @@ def make_app(rt: DocQARuntime):
             )
         except SynthesisError as e:
             return json_error(e.status, e.detail)
+        except QueueFull as e:
+            return json_error(503, str(e))
         resp = await on_gen(finish)
         return web.json_response(json.loads(resp.model_dump_json()))
 

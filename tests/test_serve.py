@@ -83,6 +83,24 @@ def test_generate_texts_roundtrip(engine, batcher):
     assert outs == solo
 
 
+def test_queue_backpressure(engine):
+    from docqa_tpu.engines.serve import QueueFull
+
+    b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=64,
+                          max_queue=2)
+    try:
+        # keep the queue saturated: slots drain slowly (device decode),
+        # so a burst beyond slots+queue must shed with QueueFull
+        handles = []
+        with pytest.raises(QueueFull):
+            for _ in range(64):
+                handles.append(b.submit_ids([3, 5], max_new_tokens=8))
+        for h in handles:
+            h.result(timeout=300)  # the admitted ones still complete
+    finally:
+        b.stop()
+
+
 def test_stop_fails_pending(engine):
     b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=256)
     h = b.submit_ids([3, 5], max_new_tokens=4)

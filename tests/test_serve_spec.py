@@ -72,6 +72,20 @@ def test_full_acceptance_constant_model():
     assert out == [7] * 10
 
 
+def test_long_prompt_truncates_instead_of_emitting_nothing(engines):
+    # prompt in the spec_k-wide band just under cache_len: must truncate
+    # (keeping the tail, where a RAG question sits) and still generate —
+    # the round-2 review caught budget going negative here
+    _plain, spec = engines
+    b = ContinuousBatcher(spec, n_slots=2, chunk=4, cache_len=128)
+    try:
+        long_prompt = [3 + i % 90 for i in range(126)]  # 128 - 2
+        out = b.submit_ids(long_prompt, max_new_tokens=6).result(timeout=300)
+    finally:
+        b.stop()
+    assert len(out) > 0
+
+
 def test_eos_retires_slot_and_reuses_it(engines):
     plain, spec = engines
     # find a prompt whose greedy continuation hits EOS early, if any;
