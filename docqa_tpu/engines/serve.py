@@ -384,8 +384,20 @@ class ContinuousBatcher:
     def generate_texts(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None
     ) -> List[str]:
-        """Batch-convenience API (same contract as GenerateEngine)."""
-        handles = [self.submit_text(p, max_new_tokens) for p in prompts]
+        """Batch-convenience API (same contract as GenerateEngine): accepts
+        any N.  Backpressure (``max_queue``) is an admission-control signal
+        for ONLINE callers; a bulk batch instead waits for the queue to
+        drain — shedding mid-batch would abandon already-admitted work."""
+        import time as _time
+
+        handles = []
+        for p in prompts:
+            while True:
+                try:
+                    handles.append(self.submit_text(p, max_new_tokens))
+                    break
+                except QueueFull:
+                    _time.sleep(0.005)  # the queue drains at decode pace
         return [h.text(self.engine.tokenizer) for h in handles]
 
     def stop(self) -> None:

@@ -1,0 +1,250 @@
+"""Contrastive fine-tuning for the sentence encoder.
+
+The reference consumed a frozen off-the-shelf sentence-transformers
+encoder (``semantic-indexer/indexer.py:21``; no training anywhere in the
+repo).  The TPU build trains all three model families in-framework —
+generator (``training/train.py``), PHI tagger (``training/ner.py``), and,
+here, the retrieval encoder: symmetric InfoNCE over in-batch negatives
+(the sentence-transformers MultipleNegativesRanking recipe), one jit
+program, DP over the ``data`` mesh axis.
+
+Why it matters for this system: retrieval quality is the recall term of
+the RAG pipeline; domain adaptation of the encoder on (query, passage)
+pairs mined from the indexed corpus is the standard lever when a generic
+embedding model underfits clinical phrasing.
+
+A synthetic pair generator rides along for the zero-egress environment:
+(query, positive) pairs are built by sampling keyword subsets of a
+passage — the query shares content words with its passage, other rows are
+the negatives.  It exercises the full path and demonstrably improves
+held-out retrieval with the in-repo tokenizer (see tests), standing in for
+real clinical query logs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from docqa_tpu.config import EncoderConfig
+from docqa_tpu.models.encoder import Params, encode_batch, init_encoder_params
+from docqa_tpu.runtime.mesh import MeshContext
+from docqa_tpu.runtime.metrics import get_logger
+
+log = get_logger("docqa.train.encoder")
+
+TrainState = Dict[str, object]
+
+
+def info_nce_loss(
+    params: Params,
+    cfg: EncoderConfig,
+    q_ids: jax.Array,  # [b, s]
+    q_len: jax.Array,  # [b]
+    p_ids: jax.Array,  # [b, s]
+    p_len: jax.Array,  # [b]
+    *,
+    temperature: float = 0.05,
+) -> jax.Array:
+    """Symmetric in-batch-negatives cross-entropy: row i's positive is
+    column i; every other row is a negative.  Embeddings come from the
+    SERVING forward (``encode_batch``) so train and serve share one
+    numerical path."""
+    zq = encode_batch(params, cfg, q_ids, q_len)  # [b, d] L2-normalized
+    zp = encode_batch(params, cfg, p_ids, p_len)
+    logits = (zq @ zp.T) / temperature  # [b, b] cosine / T
+    labels = jnp.arange(logits.shape[0])
+    l_qp = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    l_pq = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+    return (l_qp.mean() + l_pq.mean()) / 2
+
+
+def init_encoder_train_state(
+    rng: jax.Array,
+    cfg: EncoderConfig,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    mesh: Optional[MeshContext] = None,
+    params: Optional[Params] = None,
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    optimizer = optimizer or optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(2e-4, b1=0.9, b2=0.95, weight_decay=0.01),
+    )
+    if params is None:
+        params = init_encoder_params(rng, cfg)
+    if mesh is not None:
+        params = jax.device_put(params, mesh.replicated)
+    opt_state = optimizer.init(params)
+    return (
+        {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)},
+        optimizer,
+    )
+
+
+def make_encoder_train_step(
+    cfg: EncoderConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[MeshContext] = None,
+    *,
+    temperature: float = 0.05,
+):
+    """One jit program: InfoNCE loss → grads → update, batch DP-sharded.
+
+    NOTE the in-batch-negatives subtlety under data parallelism: with the
+    batch sharded over ``data``, the ``zq @ zp.T`` similarity matrix is a
+    cross-shard contraction — GSPMD inserts the all-gather of ``zp`` (the
+    [b, d] embedding block, tiny) so every shard scores against ALL
+    in-batch negatives, exactly like the single-device loss.  No
+    hand-written collective, and no silent per-shard negative shrinkage.
+    """
+
+    def step(state: TrainState, q_ids, q_len, p_ids, p_len):
+        if mesh is not None:
+            row = NamedSharding(mesh.mesh, P(mesh.data_axis, None))
+            vec = NamedSharding(mesh.mesh, P(mesh.data_axis))
+            q_ids = jax.lax.with_sharding_constraint(q_ids, row)
+            p_ids = jax.lax.with_sharding_constraint(p_ids, row)
+            q_len = jax.lax.with_sharding_constraint(q_len, vec)
+            p_len = jax.lax.with_sharding_constraint(p_len, vec)
+        loss, grads = jax.value_and_grad(info_nce_loss)(
+            state["params"], cfg, q_ids, q_len, p_ids, p_len,
+            temperature=temperature,
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {
+                "params": params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            },
+            loss,
+        )
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Synthetic (query, passage) pair generator — zero-egress stand-in for
+# mined clinical query logs.
+# ---------------------------------------------------------------------------
+
+_REAL_TOPICS: Tuple[Tuple[str, ...], ...] = (
+    ("aspirin", "cardiac", "prevention", "dose", "antiplatelet", "daily"),
+    ("metformin", "diabetes", "glucose", "insulin", "glycemic", "oral"),
+    ("hypertension", "lisinopril", "blood", "pressure", "systolic", "ace"),
+    ("asthma", "albuterol", "inhaler", "wheezing", "bronchial", "rescue"),
+    ("warfarin", "anticoagulation", "inr", "clot", "bleeding", "monitor"),
+    ("ginseng", "formula", "tonic", "qi", "root", "decoction"),
+    ("influenza", "vaccine", "seasonal", "immunization", "antiviral", "flu"),
+    ("migraine", "headache", "aura", "triptan", "photophobia", "episodic"),
+)
+
+
+def _make_topics(n_extra: int = 56, seed: int = 1234):
+    """Pad the real topics with generated ones (unique pseudo-terms) so a
+    batch larger than the topic pool doesn't recycle topics — recycled
+    topics make rows i and i+8 near-duplicates, and InfoNCE then labels a
+    passage containing the query's own keywords as a negative
+    (contradictory gradients that cap retrieval quality)."""
+    syl = (
+        "bra cre dro fli gno plu sta tri vor wex zan kel mor dun pev "
+        "qua rin sol tam urb"
+    ).split()
+    rng = np.random.default_rng(seed)
+    topics = list(_REAL_TOPICS)
+    seen = {w for t in topics for w in t}
+    while len(topics) < len(_REAL_TOPICS) + n_extra:
+        words = []
+        while len(words) < 6:
+            w = "".join(rng.choice(syl, 3))
+            if w not in seen:
+                seen.add(w)
+                words.append(w)
+        topics.append(tuple(words))
+    return tuple(topics)
+
+
+_TOPIC_WORDS: Tuple[Tuple[str, ...], ...] = _make_topics()
+_FILLER = (
+    "patient reports review plan continue stable daily follow up noted "
+    "history exam today without with the for and of on"
+).split()
+
+
+def synthetic_pairs(
+    rng: np.random.Generator, n: int
+) -> List[Tuple[str, str]]:
+    """(query, passage) pairs: each passage mixes one topic's content words
+    with filler; its query is a keyword subset of the SAME topic.  Distinct
+    rows draw distinct topics where possible, so in-batch negatives are
+    real negatives."""
+    pairs: List[Tuple[str, str]] = []
+    topics = rng.permutation(len(_TOPIC_WORDS))
+    for i in range(n):
+        topic = list(_TOPIC_WORDS[topics[i % len(_TOPIC_WORDS)]])
+        rng.shuffle(topic)
+        body = topic[:4] + list(rng.choice(_FILLER, 6))
+        rng.shuffle(body)
+        passage = " ".join(body)
+        query = " ".join(topic[:2])
+        pairs.append((query, passage))
+    return pairs
+
+
+def encode_pair_batch(
+    tokenizer, pairs: Sequence[Tuple[str, str]], seq: int
+):
+    """Host-side marshalling of a pair batch: ``tokenizer.batch`` already
+    returns right-padded [b, seq] ids with clamped lengths."""
+    q_ids, q_len = tokenizer.batch([q for q, _ in pairs], max_len=seq)
+    p_ids, p_len = tokenizer.batch([p for _, p in pairs], max_len=seq)
+    return q_ids, q_len, p_ids, p_len
+
+
+def train_encoder(
+    cfg: EncoderConfig,
+    steps: int = 200,
+    batch_size: int = 32,
+    seq: int = 32,
+    seed: int = 0,
+    mesh: Optional[MeshContext] = None,
+    params: Optional[Params] = None,
+    tokenizer=None,
+) -> Params:
+    """Short fit on the synthetic pair stream; returns trained params."""
+    from docqa_tpu.text.tokenizer import default_tokenizer
+    from docqa_tpu.utils import round_up
+
+    if steps < 1:
+        raise ValueError(f"train_encoder needs steps >= 1, got {steps}")
+    tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+    if mesh is not None and batch_size % mesh.n_data:
+        batch_size = round_up(batch_size, mesh.n_data)
+    state, optimizer = init_encoder_train_state(
+        jax.random.PRNGKey(seed), cfg, mesh=mesh, params=params
+    )
+    step_fn = make_encoder_train_step(cfg, optimizer, mesh=mesh)
+    rng = np.random.default_rng(seed)
+    for i in range(steps):
+        pairs = synthetic_pairs(rng, batch_size)
+        q_ids, q_len, p_ids, p_len = encode_pair_batch(tokenizer, pairs, seq)
+        state, loss = step_fn(
+            state,
+            jnp.asarray(q_ids),
+            jnp.asarray(q_len),
+            jnp.asarray(p_ids),
+            jnp.asarray(p_len),
+        )
+        if (i + 1) % 50 == 0 or i == steps - 1:
+            log.info(
+                "encoder step %d/%d loss %.4f", i + 1, steps, float(loss)
+            )
+    return state["params"]  # type: ignore[return-value]

@@ -83,6 +83,18 @@ def test_generate_texts_roundtrip(engine, batcher):
     assert outs == solo
 
 
+def test_generate_texts_blocks_past_queue_capacity(engine):
+    # the bulk API waits for the queue to drain instead of shedding
+    b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=64,
+                          max_queue=2)
+    try:
+        outs = b.generate_texts(["w3 w5"] * 10, max_new_tokens=4)
+    finally:
+        b.stop()
+    assert len(outs) == 10
+    assert len(set(outs)) == 1  # identical prompts, identical greedy output
+
+
 def test_queue_backpressure(engine):
     from docqa_tpu.engines.serve import QueueFull
 
