@@ -143,6 +143,45 @@ class DecoderConfig:
 
 
 @dataclass(frozen=True)
+class Seq2SeqConfig:
+    """BART-class encoder-decoder (the architecture BASELINE config 4
+    names for summarization: bart-large-cnn).  Layout is faithful to HF
+    ``BartForConditionalGeneration`` — post-LN residuals, learned positions
+    with the +2 padding offset, GELU, tied lm_head + final_logits_bias —
+    so real safetensors import 1:1 (``models/seq2seq.py``).  Defaults are a
+    smoke size; ``bart_large_cnn()`` is the target checkpoint's shape."""
+
+    vocab_size: int = 1024
+    d_model: int = 128
+    enc_layers: int = 2
+    dec_layers: int = 2
+    num_heads: int = 4
+    mlp_dim: int = 256
+    max_src_len: int = 256
+    max_tgt_len: int = 128
+    pos_offset: int = 2  # BART's learned-position padding offset
+    pad_id: int = 1  # BART convention: pad=1, bos=0, eos=2
+    bos_id: int = 0
+    eos_id: int = 2
+    decoder_start_id: int = 2  # HF bart: decoding starts from eos
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def bart_large_cnn() -> "Seq2SeqConfig":
+        return Seq2SeqConfig(
+            vocab_size=50264,
+            d_model=1024,
+            enc_layers=12,
+            dec_layers=12,
+            num_heads=16,
+            mlp_dim=4096,
+            max_src_len=1024,
+            max_tgt_len=1024,
+        )
+
+
+@dataclass(frozen=True)
 class SummarizerConfig:
     """Clinical summarizer (BART-class role per BASELINE.json config 4).
     Implemented as instruction-prompted decoding on the generator; this config
@@ -152,6 +191,11 @@ class SummarizerConfig:
     max_input_tokens: int = 3072
     max_summary_tokens: int = 512
     max_chunks: int = 5
+    # "decoder": instruction-prompted decoding on the causal LM, sharing
+    # its weights and the continuous batcher (default).  "seq2seq": a
+    # dedicated BART-class encoder-decoder (Seq2SeqConfig) — the
+    # architecture BASELINE config 4 names.
+    backend: str = "decoder"
 
 
 @dataclass(frozen=True)
@@ -290,6 +334,7 @@ class Config:
     ner: NERConfig = field(default_factory=NERConfig)
     decoder: DecoderConfig = field(default_factory=DecoderConfig)
     summarizer: SummarizerConfig = field(default_factory=SummarizerConfig)
+    seq2seq: Seq2SeqConfig = field(default_factory=Seq2SeqConfig)
     store: StoreConfig = field(default_factory=StoreConfig)
     chunk: ChunkConfig = field(default_factory=ChunkConfig)
     broker: BrokerConfig = field(default_factory=BrokerConfig)

@@ -1,0 +1,109 @@
+"""Serving wrapper for the BART-class summarizer (models/seq2seq.py).
+
+API-compatible with ``GenerateEngine`` where ``SummarizeEngine`` needs it
+(``tokenizer`` + ``generate_texts``), so the synthesis service can run on
+either backend: instruction-prompted decoding on the causal LM (default)
+or a dedicated encoder-decoder — the architecture BASELINE config 4 names
+(bart-large-cnn-class).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from docqa_tpu.config import Seq2SeqConfig
+from docqa_tpu.models.seq2seq import (
+    Params,
+    greedy_summarize_fn,
+    init_seq2seq_params,
+    load_hf_bart_weights,  # noqa: F401  (re-export for weight-drop day)
+)
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+from docqa_tpu.text.tokenizer import Tokenizer, default_tokenizer
+from docqa_tpu.utils import pick_bucket, round_up
+
+SRC_BUCKETS = (64, 128, 256, 512, 1024)
+BATCH_BUCKETS = (1, 2, 4, 8)
+
+
+class Seq2SeqEngine:
+    def __init__(
+        self,
+        cfg: Seq2SeqConfig,
+        params: Optional[Params] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+        if params is None:
+            params = init_seq2seq_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self._fns = {}
+
+    def _get_fn(self, max_new: int):
+        fn = self._fns.get(max_new)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    greedy_summarize_fn, cfg=self.cfg, max_new=max_new
+                ),
+                static_argnames=(),
+            )
+            self._fns[max_new] = fn
+        return fn
+
+    def generate_ids(
+        self,
+        src_ids: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Source token ids -> greedy summary ids (EOS excluded)."""
+        max_new = (
+            self.cfg.max_tgt_len - 1
+            if max_new_tokens is None  # explicit 0 means "no tokens"
+            else min(max_new_tokens, self.cfg.max_tgt_len - 1)
+        )
+        b = len(src_ids)
+        if b == 0 or max_new == 0:
+            return [[] for _ in src_ids]
+        longest = max(1, max(len(s) for s in src_ids))
+        bucket = min(
+            pick_bucket(longest, SRC_BUCKETS)
+            if longest <= SRC_BUCKETS[-1]
+            else round_up(longest, 128),
+            self.cfg.max_src_len,
+        )
+        b_pad = pick_bucket(b, BATCH_BUCKETS) if b <= BATCH_BUCKETS[-1] else b
+        ids = np.full((b_pad, bucket), self.cfg.pad_id, np.int32)
+        lengths = np.ones((b_pad,), np.int32)
+        for i, s in enumerate(src_ids):
+            s = list(s)[:bucket]  # summarization keeps the source HEAD
+            ids[i, : len(s)] = s
+            lengths[i] = max(len(s), 1)
+        fn = self._get_fn(max_new)
+        with span("seq2seq_generate", DEFAULT_REGISTRY):
+            out, n_emitted = fn(
+                self.params, src_ids=jnp.asarray(ids),
+                src_lengths=jnp.asarray(lengths),
+            )
+        out = np.asarray(out)[:b]
+        n_emitted = np.asarray(n_emitted)[:b]
+        return [
+            [int(t) for t in row[:count]]
+            for row, count in zip(out, n_emitted)
+        ]
+
+    def generate_texts(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: Optional[int] = None,
+    ) -> List[str]:
+        src = [self.tokenizer.encode(p) for p in prompts]
+        outs = self.generate_ids(src, max_new_tokens)
+        return [self.tokenizer.decode_ids(ids) for ids in outs]

@@ -387,9 +387,14 @@ class ContinuousBatcher:
         """Batch-convenience API (same contract as GenerateEngine): accepts
         any N.  Backpressure (``max_queue``) is an admission-control signal
         for ONLINE callers; a bulk batch instead waits for the queue to
-        drain — shedding mid-batch would abandon already-admitted work."""
+        drain — shedding mid-batch would abandon already-admitted work.
+        The wait is bounded (``DEFAULT_RESULT_TIMEOUT``), and a batcher
+        with queueing disabled outright (``max_queue=0``) fails fast."""
         import time as _time
 
+        if self.max_queue == 0:
+            raise QueueFull("batcher has queueing disabled (max_queue=0)")
+        deadline = _time.monotonic() + DEFAULT_RESULT_TIMEOUT
         handles = []
         for p in prompts:
             while True:
@@ -397,6 +402,8 @@ class ContinuousBatcher:
                     handles.append(self.submit_text(p, max_new_tokens))
                     break
                 except QueueFull:
+                    if _time.monotonic() > deadline:
+                        raise
                     _time.sleep(0.005)  # the queue drains at decode pace
         return [h.text(self.engine.tokenizer) for h in handles]
 

@@ -42,17 +42,25 @@ MULTI_PATIENT_TEMPLATE = (
 class SummarizeEngine:
     def __init__(
         self,
-        generator,  # GenerateEngine (shares tokenizer + decode programs)
+        generator,  # GenerateEngine or Seq2SeqEngine (tokenizer + generate_texts)
         cfg: Optional[SummarizerConfig] = None,
         use_fake: bool = False,
         fake_max_chars: int = 1200,
         batcher=None,  # ContinuousBatcher: concurrent summaries share slots
+        instruction_prompts: bool = True,
     ) -> None:
+        """``instruction_prompts``: wrap inputs in the clinical instruction
+        templates (right for instruction-following causal LMs).  A BART-class
+        seq2seq summarizer is trained to summarize RAW source text — an
+        instruction template would be *summarized as content* and waste
+        source-window tokens, so the seq2seq backend passes False and feeds
+        the packed documents directly."""
         self.generator = generator
         self.cfg = cfg or SummarizerConfig()
         self.use_fake = use_fake
         self.fake_max_chars = fake_max_chars
         self.batcher = batcher
+        self.instruction_prompts = instruction_prompts
 
     # ---- packing -------------------------------------------------------------
 
@@ -133,11 +141,14 @@ class SummarizeEngine:
         docs: Sequence[Tuple[str, str]],
         max_tokens: Optional[int] = None,
     ):
-        body = self._pack_documents(
-            docs, self._doc_budget(SINGLE_PATIENT_TEMPLATE)
+        template = (
+            SINGLE_PATIENT_TEMPLATE if self.instruction_prompts else "{documents}"
         )
-        prompt = SINGLE_PATIENT_TEMPLATE.format(
-            patient_id=patient_id, documents=body
+        body = self._pack_documents(docs, self._doc_budget(template))
+        prompt = (
+            template.format(patient_id=patient_id, documents=body)
+            if self.instruction_prompts
+            else body
         )
         return self.submit_prompt(prompt, max_tokens)
 
@@ -157,13 +168,16 @@ class SummarizeEngine:
         """[(patient_id, [(doc_id, text)])] → pending comparative summary.
         Block format mirrors the reference's ``=== PATIENT_x ===`` assembly
         (``routes.py:91-101``)."""
+        template = (
+            MULTI_PATIENT_TEMPLATE if self.instruction_prompts else "{documents}"
+        )
         n = max(1, len(patient_docs))
-        per_patient = self._doc_budget(MULTI_PATIENT_TEMPLATE) // n
+        per_patient = self._doc_budget(template) // n
         sections = []
         for pid, docs in patient_docs:
             body = self._pack_documents(docs, per_patient)
             sections.append(f"=== PATIENT {pid} ===\n{body}")
-        prompt = MULTI_PATIENT_TEMPLATE.format(documents="\n\n".join(sections))
+        prompt = template.format(documents="\n\n".join(sections))
         return self.submit_prompt(prompt, max_tokens)
 
     def compare_patients(

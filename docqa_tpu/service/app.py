@@ -149,11 +149,41 @@ class DocQARuntime:
             from docqa_tpu.engines.serve import ContinuousBatcher
 
             self.batcher = ContinuousBatcher(self.generator)
+        summarizer_cfg = self.cfg.summarizer
+        instruction_prompts = True
+        if (
+            summarizer_cfg.backend == "seq2seq"
+            and not self.cfg.flags.use_fake_llm  # fake path never decodes —
+            # don't pay a BART-class param init it would never touch
+        ):
+            # dedicated BART-class encoder-decoder (its own weights; the
+            # decode loop is seq2seq-internal, so no batcher lane).  Its
+            # source window bounds the packing budget — otherwise the
+            # engine would clip a 3k-token packed prompt to max_src_len
+            # and silently drop documents.
+            import dataclasses as _dc
+
+            from docqa_tpu.engines.seq2seq import Seq2SeqEngine
+
+            summarizer_model = Seq2SeqEngine(self.cfg.seq2seq)
+            summarizer_batcher = None
+            summarizer_cfg = _dc.replace(
+                summarizer_cfg,
+                max_input_tokens=min(
+                    summarizer_cfg.max_input_tokens,
+                    self.cfg.seq2seq.max_src_len,
+                ),
+            )
+            instruction_prompts = False  # BART summarizes raw source text
+        else:
+            summarizer_model = self.generator
+            summarizer_batcher = self.batcher
         self.summarizer = SummarizeEngine(
-            self.generator,
-            self.cfg.summarizer,
+            summarizer_model,
+            summarizer_cfg,
             use_fake=self.cfg.flags.use_fake_llm,
-            batcher=self.batcher,
+            batcher=summarizer_batcher,
+            instruction_prompts=instruction_prompts,
         )
 
         if journal_dir is None and self.cfg.data.work_dir:

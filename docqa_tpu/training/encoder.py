@@ -228,6 +228,11 @@ def train_encoder(
     tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
     if mesh is not None and batch_size % mesh.n_data:
         batch_size = round_up(batch_size, mesh.n_data)
+    if params is not None and mesh is None:
+        # the train step DONATES its state; without this copy the caller's
+        # params buffers are consumed by the first step (the mesh branch
+        # already copies via device_put)
+        params = jax.tree.map(lambda x: jnp.array(x, copy=True), params)
     state, optimizer = init_encoder_train_state(
         jax.random.PRNGKey(seed), cfg, mesh=mesh, params=params
     )
