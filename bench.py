@@ -371,6 +371,38 @@ def main() -> None:
         log(f"summarize bench failed: {e!r}")
         DETAILS["summarize"] = {"error": repr(e)}
 
+    # ---- config 4b: the dedicated BART-class encoder-decoder backend --------
+    # (the architecture BASELINE config 4 actually names; bart-large-cnn
+    # shape, ~0.8 GB bf16 — raw-source summarization, no instruction prompt)
+    try:
+        from docqa_tpu.config import Seq2SeqConfig
+        from docqa_tpu.engines.seq2seq import Seq2SeqEngine
+
+        s2s_cfg = Seq2SeqConfig() if small else Seq2SeqConfig.bart_large_cnn()
+        s2s = Seq2SeqEngine(s2s_cfg)
+        summ2 = SummarizeEngine(
+            s2s,
+            SummarizerConfig(max_input_tokens=s2s_cfg.max_src_len),
+            instruction_prompts=False,
+        )
+        summ2.summarize_patient("p1", docs, max_tokens=16 if small else 128)
+        t_s2s, _ = timed(
+            lambda: summ2.summarize_patient(
+                "p1", docs, max_tokens=16 if small else 128
+            )
+        )
+        DETAILS["summarize_seq2seq"] = {
+            "five_chunk_ms": round(t_s2s * 1e3, 1),
+            "model": f"bart-class {s2s_cfg.d_model}x"
+            f"{s2s_cfg.enc_layers}+{s2s_cfg.dec_layers}",
+        }
+        log(f"config4b seq2seq summarize (5 chunks): {t_s2s*1e3:.0f}ms")
+        del s2s, summ2
+        gc.collect()
+    except Exception as e:
+        log(f"seq2seq summarize bench failed: {e!r}")
+        DETAILS["summarize_seq2seq"] = {"error": repr(e)[:300]}
+
     # ---- config 2: deid NER throughput, batch = 32 --------------------------
     try:
         from docqa_tpu.deid.engine import DeidEngine
