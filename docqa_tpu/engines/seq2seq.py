@@ -42,7 +42,9 @@ class Seq2SeqEngine:
         self.cfg = cfg
         self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
         if params is None:
-            params = init_seq2seq_params(jax.random.PRNGKey(seed), cfg)
+            params = init_seq2seq_params(
+                jax.random.PRNGKey(seed), cfg, host_init=True
+            )
         self.params = params
         self._fns = {}
 

@@ -70,13 +70,34 @@ def seq2seq_param_schema(cfg: Seq2SeqConfig):
 
 
 def init_seq2seq_params(
-    rng: jax.Array, cfg: Seq2SeqConfig, param_dtype=None
+    rng: jax.Array, cfg: Seq2SeqConfig, param_dtype=None,
+    host_init: bool = False,
 ) -> Params:
+    """``host_init``: draw on the host and ``device_put`` per tensor — the
+    transfer path real checkpoints take, and it avoids the tunneled-client
+    dispatch degradation the device-side random-init sequence triggers
+    (see models/decoder.py); serving engines default to it."""
+    import numpy as _np
+
     param_dtype = jnp.dtype(param_dtype or cfg.dtype)
     schema = list(seq2seq_param_schema(cfg))
+    p: Params = {}
+    if host_init:
+        seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
+        host_rng = _np.random.default_rng(seed)
+        for name, kind, shape in schema:
+            if kind == "ones":
+                p[name] = jax.device_put(_np.ones(shape, param_dtype))
+            elif kind == "zeros":
+                p[name] = jax.device_put(_np.zeros(shape, param_dtype))
+            else:
+                p[name] = jax.device_put(
+                    (host_rng.standard_normal(shape, _np.float32) * 0.02)
+                    .astype(param_dtype)
+                )
+        return p
     n_normal = sum(1 for _, kind, _ in schema if kind == "normal")
     keys = iter(jax.random.split(rng, n_normal))
-    p: Params = {}
     for name, kind, shape in schema:
         if kind == "ones":
             p[name] = jnp.ones(shape, param_dtype)
