@@ -77,6 +77,25 @@ class TestFusedMatchesTwoStep:
         retr = FusedRetriever(enc, empty)
         assert retr.search_texts(["q"], k=3) == [[]]
 
+    def test_mesh_store_falls_back_to_two_step(self, setup, mesh8):
+        # a row-sharded store searches under shard_map; the fused
+        # single-device program must NOT be used, and results must still
+        # match the plain mesh search path
+        enc, _store, texts = setup
+        from docqa_tpu.config import StoreConfig
+
+        mstore = VectorStore(
+            StoreConfig(dim=64, shard_capacity=256), mesh=mesh8
+        )
+        vecs = enc.encode_texts(texts)
+        mstore.add(vecs, [{"doc_id": f"d{i}", "source": t} for i, t in enumerate(texts)])
+        retr = FusedRetriever(enc, mstore)
+        assert not retr._fusable
+        fused = retr.search_texts(["diabetes management"], k=3)
+        emb = enc.encode_texts(["diabetes management"])
+        plain = mstore.search(emb, k=3)
+        assert [r.row_id for r in fused[0]] == [r.row_id for r in plain[0]]
+
     def test_metadata_carried(self, setup):
         enc, store, texts = setup
         retr = FusedRetriever(enc, store)
