@@ -9,12 +9,16 @@ BASELINE.md: "measured, not inherited"; vs_baseline = 1000 / p50_ms).
 The rest of the BASELINE.json config matrix is measured in the same run,
 logged to stderr, and written to ``bench_details.json``:
 
-  1. retrieval: exact top-k latency at 1M chunks (+ encode)
+  1. retrieval: exact top-k latency at 1M chunks, encode-only, and the
+     fused one-dispatch text->top-k path
   2. deid: NER PHI tagging throughput, batch = 32 docs
-  3. generator: greedy decode tokens/s + HBM-bandwidth utilization
-     (1.1B-class serving model AND a Mistral-7B-class attempt in bf16 —
-     one v5e chip has 16 GB HBM; if the 7B OOMs that is recorded)
-  4. summarizer: 5-chunk patient summary latency
+  3. generator: greedy decode tokens/s + HBM-bandwidth utilization for
+     the 1.1B-class serving model in bf16 AND int8 (the serving default —
+     the headline e2e runs on int8, with a bf16 e2e alongside for round-1
+     comparability), plus Mistral-7B-class attempts in bf16 and int8
+     (one v5e chip has 16 GB HBM; if the bf16 7B OOMs that is recorded)
+  4. summarizer: 5-chunk patient summary latency on the decoder backend
+     and on the dedicated BART-class encoder-decoder
   5. full RAG under load: sustained QPS through the continuous batcher
      (target 16) with per-request latency
 
