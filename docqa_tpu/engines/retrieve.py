@@ -126,6 +126,9 @@ class FusedRetriever:
             mask = None
             if filters:
                 mask = store._filter_mask_locked(filters)
+            mask = store._compose_live_locked(
+                mask, already_live=bool(filters)
+            )
             fn = self._get_fn(k_eff, masked=mask is not None)
             args = [
                 self.encoder.params,

@@ -151,12 +151,19 @@ class VectorStore:
         self._search_fns: Dict[Tuple[int, int, int], Callable] = {}
         self._append_jit = jax.jit(_append_kernel, donate_argnums=(0,))
         # columnar metadata (code -1 == absent; intern code space per column)
-        self._codes: Dict[str, Dict[str, int]] = {"patient_id": {}, "doc_type": {}}
+        self._codes: Dict[str, Dict[str, int]] = {
+            "patient_id": {}, "doc_type": {}, "doc_id": {},
+        }
         self._cols: Dict[str, np.ndarray] = {
             "patient_id": np.zeros((0,), np.int32),
             "doc_type": np.zeros((0,), np.int32),
+            "doc_id": np.zeros((0,), np.int32),
             "doc_date": np.zeros((0,), np.int32),
         }
+        # tombstones: deleted rows stay in HBM (append-only buffer) but are
+        # masked out of every search; ``compact_deleted`` erases for real
+        self._deleted = np.zeros((0,), bool)
+        self._n_deleted = 0
 
     def _intern(self, column: str, value: Optional[str]) -> int:
         if value is None:
@@ -178,6 +185,12 @@ class VectorStore:
                 )
                 grown[: col.shape[0]] = col
                 self._cols[name] = grown
+        if self._deleted.shape[0] < start + n:
+            grown_d = np.zeros(
+                (max(start + n, 2 * max(1, self._deleted.shape[0])),), bool
+            )
+            grown_d[: self._deleted.shape[0]] = self._deleted
+            self._deleted = grown_d
         for i, md in enumerate(metadata):
             self._cols["patient_id"][start + i] = self._intern(
                 "patient_id", md.get("patient_id")
@@ -185,7 +198,13 @@ class VectorStore:
             self._cols["doc_type"][start + i] = self._intern(
                 "doc_type", md.get("doc_type")
             )
+            self._cols["doc_id"][start + i] = self._intern(
+                "doc_id", md.get("doc_id")
+            )
             self._cols["doc_date"][start + i] = _date_code(md.get("doc_date"))
+            if md.get("deleted"):  # restore path: tombstones persist
+                self._deleted[start + i] = True
+                self._n_deleted += 1
 
     # ---- capacity management -------------------------------------------------
 
@@ -345,8 +364,105 @@ class VectorStore:
                 live &= dates <= code
         if filters.get("date_from") or filters.get("date_to"):
             live &= dates >= 0  # undated rows excluded when bounds given
+        if self._n_deleted:
+            live &= ~self._deleted[:count]
         mask[:count] = live
         return mask
+
+    def _live_mask_locked(self) -> Optional[np.ndarray]:
+        """[capacity] live mask, or None when nothing is deleted — the
+        zero-tombstone path keeps unfiltered searches mask-free (a mask
+        upload costs a host->device transfer per query batch)."""
+        if not self._n_deleted:
+            return None
+        mask = np.zeros((self._capacity,), bool)
+        mask[: self._count] = ~self._deleted[: self._count]
+        return mask
+
+    def _compose_live_locked(
+        self, mask: Optional[np.ndarray], already_live: bool
+    ) -> Optional[np.ndarray]:
+        """Fold the tombstone mask into an (optional) filter mask — the ONE
+        place the live-rows invariant lives, so every search surface
+        composes it identically.  ``already_live``: the mask came from
+        ``_filter_mask_locked`` (which ANDs tombstones itself)."""
+        if already_live or not self._n_deleted:
+            return mask
+        live = self._live_mask_locked()
+        return live if mask is None else (mask & live)
+
+    def delete_docs(self, doc_ids: Sequence[str]) -> int:
+        """Tombstone every chunk of the given documents: rows vanish from
+        all searches/listings immediately; vector bytes remain in HBM and
+        snapshots until ``compact_deleted``.  Returns rows tombstoned."""
+        with self._lock:
+            count = self._count
+            if count == 0:
+                return 0
+            codes = [
+                self._codes["doc_id"].get(d)
+                for d in doc_ids
+                if self._codes["doc_id"].get(d) is not None
+            ]
+            if not codes:
+                return 0
+            hit = np.isin(self._cols["doc_id"][:count], codes)
+            hit &= ~self._deleted[:count]
+            n = int(hit.sum())
+            if n == 0:
+                return 0
+            self._deleted[:count] |= hit
+            self._n_deleted += n
+            for i in np.nonzero(hit)[0]:
+                self._meta[int(i)]["deleted"] = True  # persists via snapshot
+            self._version += 1
+            log.info("tombstoned %d rows across %d docs", n, len(codes))
+            return n
+
+    def compact_deleted(self) -> int:
+        """Physically remove tombstoned rows (real erasure, not a mask):
+        rewrites the host copy, columns, and the device buffer.  Row ids
+        change — any derived index (IVF/tiered) must rebuild from the new
+        state.  Returns rows removed."""
+        with self._lock:
+            count = self._count
+            if not self._n_deleted:
+                return 0
+            keep = ~self._deleted[:count]
+            removed = count - int(keep.sum())
+            self._host = self._host[:count][keep].copy()
+            self._meta = [
+                md for md, k in zip(self._meta, keep) if k
+            ]
+            self._count = int(keep.sum())
+            # rebuild interned columns from scratch (codes for deleted-only
+            # values are dropped with them)
+            self._codes = {"patient_id": {}, "doc_type": {}, "doc_id": {}}
+            self._cols = {
+                "patient_id": np.zeros((0,), np.int32),
+                "doc_type": np.zeros((0,), np.int32),
+                "doc_id": np.zeros((0,), np.int32),
+                "doc_date": np.zeros((0,), np.int32),
+            }
+            self._deleted = np.zeros((0,), bool)
+            self._n_deleted = 0
+            saved_count = self._count
+            self._count = 0
+            self._append_columns(self._meta)
+            self._count = saved_count
+            # fresh device buffer from the compacted host copy
+            n_pad = round_up(max(self._count, 1), 64)
+            self._capacity = self._round_capacity(max(n_pad, 128))
+            buf = np.zeros((self._capacity, self.cfg.dim), np.float32)
+            buf[: self._count] = self._host[: self._count]
+            self._dev = jnp.asarray(buf, self._dtype)
+            if self.mesh is not None:
+                self._dev = jax.device_put(self._dev, self.mesh.row_sharded)
+            if self._count == 0:  # keep a 1-row pad so slicing stays valid
+                self._host = np.zeros((1, self.cfg.dim), np.float32)
+            self._version += 1
+            log.info("compacted %d deleted rows; %d remain", removed, self._count)
+            return removed
 
     def metadata_select(
         self,
@@ -405,6 +521,7 @@ class VectorStore:
                 for i in range(count):
                     host[i] = bool(where(self._meta[i]))
                 mask = host if mask is None else (mask & host)
+            mask = self._compose_live_locked(mask, already_live=bool(filters))
             fn = self._get_search_fn(len(qn), k_eff, masked=mask is not None)
             args = [self._dev, jnp.asarray(qn, self._dtype), jnp.int32(count)]
             if mask is not None:
@@ -454,12 +571,16 @@ class VectorStore:
 
     # ---- versioned snapshot (checkpoint/resume parity, SURVEY §5) -----------
 
-    def snapshot(self, directory: str) -> str:
+    def snapshot(self, directory: str, keep_previous: bool = True) -> str:
         """Atomic versioned publish: vectors + metadata + manifest.
 
         Write-temp + rename — a reader never sees a half-written index
         (the reference's save had no such guarantee, ``indexer.py:26-30``).
-        """
+
+        ``keep_previous=False`` prunes every superseded snapshot instead of
+        retaining one rollback predecessor — required after an erasure
+        compaction, where the predecessor still holds the erased vectors
+        and de-identified text on disk."""
         os.makedirs(directory, exist_ok=True)
         with self._lock:
             count, version = self._count, self._version
@@ -507,7 +628,7 @@ class VectorStore:
             ),
             reverse=True,
         )
-        for old in versions[2:]:
+        for old in versions[1 if not keep_previous else 2:]:
             shutil.rmtree(
                 os.path.join(directory, f"index_v{old}"), ignore_errors=True
             )

@@ -76,6 +76,10 @@ class TieredIndex:
         self._tier: Optional[tuple] = None  # (IVFIndex, covered_rows)
         self._rebuild_lock = threading.Lock()
         self._rebuilding = False
+        # bumped by reset(): a rebuild begun against a pre-reset snapshot
+        # must NOT publish (it would resurrect erased vectors and set a
+        # stale covered watermark that hides newer rows)
+        self._gen = 0
         # device-resident tail: (covered, count, padded_dev, n_live, meta);
         # rebuilt only when the store grows, so queries between appends pay
         # zero host→device traffic
@@ -96,6 +100,7 @@ class TieredIndex:
         """Synchronous rebuild from a consistent store snapshot; returns
         whether an IVF tier is now active (False below ``min_rows`` — exact
         search is already optimal there)."""
+        gen = self._gen
         vectors, meta = self.store.vectors_snapshot()
         if len(vectors) < self.min_rows:
             return self._tier is not None
@@ -108,7 +113,11 @@ class TieredIndex:
                 seed=self.seed,
                 dtype=str(self.store.cfg.dtype),
             )
-        self._tier = (ivf, len(vectors))  # single-reference publish
+        with self._rebuild_lock:
+            if gen != self._gen:
+                log.info("discarding rebuild begun before reset()")
+                return self._tier is not None
+            self._tier = (ivf, len(vectors))  # single-reference publish
         log.info("tiered: ivf tier now covers %d rows", len(vectors))
         return True
 
@@ -159,7 +168,11 @@ class TieredIndex:
             _, _, tail_dev, n_live, tail_meta = self._tail_device(covered)
             if n_live == 0:
                 return [
-                    [SearchResult(s, rid, md) for s, rid, md in row[:k]]
+                    [
+                        SearchResult(s, rid, md)
+                        for s, rid, md in row
+                        if not md.get("deleted")
+                    ][:k]
                     for row in bulk
                 ]
             qn = queries / np.maximum(
@@ -177,18 +190,36 @@ class TieredIndex:
 
         out: List[List[SearchResult]] = []
         for qi in range(len(queries)):
+            # tombstoned rows are filtered here between rebuilds (the IVF
+            # tier still physically holds them); compaction + reset() is
+            # the erasure path
             cands: List[SearchResult] = [
-                SearchResult(s, rid, md) for s, rid, md in bulk[qi]
+                SearchResult(s, rid, md)
+                for s, rid, md in bulk[qi]
+                if not md.get("deleted")
             ]
             for s, tid in zip(vals[qi], ids[qi]):
                 if s <= NEG_INF / 2:
                     continue
-                cands.append(
-                    SearchResult(float(s), covered + int(tid), tail_meta[int(tid)])
-                )
+                md = tail_meta[int(tid)]
+                if md.get("deleted"):
+                    continue
+                cands.append(SearchResult(float(s), covered + int(tid), md))
             cands.sort(key=lambda r: -r.score)
             out.append(cands[:k])
         return out
+
+    def reset(self) -> None:
+        """Drop the IVF tier and tail cache (searches fall back to exact
+        until the next rebuild).  Required after ``store.compact_deleted``:
+        compaction renumbers rows, and a stale tier would both misattribute
+        ids and keep serving erased vectors.  Bumps the generation so an
+        in-flight background rebuild (whose snapshot predates the reset)
+        discards itself instead of publishing."""
+        with self._rebuild_lock:
+            self._gen += 1
+            self._tier = None
+            self._tail_cache = None
 
     def _tail_device(self, covered: int):
         """Device-resident padded tail, rebuilt only when the store has

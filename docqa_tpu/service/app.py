@@ -287,11 +287,11 @@ class DocQARuntime:
 
     # ---- persistence hooks ---------------------------------------------------
 
-    def _snapshot(self) -> None:
+    def _snapshot(self, keep_previous: bool = True) -> None:
         if not self._index_dir:
             return
         try:
-            self.store.snapshot(self._index_dir)
+            self.store.snapshot(self._index_dir, keep_previous=keep_previous)
             self._docs_since_snapshot = 0
         except Exception:
             log.exception("index snapshot failed")
@@ -305,6 +305,42 @@ class DocQARuntime:
         self._docs_since_snapshot += n_docs
         if self._docs_since_snapshot >= self.cfg.data.snapshot_every:
             self._snapshot()
+
+    def delete_document(self, doc_id: str, erase: bool = False) -> int:
+        """Tombstone a document out of retrieval (clinical right-to-erasure;
+        the reference had no deletion at all — its index only ever grew).
+
+        Covers every lifecycle stage: a doc still in the async pipeline is
+        suppressed (its queued message gets dropped, not indexed); an
+        indexed doc's chunks are tombstoned; ``erase=True`` additionally
+        compacts the store — run even when THIS call tombstoned nothing,
+        so erasing an already-tombstoned doc still removes its bytes — and
+        resets any IVF tier built over the old row numbering.  Returns the
+        number of chunks tombstoned by this call."""
+        from docqa_tpu.service import registry as reg
+
+        # first, so a racing index-worker batch can't add chunks after we
+        # looked: suppression wins regardless of pipeline position
+        self.pipeline.suppress_doc(doc_id)
+        n = self.store.delete_docs([doc_id])
+        compacted = 0
+        if erase:
+            compacted = self.store.compact_deleted()
+            if compacted and self.search_index is not self.store and hasattr(
+                self.search_index, "reset"
+            ):
+                self.search_index.reset()
+        try:
+            self.registry.set_status(doc_id, reg.DELETED)
+        except Exception:
+            log.exception("status write failed for %s", doc_id)
+        if n or compacted:
+            # deletions must survive a crash immediately — this is a
+            # privacy action, not an indexing optimization.  An erasure
+            # also drops the rollback predecessor snapshot: it still holds
+            # the erased vectors + de-identified text on disk.
+            self._snapshot(keep_previous=not erase)
+        return n
 
     def stop(self) -> None:
         self.pipeline.stop()
@@ -452,6 +488,18 @@ def make_app(rt: DocQARuntime):
             return json_error(404, "document not found")
         return web.json_response(rec.to_dict())
 
+    async def document_delete(req):
+        doc_id = req.match_info["doc_id"]
+        rec = rt.registry.get(doc_id)
+        if rec is None:
+            return json_error(404, "document not found")
+        erase = req.query.get("erase") in ("1", "true")
+        # device lane: tombstoning races with appends/searches otherwise
+        n = await on_device(rt.delete_document, doc_id, erase)
+        return web.json_response(
+            {"doc_id": doc_id, "chunks_removed": n, "erased": erase}
+        )
+
     # ---- QA -----------------------------------------------------------------
 
     async def ask(req):
@@ -571,6 +619,7 @@ def make_app(rt: DocQARuntime):
             web.post("/ingest/", ingest),
             web.get("/documents/", documents),
             web.get("/documents/{doc_id}", document_one),
+            web.delete("/documents/{doc_id}", document_delete),
             web.post("/ask/", ask),
             web.get("/api/search/patient-snippets", patient_snippets),
             web.post("/api/llm/summarize", llm_summarize),

@@ -64,6 +64,10 @@ class DocumentPipeline:
         self._indexed_doc_ids = {
             md.get("doc_id") for md in store.metadata_rows()
         }
+        # docs deleted while still in flight: the index worker must drop
+        # their messages instead of indexing a document the user already
+        # erased (and must NOT mark them INDEXED)
+        self._suppressed_doc_ids: set = set()
         self._consumers = [
             Consumer(
                 broker,
@@ -86,6 +90,12 @@ class DocumentPipeline:
                 ),
             ),
         ]
+
+    def suppress_doc(self, doc_id: str) -> None:
+        """Never index this document, even if its pipeline message is still
+        queued or replays later — the deletion path calls this so a DELETE
+        racing the async pipeline cannot resurrect the document."""
+        self._suppressed_doc_ids.add(doc_id)
 
     # ---- lifecycle -----------------------------------------------------------
 
@@ -179,6 +189,9 @@ class DocumentPipeline:
         per_doc: List[tuple] = []
         replayed: List[str] = []
         for body in bodies:
+            if body["doc_id"] in self._suppressed_doc_ids:
+                log.info("dropping deleted in-flight doc %s", body["doc_id"])
+                continue
             if body["doc_id"] in self._indexed_doc_ids:
                 log.info(
                     "skipping replayed already-indexed doc %s", body["doc_id"]

@@ -39,6 +39,7 @@ DEIDENTIFIED = "DEIDENTIFIED"
 INDEXED = "INDEXED"
 ERROR_DEID = "ERROR_DEID"
 ERROR_INDEXING = "ERROR_INDEXING"
+DELETED = "DELETED"  # tombstoned out of the index (DELETE /documents/{id})
 
 
 @dataclass
