@@ -164,8 +164,16 @@ class Seq2SeqConfig:
     bos_id: int = 0
     eos_id: int = 2
     decoder_start_id: int = 2  # HF bart: decoding starts from eos
+    # HF BART generation forces BOS as the first decoded token
+    forced_bos_id: Optional[int] = None
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # beam search; 1 = greedy.  (Of bart-large-cnn's shipped generation
+    # config this implements num_beams/length_penalty/forced_bos_token_id;
+    # min_length, no_repeat_ngram_size, and early_stopping are not
+    # implemented — HF output parity is approximate until they are.)
+    num_beams: int = 1
+    length_penalty: float = 1.0
 
     @staticmethod
     def bart_large_cnn() -> "Seq2SeqConfig":
@@ -178,6 +186,9 @@ class Seq2SeqConfig:
             mlp_dim=4096,
             max_src_len=1024,
             max_tgt_len=1024,
+            forced_bos_id=0,
+            num_beams=4,
+            length_penalty=2.0,
         )
 
 

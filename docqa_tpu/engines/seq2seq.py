@@ -19,6 +19,7 @@ import numpy as np
 from docqa_tpu.config import Seq2SeqConfig
 from docqa_tpu.models.seq2seq import (
     Params,
+    beam_summarize_fn,
     greedy_summarize_fn,
     init_seq2seq_params,
     load_hf_bart_weights,  # noqa: F401  (re-export for weight-drop day)
@@ -51,12 +52,22 @@ class Seq2SeqEngine:
     def _get_fn(self, max_new: int):
         fn = self._fns.get(max_new)
         if fn is None:
-            fn = jax.jit(
-                functools.partial(
-                    greedy_summarize_fn, cfg=self.cfg, max_new=max_new
-                ),
-                static_argnames=(),
-            )
+            if self.cfg.num_beams > 1:
+                fn = jax.jit(
+                    functools.partial(
+                        beam_summarize_fn,
+                        cfg=self.cfg,
+                        max_new=max_new,
+                        n_beams=self.cfg.num_beams,
+                        length_penalty=self.cfg.length_penalty,
+                    )
+                )
+            else:
+                fn = jax.jit(
+                    functools.partial(
+                        greedy_summarize_fn, cfg=self.cfg, max_new=max_new
+                    )
+                )
             self._fns[max_new] = fn
         return fn
 

@@ -285,6 +285,8 @@ def greedy_summarize_fn(
         cross_kv, src_lengths,
     )
     first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    if cfg.forced_bos_id is not None:  # HF BART: first decoded token is BOS
+        first = jnp.full((b,), cfg.forced_bos_id, jnp.int32)
     out = jnp.full((b, max_new), cfg.pad_id, jnp.int32)
     out = out.at[:, 0].set(first)
     done = first == cfg.eos_id
@@ -313,6 +315,155 @@ def greedy_summarize_fn(
         cond, body, (jnp.int32(1), cache, out, done, n_emitted)
     )
     return out, n_emitted
+
+
+NEG_INF = -1e30
+
+
+def beam_summarize_fn(
+    params: Params,
+    cfg: Seq2SeqConfig,
+    src_ids: jax.Array,  # [b, s]
+    src_lengths: jax.Array,  # [b]
+    *,
+    max_new: int,
+    n_beams: int,
+    length_penalty: float = 1.0,
+):
+    """Beam-search decode as ONE program (bart-large-cnn ships with beam 4;
+    greedy under-serves it).  Beams ride the batch axis ([b*B] lanes): the
+    per-step reorder gathers the self-attention cache rows by winning beam,
+    while the (tiled, never-mutated) cross K/V needs no reorder.  A
+    finished beam exposes exactly one continuation (pad at logp 0) so its
+    score freezes but it stays selectable; final ranking divides by
+    emitted length ** ``length_penalty`` (GNMT-style).
+
+    ``n_beams=1`` reduces to exactly the greedy trajectory (tested).
+    Returns (tokens [b, max_new], n_emitted [b]) like the greedy fn.
+    """
+    b = src_ids.shape[0]
+    B, V = n_beams, cfg.vocab_size
+    eos, pad = cfg.eos_id, cfg.pad_id
+    alpha = jnp.float32(length_penalty)
+
+    def penalize(score, n):
+        return score / jnp.maximum(n, 1).astype(jnp.float32) ** alpha
+
+    enc_h = encode_source(params, cfg, src_ids, src_lengths)
+    cross_kv = {
+        k: jnp.repeat(v, B, axis=0)
+        for k, v in precompute_cross_kv(params, cfg, enc_h).items()
+    }
+    srcl = jnp.repeat(src_lengths, B, axis=0)
+    cache = init_self_cache(cfg, b * B, max_new + 1)
+
+    start = jnp.full((b * B, 1), cfg.decoder_start_id, jnp.int32)
+    logits, cache = decoder_forward(
+        params, cfg, start, cache, jnp.zeros((b * B,), jnp.int32),
+        cross_kv, srcl,
+    )
+    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    if cfg.forced_bos_id is not None:
+        # HF BART generation forces BOS as the first decoded token; all
+        # beams share that prefix, so only beam 0 carries weight until the
+        # first real branching step
+        tok0 = jnp.full((b, B), cfg.forced_bos_id, jnp.int32)
+        scores = jnp.where(
+            jnp.arange(B)[None, :] == 0,
+            logp.reshape(b, B, V)[:, 0, cfg.forced_bos_id][:, None],
+            NEG_INF,
+        )
+    else:
+        # all beams of a row are identical at step 0 — branch from beam 0
+        scores, tok0 = jax.lax.top_k(logp.reshape(b, B, V)[:, 0], B)
+    out = jnp.full((b, B, max_new), pad, jnp.int32)
+    out = out.at[:, :, 0].set(tok0)
+    done = tok0 == eos
+    emit_len = jnp.where(done, 0, 1).astype(jnp.int32)
+    pad_only = jnp.where(  # a finished beam's single allowed continuation
+        jax.nn.one_hot(pad, V, dtype=jnp.float32) > 0, 0.0, NEG_INF
+    )
+    # the finished-hypothesis pool: a beam that hits EOS is banked here
+    # immediately, so later eviction from the live beam (higher-scoring
+    # prefixes whose completions end up worse) cannot lose it
+    fin_score = jnp.where(done, penalize(scores, emit_len), NEG_INF)
+    best0 = jnp.argmax(fin_score, axis=1)
+    fin_best = jnp.max(fin_score, axis=1)  # [b] penalized
+    fin_tokens = jnp.take_along_axis(out, best0[:, None, None], 1)[:, 0]
+    fin_len = jnp.take_along_axis(emit_len, best0[:, None], 1)[:, 0]
+
+    def cond(st):
+        t, _, _, _, done, _, _, _, _ = st
+        return jnp.logical_and(t < max_new, ~jnp.all(done))
+
+    def body(st):
+        (t, cache, out, scores, done, emit_len,
+         fin_best, fin_tokens, fin_len) = st
+        prev = out[:, :, t - 1].reshape(b * B)
+        logits, cache = decoder_forward(
+            params, cfg, prev[:, None], cache,
+            jnp.full((b * B,), t, jnp.int32), cross_kv, srcl,
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), axis=-1
+        ).reshape(b, B, V)
+        cont = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
+        total = scores[:, :, None] + cont  # [b, B, V]
+        scores_new, idx = jax.lax.top_k(total.reshape(b, B * V), B)
+        beam_idx = idx // V  # [b, B]
+        tok = (idx % V).astype(jnp.int32)
+        # reorder beam-carried state by the winning parent beam
+        rows = (jnp.arange(b)[:, None] * B + beam_idx).reshape(-1)
+        cache = {k: v[rows] for k, v in cache.items()}
+        out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
+        done_g = jnp.take_along_axis(done, beam_idx, axis=1)
+        emit_g = jnp.take_along_axis(emit_len, beam_idx, axis=1)
+        out = out.at[:, :, t].set(jnp.where(done_g, pad, tok))
+        is_eos = (~done_g) & (tok == eos)
+        emit_len_new = emit_g + jnp.where(done_g | is_eos, 0, 1)
+        done_new = done_g | is_eos
+        # bank newly finished hypotheses into the pool
+        cand = jnp.where(is_eos, penalize(scores_new, emit_len_new), NEG_INF)
+        cand_best = jnp.argmax(cand, axis=1)
+        cand_score = jnp.max(cand, axis=1)
+        better = cand_score > fin_best
+        fin_best = jnp.where(better, cand_score, fin_best)
+        fin_tokens = jnp.where(
+            better[:, None],
+            jnp.take_along_axis(out, cand_best[:, None, None], 1)[:, 0],
+            fin_tokens,
+        )
+        fin_len = jnp.where(
+            better,
+            jnp.take_along_axis(emit_len_new, cand_best[:, None], 1)[:, 0],
+            fin_len,
+        )
+        return (t + 1, cache, out, scores_new, done_new, emit_len_new,
+                fin_best, fin_tokens, fin_len)
+
+    (_, _, out, scores, done, emit_len, fin_best, fin_tokens, fin_len) = (
+        jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(1), cache, out, scores, done, emit_len,
+             fin_best, fin_tokens, fin_len),
+        )
+    )
+    # final ranking: best banked hypothesis vs best still-live beam
+    live_pen = jnp.where(done, NEG_INF, penalize(scores, emit_len))
+    live_best = jnp.argmax(live_pen, axis=1)
+    live_score = jnp.max(live_pen, axis=1)
+    use_fin = fin_best >= live_score
+    tokens = jnp.where(
+        use_fin[:, None],
+        fin_tokens,
+        jnp.take_along_axis(out, live_best[:, None, None], axis=1)[:, 0],
+    )
+    n_emitted = jnp.where(
+        use_fin,
+        fin_len,
+        jnp.take_along_axis(emit_len, live_best[:, None], axis=1)[:, 0],
+    )
+    return tokens, n_emitted
 
 
 # ---------------------------------------------------------------------------
