@@ -390,7 +390,15 @@ def main() -> None:
         s2s_cfg = (
             Seq2SeqConfig()
             if small
-            else _dc.replace(Seq2SeqConfig.bart_large_cnn(), num_beams=1)
+            else _dc.replace(
+                Seq2SeqConfig.bart_large_cnn(),
+                # route through the plain greedy program: the generation
+                # constraints all live in the beam program, whose compile
+                # at bart-large depth runs minutes on this host
+                num_beams=1,
+                min_length=0,
+                no_repeat_ngram=0,
+            )
         )
         s2s = Seq2SeqEngine(s2s_cfg)
         summ2 = SummarizeEngine(

@@ -169,11 +169,14 @@ class Seq2SeqConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     # beam search; 1 = greedy.  (Of bart-large-cnn's shipped generation
-    # config this implements num_beams/length_penalty/forced_bos_token_id;
-    # min_length, no_repeat_ngram_size, and early_stopping are not
-    # implemented — HF output parity is approximate until they are.)
+    # config this implements num_beams / length_penalty /
+    # forced_bos_token_id / min_length / no_repeat_ngram_size;
+    # early_stopping is not — the loop runs to EOS-or-horizon, which can
+    # only find better hypotheses than stopping early.)
     num_beams: int = 1
     length_penalty: float = 1.0
+    min_length: int = 0  # EOS masked until this many tokens emitted
+    no_repeat_ngram: int = 0  # 0 = off; n bans repeating any n-gram
 
     @staticmethod
     def bart_large_cnn() -> "Seq2SeqConfig":
@@ -189,6 +192,8 @@ class Seq2SeqConfig:
             forced_bos_id=0,
             num_beams=4,
             length_penalty=2.0,
+            min_length=56,
+            no_repeat_ngram=3,
         )
 
 

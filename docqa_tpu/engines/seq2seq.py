@@ -52,20 +52,30 @@ class Seq2SeqEngine:
     def _get_fn(self, max_new: int):
         fn = self._fns.get(max_new)
         if fn is None:
-            if self.cfg.num_beams > 1:
+            cfg = self.cfg
+            # min_length / no_repeat_ngram are implemented in the beam
+            # program; with them set, n_beams=1 routes through it too
+            # (beam-1 is exactly greedy plus the constraints)
+            if (
+                cfg.num_beams > 1
+                or cfg.min_length > 0
+                or cfg.no_repeat_ngram >= 1
+            ):
                 fn = jax.jit(
                     functools.partial(
                         beam_summarize_fn,
-                        cfg=self.cfg,
+                        cfg=cfg,
                         max_new=max_new,
-                        n_beams=self.cfg.num_beams,
-                        length_penalty=self.cfg.length_penalty,
+                        n_beams=cfg.num_beams,
+                        length_penalty=cfg.length_penalty,
+                        min_length=cfg.min_length,
+                        no_repeat_ngram=cfg.no_repeat_ngram,
                     )
                 )
             else:
                 fn = jax.jit(
                     functools.partial(
-                        greedy_summarize_fn, cfg=self.cfg, max_new=max_new
+                        greedy_summarize_fn, cfg=cfg, max_new=max_new
                     )
                 )
             self._fns[max_new] = fn

@@ -329,6 +329,8 @@ def beam_summarize_fn(
     max_new: int,
     n_beams: int,
     length_penalty: float = 1.0,
+    min_length: int = 0,
+    no_repeat_ngram: int = 0,
 ):
     """Beam-search decode as ONE program (bart-large-cnn ships with beam 4;
     greedy under-serves it).  Beams ride the batch axis ([b*B] lanes): the
@@ -363,6 +365,11 @@ def beam_summarize_fn(
         cross_kv, srcl,
     )
     logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    if min_length > 1:  # zero emitted + the start token: ban EOS while
+        # 0 + 1 < min_length (mirrors the in-loop HF-parity condition)
+        logp = jnp.where(
+            (jnp.arange(V) == eos)[None, :], NEG_INF, logp
+        )
     if cfg.forced_bos_id is not None:
         # HF BART generation forces BOS as the first decoded token; all
         # beams share that prefix, so only beam 0 carries weight until the
@@ -407,6 +414,42 @@ def beam_summarize_fn(
         logp = jax.nn.log_softmax(
             logits[:, 0].astype(jnp.float32), axis=-1
         ).reshape(b, B, V)
+        if min_length > 0:
+            # HF parity: MinLengthLogitsProcessor counts the decoder-start
+            # token in cur_len, so EOS unlocks once emit_len + 1 reaches
+            # min_length (a min_length=56 summary may end at 55 emissions)
+            logp = jnp.where(
+                (emit_len + 1 < min_length)[:, :, None]
+                & (jnp.arange(V) == eos)[None, None, :],
+                NEG_INF,
+                logp,
+            )
+        if no_repeat_ngram >= 1 and max_new >= no_repeat_ngram:
+            # (max_new < n can't repeat an n-gram; skipping also keeps the
+            # m-1 history slice within the out axis at trace time)
+            m = no_repeat_ngram
+            if m == 1:  # each token at most once
+                complete = jnp.arange(max_new)[None, None, :] < t
+                ban = jnp.where(complete, out, V)
+            else:
+                W = max_new - m + 1
+                # the m-1 tokens ending at position t-1, per beam
+                last = jax.lax.dynamic_slice_in_dim(
+                    out, jnp.maximum(t - (m - 1), 0), m - 1, axis=2
+                )  # [b, B, m-1]
+                # every historical m-gram window: prefix + follower token
+                win = jnp.stack(
+                    [out[:, :, j : j + W] for j in range(m - 1)], axis=-1
+                )
+                follower = out[:, :, m - 1 : m - 1 + W]
+                match = jnp.all(win == last[:, :, None, :], axis=-1)
+                complete = (jnp.arange(W) + m - 1)[None, None, :] < t
+                ban = jnp.where(
+                    match & complete & (t >= (m - 1)), follower, V
+                )  # V = out of bounds, dropped
+            bb = jnp.broadcast_to(jnp.arange(b)[:, None, None], ban.shape)
+            kk = jnp.broadcast_to(jnp.arange(B)[None, :, None], ban.shape)
+            logp = logp.at[bb, kk, ban].set(NEG_INF, mode="drop")
         cont = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
         total = scores[:, :, None] + cont  # [b, B, V]
         scores_new, idx = jax.lax.top_k(total.reshape(b, B * V), B)

@@ -135,6 +135,70 @@ class TestBeamSearch:
         # greedy: first token IS eos -> zero emissions; beam must agree
         assert int(g_n[0]) == int(n[0]) == 0
 
+    def test_min_length_defers_eos(self, params):
+        # model whose argmax is always EOS: min_length must hold EOS off
+        # until exactly that many tokens are out
+        from docqa_tpu.models.seq2seq import beam_summarize_fn
+
+        p = dict(params)
+        p["final_logits_bias"] = (
+            p["final_logits_bias"].at[CFG.eos_id].set(50.0)
+        )
+        src = jnp.asarray([[5, 9, 11]], jnp.int32)
+        lens = jnp.asarray([3])
+        out, n = beam_summarize_fn(
+            p, CFG, src, lens, max_new=10, n_beams=2, min_length=4
+        )
+        # HF counts the decoder-start token in cur_len: min_length=4
+        # unlocks EOS after 3 emissions
+        assert int(n[0]) == 3
+        toks = np.asarray(out)[0][:3]
+        assert (toks != CFG.eos_id).all()
+
+    def test_no_repeat_unigram_and_tiny_horizon(self, params):
+        from docqa_tpu.models.seq2seq import beam_summarize_fn
+
+        p = dict(params)
+        p["final_logits_bias"] = (
+            p["final_logits_bias"].at[CFG.eos_id].set(-1e9)
+        )
+        src = jnp.asarray([[5, 9, 11]], jnp.int32)
+        lens = jnp.asarray([3])
+        out, n = beam_summarize_fn(
+            p, CFG, src, lens, max_new=6, n_beams=1, no_repeat_ngram=1
+        )
+        toks = [int(x) for x in np.asarray(out)[0][: int(n[0])]]
+        assert len(toks) == len(set(toks)), toks  # every token unique
+        # horizon shorter than the n-gram: must trace and run (the ban
+        # machinery is skipped — nothing can repeat in 1 token)
+        out1, n1 = beam_summarize_fn(
+            p, CFG, src, lens, max_new=1, n_beams=1, no_repeat_ngram=3
+        )
+        assert int(n1[0]) == 1
+
+    def test_no_repeat_ngram_bans_bigram_loop(self, params):
+        # constant-output model loops one token forever; no_repeat=2 must
+        # break the loop at the first would-be repeated bigram
+        from docqa_tpu.models.seq2seq import beam_summarize_fn
+
+        p = dict(params)
+        p = {k: jnp.zeros_like(v) for k, v in p.items()}
+        p["shared_emb"] = jnp.ones_like(params["shared_emb"]) * 0.02
+        lm_bias = np.zeros((CFG.vocab_size,), np.float32)
+        lm_bias[7] = 5.0
+        lm_bias[9] = 4.0  # runner-up
+        lm_bias[CFG.eos_id] = -50.0
+        p["final_logits_bias"] = jnp.asarray(lm_bias)
+        src = jnp.asarray([[5, 9, 11]], jnp.int32)
+        lens = jnp.asarray([3])
+        out, n = beam_summarize_fn(
+            p, CFG, src, lens, max_new=8, n_beams=1, no_repeat_ngram=2
+        )
+        toks = [int(x) for x in np.asarray(out)[0][: int(n[0])]]
+        assert len(toks) == 8
+        bigrams = list(zip(toks, toks[1:]))
+        assert len(bigrams) == len(set(bigrams)), toks  # no repeated bigram
+
     def test_engine_uses_beams_from_config(self, params):
         import dataclasses
 
