@@ -544,33 +544,37 @@ def main() -> None:
                 b7 = ContinuousBatcher(
                     gen8, n_slots=16, chunk=32, cache_len=512
                 )
-                prompts7 = [
-                    [7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(32)
-                ]
-                for h in [
-                    b7.submit_ids(p, max_new_tokens=4)
-                    for p in prompts7[:16]
-                ]:
-                    h.result()  # compile admission + decode at load shapes
-                t0 = time.perf_counter()
-                handles7 = [
-                    b7.submit_ids(p, max_new_tokens=64) for p in prompts7
-                ]
-                for h in handles7:
-                    h.result()
-                wall7 = time.perf_counter() - t0
-                DETAILS["rag_load_7b_int8"] = {
-                    "requests": len(prompts7),
-                    "wall_s": round(wall7, 2),
-                    "sustained_qps": round(len(prompts7) / wall7, 2),
-                    "qps_target": 16,
-                }
-                log(
-                    f"config5b 7B-int8 load: {len(prompts7)} requests in "
-                    f"{wall7:.2f}s = {len(prompts7)/wall7:.1f} QPS"
-                )
-                b7.stop()
-                del b7
+                try:
+                    prompts7 = [
+                        [7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(32)
+                    ]
+                    for h in [
+                        b7.submit_ids(p, max_new_tokens=4)
+                        for p in prompts7[:16]
+                    ]:
+                        h.result()  # compile admission + decode shapes
+                    t0 = time.perf_counter()
+                    handles7 = [
+                        b7.submit_ids(p, max_new_tokens=64) for p in prompts7
+                    ]
+                    for h in handles7:
+                        h.result()
+                    wall7 = time.perf_counter() - t0
+                    DETAILS["rag_load_7b_int8"] = {
+                        "requests": len(prompts7),
+                        "wall_s": round(wall7, 2),
+                        "sustained_qps": round(len(prompts7) / wall7, 2),
+                        "qps_target": 16,
+                    }
+                    log(
+                        f"config5b 7B-int8 load: {len(prompts7)} requests "
+                        f"in {wall7:.2f}s = {len(prompts7)/wall7:.1f} QPS"
+                    )
+                finally:
+                    # stop on EVERY path: a leaked batcher thread holds the
+                    # int8 engine and defeats the del/gc below
+                    b7.stop()
+                    del b7
             except Exception as e:
                 log(f"7B int8 load bench failed: {e!r}")
                 DETAILS["rag_load_7b_int8"] = {"error": repr(e)[:300]}

@@ -28,6 +28,7 @@ import collections
 import functools
 import threading
 from dataclasses import dataclass, field
+from time import monotonic as time_monotonic
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -53,11 +54,22 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     tokens: List[int] = field(default_factory=list)
     error: Optional[BaseException] = None
+    # notified whenever tokens grow or the request finishes (streaming)
+    cv: threading.Condition = field(default_factory=threading.Condition)
 
 
 # One wait policy for every consumer of a Handle (qa /ask, summarize,
 # generate_texts) — change it here, not at call sites.
 DEFAULT_RESULT_TIMEOUT = 600.0
+
+
+def _finish(req: _Request) -> None:
+    """Mark a request terminal and wake streamers — the ONE completion
+    path (done without a cv notify would leave ``iter_tokens`` blocked
+    until its wait timeout)."""
+    req.done.set()
+    with req.cv:
+        req.cv.notify_all()
 
 
 class Handle:
@@ -80,6 +92,37 @@ class Handle:
     ) -> str:
         """Wait and detokenize — the shared resolve path."""
         return tokenizer.decode_ids(self.result(timeout))
+
+    def iter_tokens(self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT):
+        """Stream token ids as decode chunks land (the batcher appends a
+        chunk's worth at a time; each append notifies).  Yields every token
+        exactly once, in order; raises the request's error (or
+        TimeoutError) instead of returning partial output silently."""
+        req = self._req
+        sent = 0
+        deadline = (
+            None if timeout is None else time_monotonic() + timeout
+        )
+        while True:
+            with req.cv:
+                while len(req.tokens) <= sent and not req.done.is_set():
+                    remaining = (
+                        None
+                        if deadline is None
+                        else deadline - time_monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError("generation timed out")
+                    if not req.cv.wait(remaining):
+                        raise TimeoutError("generation timed out")
+                fresh = list(req.tokens[sent:])
+            sent += len(fresh)
+            for t in fresh:
+                yield t
+            if req.done.is_set() and sent >= len(req.tokens):
+                if req.error is not None:
+                    raise req.error
+                return
 
 
 class QueueFull(RuntimeError):
@@ -415,7 +458,7 @@ class ContinuousBatcher:
         for req in list(self._queue) + [r for r in self._slot_req if r]:
             if not req.done.is_set():
                 req.error = RuntimeError("batcher stopped")
-                req.done.set()
+                _finish(req)
 
     @property
     def n_active(self) -> int:
@@ -456,7 +499,7 @@ class ContinuousBatcher:
                 ]
             except (TypeError, ValueError) as e:  # bad request; fail it alone
                 req.error = e
-                req.done.set()
+                _finish(req)
                 continue
             good.append((slot, req, ids))
             longest = max(longest, len(ids))
@@ -531,6 +574,8 @@ class ContinuousBatcher:
                 self._retire(slot)
             else:
                 req.tokens.append(first)
+                with req.cv:  # the first streamed token
+                    req.cv.notify_all()
                 if len(req.tokens) >= budget:
                     alive = False
                     self._retire(slot)
@@ -549,7 +594,7 @@ class ContinuousBatcher:
             req = self._slot_req[slot]
             if req is not None:
                 req.error = RuntimeError(f"decode failed: {err!r}")
-                req.done.set()
+                _finish(req)
                 self._slot_req[slot] = None
         self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
         if self.mesh is not None and self.mesh.n_devices > 1:
@@ -569,7 +614,7 @@ class ContinuousBatcher:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         if req is not None:
-            req.done.set()
+            _finish(req)
             DEFAULT_REGISTRY.counter("serve_completed").inc()
 
     def _run(self) -> None:
@@ -603,7 +648,7 @@ class ContinuousBatcher:
                     for _slot, req in pairs:
                         if not req.done.is_set():
                             req.error = RuntimeError(f"prefill failed: {e!r}")
-                            req.done.set()
+                            _finish(req)
                     self._fail_active(e)
                     continue
             if not any(self._slot_req):
@@ -673,6 +718,7 @@ class ContinuousBatcher:
                 req = self._slot_req[slot]
                 if req is None:
                     continue
+                before = len(req.tokens)
                 for t in range(n_cols):
                     if not valid_h[slot, t]:
                         continue
@@ -680,6 +726,9 @@ class ContinuousBatcher:
                         break
                     req.tokens.append(int(out_h[slot, t]))
                     n_appended += 1
+                if len(req.tokens) > before:  # wake streamers per chunk
+                    with req.cv:
+                        req.cv.notify_all()
                 if (
                     not active_h[slot]
                     or len(req.tokens) >= self._slot_budget[slot]

@@ -502,27 +502,108 @@ def make_app(rt: DocQARuntime):
 
     # ---- QA -----------------------------------------------------------------
 
-    async def ask(req):
+    async def _ask_preamble(req):
+        """Shared /ask admission: parse → 422, empty index → 503, submit
+        on the device lane → QueueFull 503.  Returns (pending, None) or
+        (None, error-response) so both the blocking and streaming handlers
+        admit identically."""
         try:
             q = Query(**await req.json())
         except Exception as e:
-            return json_error(422, str(e))
+            return None, json_error(422, str(e))
         if rt.store.count == 0:
             # parity: llm-qa returns 503 when its index is unavailable
             # (main.py:113-114) — ours can only be *empty*, never missing
-            return json_error(503, "index is empty; ingest documents first")
-        # retrieval + submission on the device lane; decode wait on the gen
-        # lane so N concurrent /ask share batcher slots (≈ solo latency)
-        t0 = time.perf_counter()
+            return None, json_error(
+                503, "index is empty; ingest documents first"
+            )
         try:
             pending = await on_device(rt.qa.ask_submit, q.question)
         except QueueFull as e:
-            return json_error(503, str(e))
+            return None, json_error(503, str(e))
+        return pending, None
+
+    async def ask(req):
+        # retrieval + submission on the device lane; decode wait on the gen
+        # lane so N concurrent /ask share batcher slots (≈ solo latency)
+        t0 = time.perf_counter()
+        pending, err = await _ask_preamble(req)
+        if err is not None:
+            return err
         result = await on_gen(pending.resolve)
         DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
             (time.perf_counter() - t0) * 1000
         )
         return web.json_response(result)
+
+    async def ask_stream(req):
+        """Server-sent-events variant of /ask/: token deltas as they
+        decode, then one final event with the sources.  (The reference
+        couldn't stream — generation lived in an external Ollama process
+        behind a blocking LangChain call.)"""
+        import threading as _threading
+
+        t0 = time.perf_counter()
+        pending, err = await _ask_preamble(req)
+        if err is not None:
+            return err
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(req)
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        gone = _threading.Event()  # client disconnected: stop pumping
+
+        def pump():
+            try:
+                for delta in pending.iter_text():
+                    if gone.is_set():
+                        return  # free the gen_pool thread; the batcher
+                        # slot retires on its own budget/EOS
+                    loop.call_soon_threadsafe(queue.put_nowait, ("d", delta))
+                loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
+            except BaseException as e:  # surfaced as an SSE error event
+                loop.call_soon_threadsafe(queue.put_nowait, ("err", str(e)))
+
+        fut = loop.run_in_executor(gen_pool, pump)
+        try:
+            while True:
+                kind, payload = await queue.get()
+                if kind == "d":
+                    await resp.write(
+                        b"data: " + json.dumps({"delta": payload}).encode()
+                        + b"\n\n"
+                    )
+                elif kind == "err":
+                    await resp.write(
+                        b"event: error\ndata: "
+                        + json.dumps({"detail": payload}).encode() + b"\n\n"
+                    )
+                    break
+                else:
+                    await resp.write(
+                        b"event: done\ndata: "
+                        + json.dumps({"sources": pending.sources}).encode()
+                        + b"\n\n"
+                    )
+                    break
+        finally:
+            # release the pump on every exit (incl. client disconnect /
+            # task cancel): it checks `gone` between deltas and returns,
+            # freeing its gen_pool thread within one decode chunk — NOT
+            # awaited here, because awaiting from a cancelled task would
+            # just re-raise and the pump cleans itself up regardless
+            gone.set()
+            del fut
+            DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
+                (time.perf_counter() - t0) * 1000
+            )
+        await resp.write_eof()
+        return resp
 
     async def patient_snippets(req):
         pid = req.query.get("patient_id")
@@ -621,6 +702,7 @@ def make_app(rt: DocQARuntime):
             web.get("/documents/{doc_id}", document_one),
             web.delete("/documents/{doc_id}", document_delete),
             web.post("/ask/", ask),
+            web.post("/ask/stream", ask_stream),
             web.get("/api/search/patient-snippets", patient_snippets),
             web.post("/api/llm/summarize", llm_summarize),
             web.post("/api/synthese/patient", synthese_patient),

@@ -56,6 +56,25 @@ class PendingAnswer:
             answer = self.handle.text(self.tokenizer, timeout)
         return {"answer": answer, "sources": self.sources}
 
+    def iter_text(self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT):
+        """Yield answer text incrementally as decode chunks land (SSE
+        backing).  Fake/inline answers yield once; batched answers stream
+        text DELTAS of the cumulative detokenization — per-token decoding
+        would mis-render wordpiece merges and skipped specials, so the
+        concatenated stream must equal ``resolve()``'s answer exactly by
+        construction."""
+        if self.answer is not None:
+            yield self.answer
+            return
+        ids: list = []
+        emitted = 0
+        for tok in self.handle.iter_tokens(timeout):
+            ids.append(tok)
+            decoded = self.tokenizer.decode_ids(ids)
+            if len(decoded) > emitted:
+                yield decoded[emitted:]
+                emitted = len(decoded)
+
 
 class QAService:
     def __init__(

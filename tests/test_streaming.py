@@ -1,0 +1,136 @@
+"""Token streaming: Handle.iter_tokens must reproduce result() exactly
+(order, completeness, errors), PendingAnswer.iter_text must concatenate to
+resolve()'s answer byte-for-byte, and the SSE endpoint must stream deltas
+plus a final sources event."""
+
+import asyncio
+import json
+
+import pytest
+
+from docqa_tpu.config import DecoderConfig, GenerateConfig, load_config
+from docqa_tpu.engines.generate import GenerateEngine
+from docqa_tpu.engines.serve import ContinuousBatcher
+
+CFG = DecoderConfig(
+    vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=256,
+    dtype="float32",
+)
+GEN = GenerateConfig(temperature=0.0, prefill_buckets=(16,), eos_id=2)
+
+
+@pytest.fixture(scope="module")
+def batcher():
+    b = ContinuousBatcher(
+        GenerateEngine(CFG, GEN, seed=7), n_slots=2, chunk=4, cache_len=128
+    )
+    yield b
+    b.stop()
+
+
+class TestHandleStreaming:
+    def test_iter_tokens_equals_result(self, batcher):
+        h1 = batcher.submit_ids([3, 5, 9], max_new_tokens=11)
+        h2 = batcher.submit_ids([3, 5, 9], max_new_tokens=11)
+        streamed = list(h1.iter_tokens(timeout=300))
+        assert streamed == h2.result(timeout=300)
+
+    def test_iter_text_concatenates_to_resolve(self, batcher):
+        from docqa_tpu.service.qa import PendingAnswer
+
+        h1 = batcher.submit_ids([4, 7], max_new_tokens=9)
+        h2 = batcher.submit_ids([4, 7], max_new_tokens=9)
+        tok = batcher.engine.tokenizer
+        p1 = PendingAnswer(sources=["s"], handle=h1, tokenizer=tok)
+        p2 = PendingAnswer(sources=["s"], handle=h2, tokenizer=tok)
+        assert "".join(p1.iter_text(timeout=300)) == p2.resolve(300)["answer"]
+
+    def test_stream_surfaces_stop_error(self):
+        b = ContinuousBatcher(
+            GenerateEngine(CFG, GEN, seed=7), n_slots=2, chunk=4,
+            cache_len=128,
+        )
+        h = b.submit_ids([3, 5], max_new_tokens=50)
+        b.stop()
+        with pytest.raises(RuntimeError):
+            list(h.iter_tokens(timeout=30))
+
+
+TINY = {
+    "encoder.hidden_dim": 64,
+    "encoder.num_layers": 1,
+    "encoder.num_heads": 4,
+    "encoder.mlp_dim": 128,
+    "encoder.embed_dim": 64,
+    "store.dim": 64,
+    "store.shard_capacity": 256,
+    "ner.train_steps": 0,
+    "decoder.hidden_dim": 64,
+    "decoder.num_layers": 2,
+    "decoder.num_heads": 8,
+    "decoder.num_kv_heads": 8,
+    "decoder.head_dim": 8,
+    "decoder.mlp_dim": 128,
+    "decoder.vocab_size": 512,
+    "decoder.max_seq_len": 512,
+    "decoder.dtype": "float32",
+    "generate.max_new_tokens": 10,
+    "generate.max_concurrent": 2,
+    "generate.prefill_buckets": (64, 128),
+    "flags.use_fake_encoder": True,
+}
+
+
+class TestSSEEndpoint:
+    def test_stream_deltas_then_sources(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from docqa_tpu.service.app import DocQARuntime, make_app
+
+        cfg = load_config(env={}, overrides=dict(TINY))
+        rt = DocQARuntime(cfg).start()
+        rec = rt.pipeline.ingest_document(
+            "a.txt", b"Aspirin 100 mg daily.", patient_id="p1"
+        )
+        assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+
+        async def drive():
+            client = TestClient(TestServer(make_app(rt)))
+            await client.start_server()
+            try:
+                expect = (await (await client.post(
+                    "/ask/", json={"question": "aspirin dose?"}
+                )).json())["answer"]
+                resp = await client.post(
+                    "/ask/stream", json={"question": "aspirin dose?"}
+                )
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/event-stream"
+                )
+                raw = (await resp.read()).decode()
+                deltas, sources, n_events = [], None, 0
+                for block in raw.strip().split("\n\n"):
+                    lines = dict(
+                        line.split(": ", 1)
+                        for line in block.splitlines()
+                        if ": " in line
+                    )
+                    body = json.loads(lines["data"])
+                    n_events += 1
+                    if "delta" in body:
+                        deltas.append(body["delta"])
+                    else:
+                        sources = body["sources"]
+                return expect, "".join(deltas), sources, n_events
+            finally:
+                await client.close()
+
+        expect, streamed, sources, n_events = asyncio.new_event_loop().run_until_complete(
+            drive()
+        )
+        rt.stop()
+        assert streamed == expect
+        assert sources  # the final done event carried them
+        assert n_events >= 3  # actually incremental, not one blob
