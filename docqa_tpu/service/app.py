@@ -409,19 +409,20 @@ def make_app(rt: DocQARuntime):
         return web.json_response({"status": "ok"})
 
     async def api_status(_req):
+        queues = (rt.cfg.broker.raw_queue, rt.cfg.broker.clean_queue)
         return web.json_response(
             {
                 "service": "docqa-tpu",
                 "status": "running",
                 "indexed_vectors": rt.store.count,
                 "index_version": rt.store.version,
-                "queue_depths": {
-                    rt.cfg.broker.raw_queue: rt.broker.depth(
-                        rt.cfg.broker.raw_queue
-                    ),
-                    rt.cfg.broker.clean_queue: rt.broker.depth(
-                        rt.cfg.broker.clean_queue
-                    ),
+                "queue_depths": {q: rt.broker.depth(q) for q in queues},
+                # pipeline health at a glance: messages being processed and
+                # poison messages parked in the DLQ (the reference DROPPED
+                # poison messages, anonymizer.py:83-87)
+                "in_flight": {q: rt.broker.in_flight(q) for q in queues},
+                "dead_letters": {
+                    q: len(rt.broker.dead_letters(q)) for q in queues
                 },
             }
         )
