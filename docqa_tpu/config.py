@@ -233,6 +233,10 @@ class StoreConfig:
     ivf_nprobe: int = 48  # with n_assign=2 cells: recall@10 ≈ 0.96 measured
     ivf_min_rows: int = 50_000  # below this the IVF tier stays off
     ivf_rebuild_tail: int = 100_000  # rebuild when the tail outgrows this
+    # auto-compaction: once this fraction of live+dead rows is tombstoned,
+    # deletions trigger a compaction (tombstones cost a mask upload per
+    # search and dilute IVF cells); 0 disables
+    compact_threshold: float = 0.25
 
 
 @dataclass(frozen=True)

@@ -240,6 +240,12 @@ class VectorStore:
         return self._count
 
     @property
+    def deleted_count(self) -> int:
+        """Tombstoned rows still occupying buffer slots (0 after
+        ``compact_deleted``)."""
+        return self._n_deleted
+
+    @property
     def version(self) -> int:
         return self._version
 

@@ -323,8 +323,15 @@ class DocQARuntime:
         # looked: suppression wins regardless of pipeline position
         self.pipeline.suppress_doc(doc_id)
         n = self.store.delete_docs([doc_id])
+        threshold = self.cfg.store.compact_threshold
+        auto = (
+            not erase
+            and threshold > 0
+            and self.store.count > 0
+            and self.store.deleted_count >= threshold * self.store.count
+        )
         compacted = 0
-        if erase:
+        if erase or auto:
             compacted = self.store.compact_deleted()
             if compacted and self.search_index is not self.store and hasattr(
                 self.search_index, "reset"

@@ -224,6 +224,48 @@ class TestServiceDelete:
         finally:
             rt.stop()
 
+    def test_auto_compaction_at_threshold(self, tmp_path):
+        """Plain (non-erase) deletions compact automatically once
+        tombstones reach compact_threshold of the corpus."""
+        from docqa_tpu.service.app import DocQARuntime
+
+        cfg = load_config(
+            env={},
+            overrides={
+                "ner.train_steps": 0,
+                "flags.use_fake_encoder": True,
+                "flags.use_fake_llm": True,
+                "decoder.hidden_dim": 32,
+                "decoder.num_layers": 1,
+                "decoder.num_heads": 4,
+                "decoder.num_kv_heads": 4,
+                "decoder.head_dim": 8,
+                "decoder.mlp_dim": 64,
+                "decoder.vocab_size": 256,
+                "store.shard_capacity": 128,
+                "store.compact_threshold": 0.4,
+                "data.bootstrap_dir": None,
+            },
+        )
+        rt = DocQARuntime(cfg).start()
+        try:
+            recs = [
+                rt.pipeline.ingest_document(
+                    f"{i}.txt", f"Note {i} stable vitals.".encode(),
+                    patient_id=f"q{i}",
+                )
+                for i in range(4)
+            ]
+            for r in recs:
+                assert rt.pipeline.wait_indexed(r.doc_id, timeout=60)
+            rt.delete_document(recs[0].doc_id)  # 1/4 < 0.4: tombstone only
+            assert rt.store.deleted_count == 1
+            rt.delete_document(recs[1].doc_id)  # 2/4 >= 0.4: auto-compacts
+            assert rt.store.deleted_count == 0
+            assert rt.store.count == 2
+        finally:
+            rt.stop()
+
         # deletion survives restart (the snapshot carried the compaction)
         rt2 = DocQARuntime(cfg).start()
         try:
