@@ -81,7 +81,36 @@ def param_bytes(params) -> int:
     return int(sum(np.prod(p.shape) * p.dtype.itemsize for p in params.values()))
 
 
+def _device_backend_alive(timeout_s: float = 150.0) -> bool:
+    """Probe the accelerator from a SUBPROCESS: a dead tunnel hangs
+    ``jax.devices()`` indefinitely, and an in-process hang would eat the
+    driver's whole bench budget with no JSON line to show for it."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        r = subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
 def main() -> None:
+    if not _device_backend_alive():
+        # degrade honestly: a CPU smoke run labeled as such beats a hang
+        log(
+            "accelerator backend unreachable (tunnel down?) — "
+            "falling back to the CPU smoke configuration"
+        )
+        os.environ["DOCQA_BENCH_SMALL"] = "1"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     import jax
 
     backend = jax.default_backend()
