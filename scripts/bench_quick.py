@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Quick serving measurement: the two headline numbers in ~5 minutes.
+
+The full ``bench.py`` matrix takes ~20 min (1M-corpus ingest, IVF build,
+7B sections).  This measures just e2e QA p50 (int8 serving default,
+fused retrieval) and sustained QPS through the batcher at a 200k-chunk
+corpus — enough to validate a serving change on hardware fast, or to
+salvage numbers from a short tunnel window.
+
+    python scripts/bench_quick.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from docqa_tpu.config import (
+        DecoderConfig, EncoderConfig, GenerateConfig, StoreConfig,
+    )
+    from docqa_tpu.engines.encoder import EncoderEngine
+    from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.engines.retrieve import FusedRetriever
+    from docqa_tpu.engines.serve import ContinuousBatcher
+    from docqa_tpu.index.store import VectorStore
+
+    print("backend:", jax.default_backend(), flush=True)
+    dec_cfg = DecoderConfig(
+        vocab_size=32000, hidden_dim=2048, num_layers=16, num_heads=16,
+        num_kv_heads=8, head_dim=128, mlp_dim=5632, max_seq_len=4096,
+        quantize_weights=True,
+    )
+    n_chunks, max_new = 200_000, 64
+
+    rng = np.random.default_rng(0)
+    encoder = EncoderEngine(EncoderConfig())
+    store = VectorStore(StoreConfig(shard_capacity=n_chunks))
+    t0 = time.perf_counter()
+    for start in range(0, n_chunks, 65536):
+        n = min(65536, n_chunks - start)
+        v = rng.standard_normal((n, 384)).astype(np.float32)
+        store.add(v, [{"doc_id": f"d{i}", "source": f"c{i}"} for i in
+                      range(start, start + n)])
+    print(f"corpus {n_chunks} in {time.perf_counter()-t0:.1f}s", flush=True)
+    retr = FusedRetriever(encoder, store)
+    gen = GenerateEngine(dec_cfg, GenerateConfig())
+
+    def ask(q):
+        hits = retr.search_texts([q], k=3)[0]
+        ctx = "\n".join(h.metadata["source"] for h in hits)
+        gen.generate_texts(
+            [f"Context:\n{ctx}\n\nQ: {q}\nA:"], max_new_tokens=max_new
+        )
+
+    qs = [f"question {i} about treatment?" for i in range(12)]
+    for q in qs[:2]:
+        ask(q)  # compile
+    lat = []
+    for q in qs[2:]:
+        t0 = time.perf_counter()
+        ask(q)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    print(
+        f"e2e int8+fused: p50 {np.percentile(lat, 50):.1f}ms "
+        f"p95 {np.percentile(lat, 95):.1f}ms", flush=True,
+    )
+    t_f = min(
+        (lambda t0=time.perf_counter(): (retr.search_texts([qs[0]], k=3),
+                                         time.perf_counter() - t0)[1])()
+        for _ in range(5)
+    )
+    print(f"fused retrieval: {t_f*1e3:.1f}ms", flush=True)
+
+    b = ContinuousBatcher(gen, n_slots=16, chunk=32, cache_len=1024)
+    try:
+        prompts = [[7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(64)]
+        for h in [b.submit_ids(p, max_new_tokens=4) for p in prompts[:16]]:
+            h.result()
+        t0 = time.perf_counter()
+        hs = [b.submit_ids(p, max_new_tokens=max_new) for p in prompts]
+        for h in hs:
+            h.result()
+        wall = time.perf_counter() - t0
+        print(f"QPS: {len(prompts)} req in {wall:.2f}s = "
+              f"{len(prompts)/wall:.1f} (target 16)", flush=True)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()
