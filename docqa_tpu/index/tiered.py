@@ -162,33 +162,48 @@ class TieredIndex:
         queries = np.asarray(queries, np.float32)
         if queries.ndim == 1:
             queries = queries[None]
+        # tombstoned rows are filtered host-side AFTER top-k; without
+        # headroom a query between rebuilds could return fewer than k live
+        # results even when enough exist in the tier.  The over-fetch is
+        # QUANTIZED to {k, 2k, 4k} — a continuously varying fetch would
+        # recompile the probe/tail kernels on every deletion (both are
+        # jit-specialized on k) — and backstopped by an exact-search
+        # fallback below for the correlated case (deleting one document
+        # tombstones mutually-similar chunks that cluster at the top of
+        # the ranking for related queries, which no fraction-based
+        # headroom can bound).
+        deleted_frac = self.store.deleted_count / max(self.store.count, 1)
+        if deleted_frac == 0:
+            k_bulk = k
+        elif deleted_frac <= 0.25:
+            k_bulk = min(covered, 2 * k)
+        else:
+            k_bulk = min(covered, 4 * k)
         with span("tiered_search", DEFAULT_REGISTRY):
-            bulk = ivf.search(queries, k=k, nprobe=self.nprobe)
+            bulk = ivf.search(queries, k=k_bulk, nprobe=self.nprobe)
 
             _, _, tail_dev, n_live, tail_meta = self._tail_device(covered)
             if n_live == 0:
-                return [
-                    [
-                        SearchResult(s, rid, md)
-                        for s, rid, md in row
-                        if not md.get("deleted")
-                    ][:k]
-                    for row in bulk
-                ]
-            qn = queries / np.maximum(
-                np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
-            )
-            k_tail = min(k, n_live)
-            vals, ids = _tail_kernel(
-                tail_dev,
-                jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
-                jnp.int32(n_live),
-                k_tail,
-            )
-            vals = np.asarray(vals, np.float32)
-            ids = np.asarray(ids)
+                # empty tail: bulk-only, but still through the merge loop
+                # below so the under-fill fallback applies
+                vals = np.empty((len(queries), 0), np.float32)
+                ids = np.empty((len(queries), 0), np.int32)
+            else:
+                qn = queries / np.maximum(
+                    np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+                )
+                k_tail = min(k_bulk, n_live)  # same tombstone headroom as bulk
+                vals, ids = _tail_kernel(
+                    tail_dev,
+                    jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
+                    jnp.int32(n_live),
+                    k_tail,
+                )
+                vals = np.asarray(vals, np.float32)
+                ids = np.asarray(ids)
 
         out: List[List[SearchResult]] = []
+        short: List[int] = []
         for qi in range(len(queries)):
             # tombstoned rows are filtered here between rebuilds (the IVF
             # tier still physically holds them); compaction + reset() is
@@ -207,6 +222,18 @@ class TieredIndex:
                 cands.append(SearchResult(float(s), covered + int(tid), md))
             cands.sort(key=lambda r: -r.score)
             out.append(cands[:k])
+            if len(cands) < k:
+                short.append(qi)
+        if short and (self.store.count - self.store.deleted_count) > 0:
+            # under-filled despite the head-room: tombstones clustered at
+            # the top of this query's ranking (e.g. a just-deleted document
+            # whose chunks all match).  Exact tombstone-masked search is
+            # always correct; this path is rare and vanishes at the next
+            # compaction/rebuild.
+            exact = self.store.search(queries[short], k=k)
+            for j, qi in enumerate(short):
+                if len(exact[j]) > len(out[qi]):
+                    out[qi] = exact[j]
         return out
 
     def reset(self) -> None:

@@ -300,7 +300,7 @@ class Consumer(threading.Thread):
 
     def stop(self, join: bool = True) -> None:
         self._stopped.set()
-        if join:
+        if join and self.is_alive():  # stop() before start() is a no-op
             self.join(timeout=5)
 
     def _nack(self, delivery: Delivery) -> None:

@@ -20,8 +20,11 @@ Completion is *observable*: the registry reaches INDEXED with a chunk count
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from docqa_tpu.config import Config
 from docqa_tpu.service import registry as reg
@@ -66,8 +69,15 @@ class DocumentPipeline:
         }
         # docs deleted while still in flight: the index worker must drop
         # their messages instead of indexing a document the user already
-        # erased (and must NOT mark them INDEXED)
+        # erased (and must NOT mark them INDEXED).  The lock closes the
+        # batch-start-to-store.add window: encode_texts can take seconds,
+        # and a DELETE landing inside it would tombstone nothing (rows not
+        # yet added) while the worker then adds the chunks anyway.  Both
+        # suppress_doc and the worker's add/status critical sections take
+        # it, so either the suppression lands before the add (chunks are
+        # dropped) or the add completes first (delete_docs tombstones them).
         self._suppressed_doc_ids: set = set()
+        self._suppress_lock = threading.Lock()
         self._consumers = [
             Consumer(
                 broker,
@@ -94,8 +104,12 @@ class DocumentPipeline:
     def suppress_doc(self, doc_id: str) -> None:
         """Never index this document, even if its pipeline message is still
         queued or replays later — the deletion path calls this so a DELETE
-        racing the async pipeline cannot resurrect the document."""
-        self._suppressed_doc_ids.add(doc_id)
+        racing the async pipeline cannot resurrect the document.  Blocks
+        while an index-worker batch is inside its add/status critical
+        section: on return, the doc's chunks are either dropped or already
+        in the store where the caller's ``delete_docs`` will find them."""
+        with self._suppress_lock:
+            self._suppressed_doc_ids.add(doc_id)
 
     # ---- lifecycle -----------------------------------------------------------
 
@@ -189,6 +203,16 @@ class DocumentPipeline:
         per_doc: List[tuple] = []
         replayed: List[str] = []
         for body in bodies:
+            # Durable suppression: the in-memory suppressed set dies with
+            # the process, but a DELETE writes reg.DELETED to the registry
+            # (SQLite/Postgres) — so a message replayed from the broker
+            # journal after a restart still cannot resurrect an erased
+            # document, and a tombstoned-but-uncompacted doc's replay
+            # cannot flip its status back to INDEXED.
+            record = self.registry.get(body["doc_id"])
+            if record is not None and record.status == reg.DELETED:
+                log.info("dropping deleted doc %s (registry)", body["doc_id"])
+                continue
             if body["doc_id"] in self._suppressed_doc_ids:
                 log.info("dropping deleted in-flight doc %s", body["doc_id"])
                 continue
@@ -226,8 +250,31 @@ class DocumentPipeline:
                 # append is all-or-nothing) leaves no partial state, so the
                 # Consumer's individual retry cannot duplicate vectors
                 embeddings = self.encoder.encode_texts(all_chunks)
-                self.store.add(embeddings, all_meta)
-            self._indexed_doc_ids.update(d for d, _n in per_doc)
+                with self._suppress_lock:
+                    # a DELETE may have landed during the (seconds-long)
+                    # encode; drop those docs' rows now, while suppress_doc
+                    # is excluded — past this block, added rows are visible
+                    # to the deleter's delete_docs
+                    late = {
+                        d for d, _n in per_doc if d in self._suppressed_doc_ids
+                    }
+                    if late:
+                        keep = [
+                            i
+                            for i, md in enumerate(all_meta)
+                            if md["doc_id"] not in late
+                        ]
+                        embeddings = np.asarray(embeddings)[keep]
+                        all_meta = [all_meta[i] for i in keep]
+                        per_doc = [
+                            (d, n) for d, n in per_doc if d not in late
+                        ]
+                        log.info(
+                            "dropped %d doc(s) deleted mid-encode", len(late)
+                        )
+                    if all_meta:
+                        self.store.add(embeddings, all_meta)
+                    self._indexed_doc_ids.update(d for d, _n in per_doc)
         # vectors are committed past this point: never raise (a retry would
         # re-encode and re-append the whole batch)
         if self.on_indexed is not None and per_doc:
@@ -239,15 +286,29 @@ class DocumentPipeline:
                 log.exception("on_indexed hook failed")
         for doc_id, n in per_doc:
             try:
-                self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
+                with self._suppress_lock:
+                    # a DELETE between store.add and here already wrote (or
+                    # is about to write) DELETED; an INDEXED overwrite would
+                    # advertise a doc whose vectors are tombstoned
+                    if doc_id in self._suppressed_doc_ids:
+                        continue
+                    self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
             except Exception:
                 log.exception("status write failed for %s", doc_id)
         for doc_id in replayed:
             # the crash the replay recovers from may have hit between the
             # snapshot and the status write — make the registry agree with
-            # the vectors it already has (idempotent overwrite)
+            # the vectors it already has (idempotent overwrite).  Same
+            # guard as the per_doc loop: a DELETE that landed while this
+            # batch was in the encoder must not be overwritten by INDEXED.
             try:
-                self.registry.set_status(doc_id, reg.INDEXED)
+                with self._suppress_lock:
+                    if doc_id in self._suppressed_doc_ids:
+                        continue
+                    record = self.registry.get(doc_id)
+                    if record is not None and record.status == reg.DELETED:
+                        continue
+                    self.registry.set_status(doc_id, reg.INDEXED)
             except Exception:
                 log.exception("status write failed for %s", doc_id)
 

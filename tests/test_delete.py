@@ -186,6 +186,199 @@ class TestErasureEdges:
             rt.stop()
 
 
+class TestDeletionRaces:
+    """Regression tests for the advisor's round-2 findings: deletions that
+    race the async pipeline or a process restart must stick."""
+
+    def _runtime(self, tmp_path=None):
+        from docqa_tpu.service.app import DocQARuntime
+
+        overrides = {
+            "ner.train_steps": 0,
+            "flags.use_fake_encoder": True,
+            "flags.use_fake_llm": True,
+            "decoder.hidden_dim": 32,
+            "decoder.num_layers": 1,
+            "decoder.num_heads": 4,
+            "decoder.num_kv_heads": 4,
+            "decoder.head_dim": 8,
+            "decoder.mlp_dim": 64,
+            "decoder.vocab_size": 256,
+            "store.shard_capacity": 128,
+            "store.compact_threshold": 0.0,  # keep tombstones visible
+            "data.bootstrap_dir": None,
+        }
+        if tmp_path is not None:
+            overrides["data.work_dir"] = str(tmp_path)
+        cfg = load_config(env={}, overrides=overrides)
+        return DocQARuntime(cfg)
+
+    def test_delete_during_encode_cannot_resurrect(self):
+        """A DELETE landing while the index worker is inside encode_texts
+        (a seconds-long window in production) must still drop the doc's
+        chunks: the worker re-checks suppression under the shared lock
+        right before store.add."""
+        rt = self._runtime()
+        try:
+            rec = rt.pipeline.ingest_document(
+                "a.txt", b"Lisinopril 10mg for hypertension.",
+                patient_id="p3",
+            )
+            count_before = rt.store.count
+            orig = rt.pipeline.encoder
+            state = {"fired": False}
+
+            class RacingEncoder:
+                def encode_texts(self, texts):
+                    embs = orig.encode_texts(texts)
+                    if not state["fired"]:
+                        state["fired"] = True
+                        # the DELETE arrives after encode, before store.add
+                        rt.delete_document(rec.doc_id)
+                    return embs
+
+            rt.pipeline.encoder = RacingEncoder()
+            rt.pipeline.start()
+            import time as _t
+
+            # queue depth drops while the message is still in flight inside
+            # the workers, so wait on the observable outcome instead
+            deadline = _t.monotonic() + 60
+            while not state["fired"] and _t.monotonic() < deadline:
+                _t.sleep(0.05)
+            _t.sleep(0.5)  # let the index worker finish its batch
+            assert state["fired"]
+            assert rt.store.count == count_before  # chunks dropped
+            assert rt.registry.get(rec.doc_id).status == "DELETED"
+            assert rt.qa.patient_snippets("p3") == []
+        finally:
+            rt.stop()
+
+    def test_erasure_survives_restart_replay(self):
+        """The in-memory suppressed set dies with the process; the registry
+        DELETED row is the durable record.  A message replayed after a
+        restart must be dropped on its account."""
+        from docqa_tpu.service import registry as reg
+
+        rt = self._runtime()
+        try:
+            rec = rt.pipeline.ingest_document(
+                "b.txt", b"Warfarin 5mg, INR monitored.", patient_id="p4"
+            )
+            # delete while the message is still queued, then simulate the
+            # restart by clearing the in-memory suppression (a new process
+            # starts with an empty set)
+            rt.delete_document(rec.doc_id, erase=True)
+            rt.pipeline._suppressed_doc_ids.clear()
+            body = {
+                "doc_id": rec.doc_id,
+                "original_text_masked": "Warfarin 5mg, INR monitored.",
+                "metadata": {"patient_id": "p4", "filename": "b.txt"},
+                "processed_at": 0.0,
+            }
+            count_before = rt.store.count
+            rt.pipeline._index_handler([body])  # the journal replay
+            assert rt.store.count == count_before
+            assert rt.registry.get(rec.doc_id).status == reg.DELETED
+        finally:
+            rt.stop()
+
+    def test_replay_does_not_flip_deleted_to_indexed(self):
+        """A tombstoned-but-uncompacted doc is still in metadata_rows(), so
+        its replayed message lands in the already-indexed path — which must
+        NOT overwrite the DELETED status with INDEXED."""
+        from docqa_tpu.service import registry as reg
+        from docqa_tpu.service.pipeline import DocumentPipeline
+
+        rt = self._runtime()
+        rt.pipeline.start()
+        try:
+            rec = rt.pipeline.ingest_document(
+                "c.txt", b"Atorvastatin 20mg nightly.", patient_id="p5"
+            )
+            assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+            rt.delete_document(rec.doc_id)  # tombstone, no compaction
+            assert rt.store.deleted_count >= 1
+            # a fresh pipeline (as after restart) seeds _indexed_doc_ids
+            # from the store, which still physically holds the rows
+            fresh = DocumentPipeline(
+                rt.cfg, rt.broker, rt.registry, rt.pipeline.deid,
+                rt.pipeline.encoder, rt.store,
+            )
+            assert rec.doc_id in fresh._indexed_doc_ids
+            body = {
+                "doc_id": rec.doc_id,
+                "original_text_masked": "Atorvastatin 20mg nightly.",
+                "metadata": {"patient_id": "p5", "filename": "c.txt"},
+                "processed_at": 0.0,
+            }
+            fresh._index_handler([body])
+            assert rt.registry.get(rec.doc_id).status == reg.DELETED
+        finally:
+            rt.stop()
+
+
+class TestTieredOverfetch:
+    def test_k_live_results_despite_tombstones(self):
+        """Between rebuilds the IVF tier physically holds tombstoned rows
+        and filters them host-side after top-k; the fetch must over-fetch
+        by the deleted fraction so k live results still come back."""
+        from docqa_tpu.index.tiered import TieredIndex
+
+        dim, n = 16, 32
+        q = np.zeros(dim, np.float32)
+        q[0] = 1.0
+        u = np.zeros(dim, np.float32)
+        u[1] = 1.0
+        # deterministic ranking: row i scores cos(theta_i), decreasing in i
+        thetas = np.linspace(0.05, 1.2, n)
+        vecs = (
+            np.cos(thetas)[:, None] * q[None] + np.sin(thetas)[:, None] * u[None]
+        ).astype(np.float32)
+        store = VectorStore(StoreConfig(dim=dim, shard_capacity=64))
+        store.add(vecs, [{"doc_id": f"d{i}", "source": f"s{i}"} for i in range(n)])
+        tiered = TieredIndex(store, min_rows=8, n_clusters=2, nprobe=2)
+        assert tiered.rebuild()
+        # tombstone every even-ranked row: half the top-k raw candidates
+        store.delete_docs([f"d{i}" for i in range(0, n, 2)])
+        rows = tiered.search(q[None], k=8)[0]
+        assert len(rows) == 8  # not fewer, despite 50% tombstones
+        assert all(not r.metadata.get("deleted") for r in rows)
+        assert all(int(r.metadata["doc_id"][1:]) % 2 == 1 for r in rows)
+
+    def test_correlated_deletion_falls_back_to_exact(self):
+        """Deleting one document tombstones mutually-similar chunks that
+        monopolize the top of the ranking for related queries — no
+        fraction-based headroom covers that, so an under-filled query must
+        fall back to exact tombstone-masked search."""
+        from docqa_tpu.index.tiered import TieredIndex
+
+        dim, n = 16, 64
+        q = np.zeros(dim, np.float32)
+        q[0] = 1.0
+        u = np.zeros(dim, np.float32)
+        u[1] = 1.0
+        thetas = np.concatenate(
+            [np.linspace(0.01, 0.1, 16), np.linspace(0.8, 1.4, n - 16)]
+        )
+        vecs = (
+            np.cos(thetas)[:, None] * q[None] + np.sin(thetas)[:, None] * u[None]
+        ).astype(np.float32)
+        store = VectorStore(StoreConfig(dim=dim, shard_capacity=128))
+        # the first 16 rows (the entire top of the ranking) are ONE doc
+        metas = [
+            {"doc_id": "hot" if i < 16 else f"d{i}", "source": f"s{i}"}
+            for i in range(n)
+        ]
+        store.add(vecs, metas)
+        tiered = TieredIndex(store, min_rows=8, n_clusters=2, nprobe=2)
+        assert tiered.rebuild()
+        store.delete_docs(["hot"])  # 25% deleted, all of them ranked top
+        rows = tiered.search(q[None], k=8)[0]
+        assert len(rows) == 8  # exact fallback fills the quota
+        assert all(r.metadata["doc_id"] != "hot" for r in rows)
+
+
 class TestServiceDelete:
     def test_runtime_delete_document(self, tmp_path):
         from docqa_tpu.service.app import DocQARuntime
