@@ -336,48 +336,83 @@ def main() -> None:
         "new_tokens": max_new,
         "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8",
     }
+    DETAILS["headline_config"] = "qa_e2e"  # upgraded to 7B-int8 below
     measure_decode(gen, "decode_1b_int8", "config3a int8")
 
     # ---- config 5: sustained QPS through the continuous batcher -------------
-    try:
+    def run_load(engine, n_slots, chunk, n_req, cache_len):
+        """One load measurement: n_req concurrent requests, max_new tokens
+        each, through a ContinuousBatcher with the given knobs.  Returns
+        (qps, wall_s)."""
         from docqa_tpu.engines.serve import ContinuousBatcher
 
-        batcher = ContinuousBatcher(
-            gen, n_slots=16, chunk=32, cache_len=1024 if not small else 256
+        b = ContinuousBatcher(
+            engine, n_slots=n_slots, chunk=chunk, cache_len=cache_len
         )
-        prompt_ids = [[7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(64)]
-        # warm: compile the batched admission prefill at the shapes the
-        # loaded rounds hit (full-slot rounds) plus trickle shapes, and the
-        # slot decode program
-        for h in [
-            batcher.submit_ids(p, max_new_tokens=4)
-            for p in prompt_ids[: batcher.n_slots]
-        ]:
-            h.result()
-        batcher.submit_ids(prompt_ids[0], max_new_tokens=max_new).result()
-        n_req = 64 if not small else 8
-        t0 = time.perf_counter()
-        handles = [
-            batcher.submit_ids(p, max_new_tokens=max_new)
-            for p in prompt_ids[:n_req]
-        ]
-        for h in handles:
-            h.result()
-        wall = time.perf_counter() - t0
-        qps = n_req / wall
-        DETAILS["rag_load"] = {
+        try:
+            prompt_ids = [
+                [7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(n_req)
+            ]
+            # warm: compile the batched admission prefill at the shapes the
+            # loaded rounds hit (full-slot rounds) plus trickle shapes, and
+            # the slot decode program
+            for h in [
+                b.submit_ids(p, max_new_tokens=4) for p in prompt_ids[:n_slots]
+            ]:
+                h.result()
+            b.submit_ids(prompt_ids[0], max_new_tokens=max_new).result()
+            t0 = time.perf_counter()
+            handles = [
+                b.submit_ids(p, max_new_tokens=max_new) for p in prompt_ids
+            ]
+            for h in handles:
+                h.result()
+            wall = time.perf_counter() - t0
+        finally:
+            # stop on EVERY path: a leaked batcher thread holds the engine
+            b.stop()
+            del b
+            gc.collect()
+        return n_req / wall, wall
+
+    def sweep_load(engine, n_req, cache_len, extra_combos):
+        """Measure (16, 32), then — if short of BASELINE config 5's QPS 16
+        target — sweep extra (n_slots, chunk) combos: slots and chunk trade
+        per-request latency for aggregate throughput, and the served config
+        should be the measured winner, not a guess.  Returns the rag_load
+        DETAILS dict."""
+        attempts = []
+        qps, wall = run_load(engine, 16, 32, n_req, cache_len)
+        attempts.append({"n_slots": 16, "chunk": 32, "qps": round(qps, 2)})
+        if not small and qps < 16:
+            for ns, ch in extra_combos:
+                try:
+                    q2, w2 = run_load(engine, ns, ch, n_req, cache_len)
+                except Exception as e:
+                    log(f"load sweep ({ns},{ch}) failed: {e!r}")
+                    continue
+                attempts.append(
+                    {"n_slots": ns, "chunk": ch, "qps": round(q2, 2)}
+                )
+                if q2 > qps:
+                    qps, wall = q2, w2
+        best = max(attempts, key=lambda a: a["qps"])
+        return {
             "requests": n_req,
             "wall_s": round(wall, 2),
             "sustained_qps": round(qps, 2),
             "qps_target": 16,
+            "best_knobs": {"n_slots": best["n_slots"], "chunk": best["chunk"]},
+            "attempts": attempts,
         }
-        log(
-            f"config5 load: {n_req} concurrent requests in {wall:.2f}s "
-            f"= {qps:.1f} QPS (target 16)"
+
+    try:
+        n_req = 64 if not small else 8
+        cache_len = 1024 if not small else 256
+        DETAILS["rag_load"] = sweep_load(
+            gen, n_req, cache_len, ((32, 32), (16, 64), (32, 64))
         )
-        batcher.stop()
-        del batcher
-        gc.collect()
+        log(f"config5 load: {DETAILS['rag_load']}")
     except Exception as e:
         log(f"qps bench failed: {e!r}")
         DETAILS["rag_load"] = {"error": repr(e)}
@@ -478,13 +513,147 @@ def main() -> None:
         log(f"deid bench failed: {e!r}")
         DETAILS["deid"] = {"error": repr(e)}
 
-    # ---- config 3b: Mistral-7B-class attempt (bf16, single chip) ------------
+    # ---- configs 3c/5b/3b: Mistral-7B-class on one chip ---------------------
     if not small:
-        # free everything the 7B needs room for — including `summ`, which
-        # holds the 1.1B engine as .generator (a leaked ref here would make
-        # the 7B verdict measure under ~2 GB of false memory pressure)
+        # free the 1.1B engines — including `summ`, which holds one as
+        # .generator (a leaked ref here would make the 7B verdict measure
+        # under ~2 GB of false memory pressure).  The 1M store (~0.8 GB)
+        # STAYS resident: the headline configuration is 7B-int8 e2e over it
+        # (the model class BASELINE config 3 actually names).
         summ = None  # noqa: F841
-        del gen, store, encoder
+        del gen
+        gc.collect()
+
+        # ---- config 3c: 7B int8 weights (w8a16) — the serving path that
+        # fits one v5e chip (~7.2 GB tree, half the bytes per decode step;
+        # models/quant.py)
+        try:
+            from docqa_tpu.models.quant import init_quantized_decoder_params
+
+            cfg7 = DecoderConfig.mistral_7b()
+            params8 = init_quantized_decoder_params(jax.random.PRNGKey(0), cfg7)
+            pb8 = param_bytes(params8)
+            gen8 = GenerateEngine(
+                cfg7,
+                GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
+                params=params8,
+            )
+            gen8.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
+            t8, _ = timed(
+                lambda: gen8.generate_ids([[5, 9, 11]], max_new_tokens=64), n=3
+            )
+            tok8 = 64 / t8
+            util8 = tok8 * pb8 / (V5E_HBM_GBPS * 1e9) if on_tpu else None
+            DETAILS["decode_7b_int8"] = {
+                "tokens_per_s": round(tok8, 1),
+                "param_bytes_gb": round(pb8 / 1e9, 2),
+                "hbm_utilization": round(util8, 3) if util8 else None,
+            }
+            log(
+                f"config3c Mistral-7B-class int8 ({pb8/1e9:.1f}GB): "
+                f"{tok8:.1f} tok/s"
+                + (f", HBM util {util8:.0%}" if util8 else "")
+            )
+
+            # ---- HEADLINE: 7B-int8 e2e QA over the 1M store, speculation
+            # swept.  Prompt-lookup speculation is output-exact (greedy
+            # match or it falls back), so the best speculative_k is purely
+            # a latency decision — measure, don't guess.
+            try:
+                e2e_attempts = []
+                best = None
+                for spec_k in (0, 4, 8):
+                    eng_k = (
+                        gen8
+                        if spec_k == 0
+                        else GenerateEngine(
+                            cfg7,
+                            GenerateConfig(
+                                max_new_tokens=64,
+                                prefill_buckets=(128,),
+                                speculative_k=spec_k,
+                            ),
+                            params=params8,
+                        )
+                    )
+                    try:
+                        p50k, p95k = measure_e2e(
+                            eng_k, q_texts[2:7], f"7B-int8 spec_k={spec_k}"
+                        )
+                    finally:
+                        # release on the error path too: a leaked spec
+                        # engine would hold the 7B tree and starve the
+                        # bf16 attempt below of HBM it needs
+                        if eng_k is not gen8:
+                            del eng_k
+                            gc.collect()
+                    e2e_attempts.append(
+                        {
+                            "speculative_k": spec_k,
+                            "p50_ms": round(p50k, 2),
+                            "p95_ms": round(p95k, 2),
+                        }
+                    )
+                    if best is None or p50k < best[1]:
+                        best = (spec_k, p50k, p95k)
+                DETAILS["qa_e2e_7b_int8"] = {
+                    "p50_ms": round(best[1], 2),
+                    "p95_ms": round(best[2], 2),
+                    "new_tokens": max_new,
+                    "decoder": "mistral-7b-class-int8",
+                    "speculative_k": best[0],
+                    "attempts": e2e_attempts,
+                }
+                # this is the number the summary line reports — the 1.1B
+                # figures above stay in DETAILS for round-over-round
+                # comparability
+                p50 = best[1]
+                DETAILS["headline_config"] = "qa_e2e_7b_int8"
+                log(
+                    f"HEADLINE 7B-int8 e2e: p50 {best[1]:.1f}ms "
+                    f"p95 {best[2]:.1f}ms (spec_k={best[0]})"
+                )
+            except Exception as e:
+                log(f"7B e2e headline failed (1.1B number stands): {e!r}")
+                DETAILS["qa_e2e_7b_int8"] = {"error": repr(e)[:300]}
+
+            # ---- config 5b: 7B-class under load — BASELINE config 5's
+            # generator class through the batcher.  The slots share each
+            # int8 weight read, so aggregate throughput approaches
+            # slots/step-time even at 7B on one chip.
+            try:
+                from docqa_tpu.runtime.metrics import (
+                    DEFAULT_REGISTRY as _REG,
+                )
+
+                # delta-window the global histogram: config 5's 1.1B runs
+                # already observed into it, and the lifetime mean would
+                # blend models
+                hist = _REG.histogram("serve_tokens_per_chunk")
+                count0 = hist.count
+                sum0 = (hist.mean * count0) if count0 else 0.0
+                DETAILS["rag_load_7b_int8"] = sweep_load(
+                    gen8, 32, 512, ((32, 32), (16, 64))
+                )
+                d_count = hist.count - count0
+                DETAILS["rag_load_7b_int8"]["serve_tokens_per_chunk_mean"] = (
+                    round((hist.mean * hist.count - sum0) / d_count, 2)
+                    if d_count > 0
+                    else None
+                )
+                log(f"config5b 7B-int8 load: {DETAILS['rag_load_7b_int8']}")
+            except Exception as e:
+                log(f"7B int8 load bench failed: {e!r}")
+                DETAILS["rag_load_7b_int8"] = {"error": repr(e)[:300]}
+            del gen8, params8
+            gc.collect()
+        except Exception as e:
+            log(f"config3c 7B int8 attempt failed: {e!r}")
+            DETAILS["decode_7b_int8"] = {"error": repr(e)[:500]}
+
+        # ---- config 3b: the same 7B in bf16 (14.5 GB) — needs ALL the
+        # HBM, so the store/encoder go first; runs last for that reason
+        del store, encoder, retriever
         gc.collect()
         try:
             import jax.numpy as jnp
@@ -529,91 +698,14 @@ def main() -> None:
             # leave room — record the honest outcome either way
             log(f"config3b 7B bf16 attempt failed: {e!r}")
             DETAILS["decode_7b"] = {"error": repr(e)[:500]}
-            gen7 = params7 = None  # noqa: F841 — drop refs before int8 try
-            gc.collect()
-
-        # ---- config 3c: the same 7B in int8 weights (w8a16) — the path
-        # that actually fits one v5e chip (~7.2 GB tree, half the bytes
-        # per decode step; models/quant.py)
-        try:
-            from docqa_tpu.models.quant import init_quantized_decoder_params
-
-            cfg7 = DecoderConfig.mistral_7b()
-            params8 = init_quantized_decoder_params(jax.random.PRNGKey(0), cfg7)
-            pb8 = param_bytes(params8)
-            gen8 = GenerateEngine(
-                cfg7,
-                GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
-                params=params8,
-            )
-            gen8.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
-            t8, _ = timed(
-                lambda: gen8.generate_ids([[5, 9, 11]], max_new_tokens=64), n=3
-            )
-            tok8 = 64 / t8
-            util8 = tok8 * pb8 / (V5E_HBM_GBPS * 1e9) if on_tpu else None
-            DETAILS["decode_7b_int8"] = {
-                "tokens_per_s": round(tok8, 1),
-                "param_bytes_gb": round(pb8 / 1e9, 2),
-                "hbm_utilization": round(util8, 3) if util8 else None,
-            }
-            log(
-                f"config3c Mistral-7B-class int8 ({pb8/1e9:.1f}GB): "
-                f"{tok8:.1f} tok/s"
-                + (f", HBM util {util8:.0%}" if util8 else "")
-            )
-
-            # ---- config 5b: 7B-class under load — BASELINE config 5's
-            # generator class (Llama-3-8B/Mistral-7B) through the batcher.
-            # Sixteen slots share each int8 weight read, so the aggregate
-            # should approach slots/step-time even at 7B on one chip.
-            try:
-                from docqa_tpu.engines.serve import ContinuousBatcher
-
-                b7 = ContinuousBatcher(
-                    gen8, n_slots=16, chunk=32, cache_len=512
-                )
-                try:
-                    prompts7 = [
-                        [7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(32)
-                    ]
-                    for h in [
-                        b7.submit_ids(p, max_new_tokens=4)
-                        for p in prompts7[:16]
-                    ]:
-                        h.result()  # compile admission + decode shapes
-                    t0 = time.perf_counter()
-                    handles7 = [
-                        b7.submit_ids(p, max_new_tokens=64) for p in prompts7
-                    ]
-                    for h in handles7:
-                        h.result()
-                    wall7 = time.perf_counter() - t0
-                    DETAILS["rag_load_7b_int8"] = {
-                        "requests": len(prompts7),
-                        "wall_s": round(wall7, 2),
-                        "sustained_qps": round(len(prompts7) / wall7, 2),
-                        "qps_target": 16,
-                    }
-                    log(
-                        f"config5b 7B-int8 load: {len(prompts7)} requests "
-                        f"in {wall7:.2f}s = {len(prompts7)/wall7:.1f} QPS"
-                    )
-                finally:
-                    # stop on EVERY path: a leaked batcher thread holds the
-                    # int8 engine and defeats the del/gc below
-                    b7.stop()
-                    del b7
-            except Exception as e:
-                log(f"7B int8 load bench failed: {e!r}")
-                DETAILS["rag_load_7b_int8"] = {"error": repr(e)[:300]}
-            del gen8, params8
-            gc.collect()
-        except Exception as e:
-            log(f"config3c 7B int8 attempt failed: {e!r}")
-            DETAILS["decode_7b_int8"] = {"error": repr(e)[:500]}
 
     # ---- emit ---------------------------------------------------------------
+    # A CPU fallback run must be UNMISTAKABLE in the one line the driver
+    # parses: distinct metric name AND an explicit degraded flag, so no
+    # artifact comparison can mistake a smoke run for a TPU measurement
+    # (the r02 artifact was misleading exactly this way).
+    degraded = not on_tpu
+    DETAILS["degraded"] = degraded
     try:
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_details.json"),
@@ -623,16 +715,15 @@ def main() -> None:
     except Exception as e:
         log(f"details write failed: {e!r}")
     log(f"details: {json.dumps(DETAILS)}")
-    print(
-        json.dumps(
-            {
-                "metric": "qa_e2e_p50_ms",
-                "value": round(p50, 2),
-                "unit": "ms",
-                "vs_baseline": round(1000.0 / p50, 3),
-            }
-        )
-    )
+    summary = {
+        "metric": "qa_e2e_p50_ms" + ("_cpu_smoke" if degraded else ""),
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(1000.0 / p50, 3),
+    }
+    if degraded:
+        summary["degraded"] = True
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -192,7 +192,11 @@ class TieredIndex:
                 qn = queries / np.maximum(
                     np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
                 )
-                k_tail = min(k_bulk, n_live)  # same tombstone headroom as bulk
+                # tombstone headroom like the bulk fetch, but never below k:
+                # k_bulk is capped at `covered`, and a tier built over few
+                # rows must not shrink the tail fetch (that would under-fill
+                # every query and force the exact fallback permanently)
+                k_tail = min(max(k_bulk, k), n_live)
                 vals, ids = _tail_kernel(
                     tail_dev,
                     jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),

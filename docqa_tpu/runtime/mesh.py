@@ -125,12 +125,43 @@ def host_cpu_mesh(n_devices: int = 8, data: int = 1) -> MeshContext:
     )
 
 
-def multihost_init() -> None:
+def multihost_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
     """Initialize the JAX distributed runtime for multi-host (DCN) operation.
 
-    Single-process if no coordinator is configured — the service plane calls
-    this unconditionally at startup.  (Replaces the reference's absent
-    multi-node story, SURVEY §2c.)
+    Parameters fall back to ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` env vars; with neither
+    arguments nor env configured this is a no-op returning False, so the
+    service plane can call it unconditionally at startup and stay
+    single-process by default.  After a True return, ``jax.devices()``
+    enumerates every host's devices and ``make_mesh()`` builds a global
+    mesh whose collectives ride DCN between hosts (and ICI within one).
+
+    Exercised for real by ``tests/test_multihost.py``: two OS processes, a
+    local coordinator, and a cross-process global reduction on CPU devices.
+    (Replaces the reference's absent multi-node story, SURVEY §2c; its
+    orchestration was a single-host batch file, ``start_all.bat:12-35``.)
     """
-    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        jax.distributed.initialize()
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return False
+    kwargs: dict = {"coordinator_address": addr}
+    n = (
+        num_processes
+        if num_processes is not None
+        else os.environ.get("JAX_NUM_PROCESSES")
+    )
+    pid = (
+        process_id
+        if process_id is not None
+        else os.environ.get("JAX_PROCESS_ID")
+    )
+    if n is not None:
+        kwargs["num_processes"] = int(n)
+    if pid is not None:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+    return True

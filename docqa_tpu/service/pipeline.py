@@ -177,10 +177,35 @@ class DocumentPipeline:
         # re-raised (a raise would make the retry republish the prefix).
         for body, clean in zip(bodies, masked):
             try:
-                # status BEFORE publish: once the message is on the clean queue
-                # the index worker may race us to INDEXED, which must not be
-                # overwritten by a late DEIDENTIFIED
-                self.registry.set_status(body["doc_id"], reg.DEIDENTIFIED)
+                # deleted docs stop HERE, not just at the index worker: a
+                # DEIDENTIFIED overwrite of DELETED would advertise an
+                # erased doc as alive, and the clean-queue publish would
+                # re-arm its resurrection across a restart (the replayed
+                # message would pass the index worker's DELETED check
+                # because this very write changed the status)
+                with self._suppress_lock:
+                    suppressed = body["doc_id"] in self._suppressed_doc_ids
+                    if not suppressed:
+                        record = self.registry.get(body["doc_id"])
+                        suppressed = (
+                            record is not None
+                            and record.status == reg.DELETED
+                        )
+                    if not suppressed:
+                        # status BEFORE publish (and inside the lock, so a
+                        # concurrent DELETE either lands before this check
+                        # or writes DELETED after us): once the message is
+                        # on the clean queue the index worker may race us
+                        # to INDEXED, which must not be overwritten by a
+                        # late DEIDENTIFIED
+                        self.registry.set_status(
+                            body["doc_id"], reg.DEIDENTIFIED
+                        )
+                if suppressed:
+                    log.info(
+                        "dropping deleted doc %s at deid stage", body["doc_id"]
+                    )
+                    continue
                 self.broker.publish(
                     self.cfg.broker.clean_queue,
                     {

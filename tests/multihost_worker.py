@@ -1,0 +1,53 @@
+"""Worker process for tests/test_multihost.py — NOT a test module.
+
+Each of the two OS processes runs this script: force the CPU backend
+(defeating the environment's accelerator hook), join the distributed
+runtime through the framework's own ``multihost_init``, build the global
+mesh, and run a cross-process reduction whose result proves bytes moved
+between the processes.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # before any backend init
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main() -> None:
+    port, process_id = sys.argv[1], int(sys.argv[2])
+    sys.path.insert(0, sys.argv[3])  # repo root
+
+    from docqa_tpu.runtime.mesh import make_mesh, multihost_init
+
+    assert multihost_init(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    ld = jax.local_device_count()
+    assert jax.device_count() == 2 * ld
+
+    ctx = make_mesh()  # over ALL global devices — the cross-process mesh
+    assert ctx.n_devices == jax.device_count()
+
+    # each process contributes (process_index + 1) per local device; the
+    # global sum must therefore be ld*1 + ld*2 = 3*ld — a value no single
+    # process could compute without the other's shard
+    local = np.full((ld,), float(jax.process_index() + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        ctx.row_sharded, local, (jax.device_count(),)
+    )
+    total = jax.jit(
+        jnp.sum, out_shardings=NamedSharding(ctx.mesh, P())
+    )(arr)
+    print(f"MULTIHOST_OK {float(total)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

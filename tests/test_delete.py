@@ -283,6 +283,34 @@ class TestDeletionRaces:
         finally:
             rt.stop()
 
+    def test_deid_stage_drops_deleted_doc(self):
+        """A doc deleted while still on the RAW queue must be dropped at
+        the deid stage: a DEIDENTIFIED overwrite of DELETED would advertise
+        an erased doc as alive, and the clean-queue publish would re-arm
+        its resurrection across a restart."""
+        from docqa_tpu.service import registry as reg
+
+        rt = self._runtime()
+        try:
+            rec = rt.pipeline.ingest_document(
+                "d.txt", b"Insulin glargine 20 units at bedtime.",
+                patient_id="p6",
+            )
+            rt.delete_document(rec.doc_id, erase=True)
+            # simulate the restart: in-memory suppression is gone, only the
+            # registry DELETED row survives
+            rt.pipeline._suppressed_doc_ids.clear()
+            body = {
+                "doc_id": rec.doc_id,
+                "text": "Insulin glargine 20 units at bedtime.",
+                "metadata": {"patient_id": "p6", "filename": "d.txt"},
+            }
+            rt.pipeline._deid_handler([body])  # the raw-queue replay
+            assert rt.registry.get(rec.doc_id).status == reg.DELETED
+            assert rt.broker.depth(rt.cfg.broker.clean_queue) == 0
+        finally:
+            rt.stop()
+
     def test_replay_does_not_flip_deleted_to_indexed(self):
         """A tombstoned-but-uncompacted doc is still in metadata_rows(), so
         its replayed message lands in the already-indexed path — which must

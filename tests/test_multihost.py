@@ -1,0 +1,56 @@
+"""Multi-host (DCN) initialization: two real OS processes, one JAX
+distributed runtime, a cross-process mesh, and a global reduction.
+
+This is the test SURVEY §2c's "elastic / multi-node" row calls for: the
+reference had no multi-node story at all (a single-host batch launcher,
+``start_all.bat:12-35``), and round 2's ``multihost_init`` was an
+unexercised env gate.  Here both workers join through the framework's own
+``multihost_init`` (tests/multihost_worker.py), so the DCN code path in
+``runtime/mesh.py`` runs for real on every CI pass — on CPU devices, the
+same way every other distributed path in this suite is validated.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_and_global_reduction():
+    port = _free_port()
+    env = dict(os.environ)
+    # 2 virtual CPU devices per process -> a 4-device global mesh
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(pid), REPO],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+        # 2 local devices/process: global sum = 2*1 + 2*2 = 6
+        assert "MULTIHOST_OK 6.0" in out, out
