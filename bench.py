@@ -412,6 +412,39 @@ def main() -> None:
         DETAILS["rag_load"] = sweep_load(
             gen, n_req, cache_len, ((32, 32), (16, 64), (32, 64))
         )
+        if not small and DETAILS["rag_load"]["sustained_qps"] < 16:
+            # last knob (VERDICT r2 item 2): speculation — each batcher
+            # chunk verifies spec_k draft tokens per slot in one weight
+            # read, raising aggregate tokens/read.  Own try: a failure
+            # here must not wipe the measured sweep above.
+            try:
+                bk = DETAILS["rag_load"]["best_knobs"]
+                gen_spec = GenerateEngine(
+                    dataclasses.replace(dec_cfg, quantize_weights=True),
+                    GenerateConfig(speculative_k=4),
+                    mesh=mesh,
+                    params=gen.params,
+                )
+                try:
+                    qs, ws = run_load(
+                        gen_spec, bk["n_slots"], bk["chunk"], n_req,
+                        cache_len,
+                    )
+                finally:
+                    del gen_spec
+                    gc.collect()
+                DETAILS["rag_load"]["attempts"].append(
+                    {**bk, "speculative_k": 4, "qps": round(qs, 2)}
+                )
+                if qs > DETAILS["rag_load"]["sustained_qps"]:
+                    DETAILS["rag_load"].update(
+                        sustained_qps=round(qs, 2),
+                        wall_s=round(ws, 2),
+                        best_knobs={**bk, "speculative_k": 4},
+                    )
+            except Exception as e:
+                log(f"config5 speculation attempt failed: {e!r}")
+                DETAILS["rag_load"]["speculation_error"] = repr(e)[:200]
         log(f"config5 load: {DETAILS['rag_load']}")
     except Exception as e:
         log(f"qps bench failed: {e!r}")
@@ -480,10 +513,46 @@ def main() -> None:
             "five_chunk_ms": round(t_s2s * 1e3, 1),
             "model": f"bart-class {s2s_cfg.d_model}x"
             f"{s2s_cfg.enc_layers}+{s2s_cfg.dec_layers}",
+            "decode": "greedy",
         }
         log(f"config4b seq2seq summarize (5 chunks): {t_s2s*1e3:.0f}ms")
         del s2s, summ2
         gc.collect()
+        if not small:
+            # beam-4 with the full generation constraints — BASELINE
+            # config 4 names bart-large-cnn whose published decode IS
+            # beam.  Kept in a separate try: the beam program's XLA
+            # compile at this depth is the risk (minutes on a slow host),
+            # not its runtime.
+            try:
+                s2s_beam = Seq2SeqEngine(Seq2SeqConfig.bart_large_cnn())
+                summ_b = SummarizeEngine(
+                    s2s_beam,
+                    SummarizerConfig(max_input_tokens=s2s_cfg.max_src_len),
+                    instruction_prompts=False,
+                )
+                t0 = time.perf_counter()
+                summ_b.summarize_patient("p1", docs, max_tokens=128)
+                compile_s = time.perf_counter() - t0
+                t_beam, _ = timed(
+                    lambda: summ_b.summarize_patient(
+                        "p1", docs, max_tokens=128
+                    )
+                )
+                DETAILS["summarize_seq2seq_beam"] = {
+                    "five_chunk_ms": round(t_beam * 1e3, 1),
+                    "compile_s": round(compile_s, 1),
+                    "num_beams": Seq2SeqConfig.bart_large_cnn().num_beams,
+                }
+                log(
+                    f"config4b beam summarize (5 chunks): "
+                    f"{t_beam*1e3:.0f}ms (compile {compile_s:.0f}s)"
+                )
+                del s2s_beam, summ_b
+                gc.collect()
+            except Exception as e:
+                log(f"beam summarize bench failed: {e!r}")
+                DETAILS["summarize_seq2seq_beam"] = {"error": repr(e)[:300]}
     except Exception as e:
         log(f"seq2seq summarize bench failed: {e!r}")
         DETAILS["summarize_seq2seq"] = {"error": repr(e)[:300]}
@@ -509,6 +578,36 @@ def main() -> None:
         log(f"config2 deid: batch-32 in {t_deid*1e3:.0f}ms = {32/t_deid:.0f} docs/s")
         del deid
         gc.collect()
+        if not small:
+            # quality, not just speed: train the real tagger and score it
+            # on the HAND-WRITTEN eval set (deid/evalset.py — sentences
+            # disjoint from the training generator's templates, so this
+            # measures generalization, not memorization)
+            try:
+                from docqa_tpu.deid.evalset import evaluate_deid
+
+                t0 = time.perf_counter()
+                deid_trained = DeidEngine.trained(NERConfig())
+                ev = evaluate_deid(deid_trained)
+                DETAILS["deid"].update(
+                    {
+                        "train_s": round(time.perf_counter() - t0, 1),
+                        "f1": ev["entity_f1"],
+                        "char_f1": ev["char_f1"],
+                        "span_recall_any": ev["span_recall_any"],
+                        "eval": ev,
+                    }
+                )
+                log(
+                    f"config2 deid quality (handwritten eval): entity F1 "
+                    f"{ev['entity_f1']}, char F1 {ev['char_f1']}, "
+                    f"span recall {ev['span_recall_any']}"
+                )
+                del deid_trained
+                gc.collect()
+            except Exception as e:
+                log(f"deid quality eval failed: {e!r}")
+                DETAILS["deid"]["eval_error"] = repr(e)[:300]
     except Exception as e:
         log(f"deid bench failed: {e!r}")
         DETAILS["deid"] = {"error": repr(e)}
@@ -531,7 +630,16 @@ def main() -> None:
             from docqa_tpu.models.quant import init_quantized_decoder_params
 
             cfg7 = DecoderConfig.mistral_7b()
-            params8 = init_quantized_decoder_params(jax.random.PRNGKey(0), cfg7)
+            # HOST init deliberately: the device-side jax.random init
+            # sequence leaves the tunneled client in its degraded mode
+            # (docs/PERF.md §1, ~70 ms on EVERY later dispatch) and the
+            # headline e2e + 5b load both run after this point in this
+            # process.  The one-time cost is drawing + transferring the
+            # 7.2 GB tree — the decode-only bf16 attempt (config 3b, runs
+            # last) keeps device init because nothing measured after it.
+            params8 = init_quantized_decoder_params(
+                jax.random.PRNGKey(0), cfg7, host_init=True
+            )
             pb8 = param_bytes(params8)
             gen8 = GenerateEngine(
                 cfg7,
@@ -632,9 +740,37 @@ def main() -> None:
                 hist = _REG.histogram("serve_tokens_per_chunk")
                 count0 = hist.count
                 sum0 = (hist.mean * count0) if count0 else 0.0
-                DETAILS["rag_load_7b_int8"] = sweep_load(
-                    gen8, 32, 512, ((32, 32), (16, 64))
+                # serve with the e2e sweep's best speculative_k: in the
+                # batcher each chunk verifies spec_k draft tokens per slot
+                # in ONE weight read, so speculation raises load
+                # throughput, not just solo latency
+                best_k = DETAILS.get("qa_e2e_7b_int8", {}).get(
+                    "speculative_k", 0
                 )
+                load_engine = (
+                    GenerateEngine(
+                        cfg7,
+                        GenerateConfig(
+                            max_new_tokens=64,
+                            prefill_buckets=(128,),
+                            speculative_k=best_k,
+                        ),
+                        params=params8,
+                    )
+                    if best_k
+                    else gen8
+                )
+                try:
+                    DETAILS["rag_load_7b_int8"] = sweep_load(
+                        load_engine, 32, 512, ((32, 32), (16, 64))
+                    )
+                finally:
+                    # release on the error path too: a leaked 7B engine
+                    # would starve the bf16 attempt below of HBM
+                    if load_engine is not gen8:
+                        del load_engine
+                        gc.collect()
+                DETAILS["rag_load_7b_int8"]["speculative_k"] = best_k
                 d_count = hist.count - count0
                 DETAILS["rag_load_7b_int8"]["serve_tokens_per_chunk_mean"] = (
                     round((hist.mean * hist.count - sum0) / d_count, 2)

@@ -85,8 +85,15 @@ TRAIN_NRP = (
     "French German Spanish Polish Czech Croatian Finnish Danish Japanese "
     "Korean Vietnamese Indian Nigerian Ghanaian Kenyan Peruvian Canadian "
     "Australian Swiss Dutch Catholic Protestant Orthodox Muslim Hindu "
-    "Sikh Jain Lutheran Anglican Methodist"
-).split()
+    "Sikh Jain Lutheran Anglican Methodist Quaker Mormon Amish Baptist "
+    "Presbyterian Taoist Mennonite"
+).split() + [
+    # multi-word affiliations: span merging must learn B- then I- chains
+    "Roman Catholic",
+    "Greek Orthodox",
+    "Seventh-day Adventist",
+    "Russian Orthodox",
+]
 EVAL_NRP = "Irish Buddhist Norwegian Egyptian Moroccan Jewish".split()
 
 # Capitalized non-PHI that must stay O (drugs, scans, units, days are caught
@@ -96,6 +103,10 @@ _CAP_NEGATIVES = (
     "Amoxicillin Prednisone Insulin Albuterol"
 ).split()
 _SCANS = "MRI CT ECG EEG X-ray".split()
+
+_LOCATION_PREFIXES = (
+    "New Port Mount East West Saint Lake Fort North South"
+).split()
 
 _SYLLABLES = (
     "ba be bi bo bu da de di do du ka ke ki ko ku la le li lo lu ma me "
@@ -136,6 +147,13 @@ _SUBJECTS: Tuple[str, ...] = (
     "{P}, a {N} male,",
     "{P}, a {N} female,",
     "Patient {P}, who is {N},",
+    # appositions and narrative subjects (round-3 disjoint eval showed the
+    # tagger under-trained on flowing prose: deid/evalset.py)
+    "The patient, {P},",
+    "Our mutual patient {P}",
+    "Your patient {P}",
+    "The surgeon, {P},",
+    "pt {P}",
 )
 _PREDICATES: Tuple[str, ...] = (
     "was admitted with chest pain.",
@@ -150,6 +168,11 @@ _PREDICATES: Tuple[str, ...] = (
     "requests an interpreter for the next visit.",
     "tolerated the procedure well.",
     "reports good adherence to medications.",
+    # predicates carrying their own entities (late-sentence positions)
+    "was transferred from {L} for a higher level of care.",
+    "will be discharged to a rehabilitation facility in {L}.",
+    "arrived by ambulance from {L} overnight.",
+    "is resting comfortably, family at bedside.",
 )
 
 _TEMPLATES: Tuple[str, ...] = (
@@ -173,6 +196,25 @@ _TEMPLATES: Tuple[str, ...] = (
     "Emergency contact: {P}, number on file.",
     "Referred by {P}.",
     "{P} and spouse attended the visit.",
+    # letter register (salutations, courteous clauses)
+    "Dear colleague, thank you for referring {P} for further evaluation.",
+    "Thank you for asking me to see {P} in consultation.",
+    "I had the pleasure of seeing {P}, who travelled from {L}.",
+    "I reviewed the results with {P} by telephone yesterday.",
+    # possessives (the span ends at the name; 's stays O)
+    "{P}'s blood pressure remains elevated despite therapy.",
+    "{P}'s family requests a care conference this week.",
+    # religious-practice phrasings (affiliation in varied predicates)
+    "He is a devout {N} and declines the gelatin-based capsules.",
+    "She is an active member of the local {N} congregation.",
+    "Patient describes himself as {N} and requests chaplain support.",
+    "Faith is recorded as {N} in the chart.",
+    "A practicing {N}, the patient observes dietary restrictions.",
+    # French clinical prose (the service's prompt language)
+    "La patiente {P} de {L} consulte pour des céphalées persistantes.",
+    "Monsieur {P} habite {L} et vit seul depuis peu.",
+    "Madame {P} est hospitalisée depuis hier soir.",
+    "Le patient {P}, d'origine {N}, est suivi en cardiologie.",
     # negatives: no PHI, plenty of capitalized O words
     "Patient presents with abdominal pain and nausea.",
     "The {S} of the chest was unremarkable.",
@@ -182,6 +224,14 @@ _TEMPLATES: Tuple[str, ...] = (
     "Physical exam reveals no acute distress.",
     "{S} results were reviewed with the care team.",
     "Plan to titrate {D} as tolerated.",
+    # narrative negatives: sentence-initial capitals, section headers,
+    # clinical nouns that must not fire as PERSON/LOCATION
+    "Assessment: stable overnight. Plan: continue current regimen.",
+    "Ambulating independently; wound edges clean and dry.",
+    "Chest radiograph demonstrates clear lung fields bilaterally.",
+    "Colonoscopy scheduled for next month; bowel preparation reviewed.",
+    "Echocardiogram pending; telemetry without events overnight.",
+    "Discharge instructions reviewed; follow-up arranged with cardiology.",
 )
 
 
@@ -214,9 +264,23 @@ def _fill(
                     if rng.random() < gibberish_frac
                     else str(rng.choice(lexicons["city"]))
                 )
+                if rng.random() < 0.2:
+                    # compound place names (Mount Auburn, New Bedford —
+                    # multi-word LOCATION spans the tagger must chain)
+                    fill = (
+                        str(rng.choice(_LOCATION_PREFIXES)) + " " + fill
+                    )
                 ent = "LOCATION"
             elif slot == "N":
-                fill = str(rng.choice(lexicons["nrp"]))
+                # gibberish NRP fills too (at a lower rate): group names
+                # form a near-closed set, but an unseen affiliation must
+                # still be typed NRP from context — without these, unseen
+                # hash buckets fall back to the (much larger) PERSON prior
+                fill = (
+                    _gibberish(rng)
+                    if rng.random() < 0.25 * gibberish_frac
+                    else str(rng.choice(lexicons["nrp"]))
+                )
                 ent = "NRP"
             elif slot == "D":
                 fill, ent = str(rng.choice(_CAP_NEGATIVES)), None

@@ -1,0 +1,218 @@
+"""Hand-written PHI evaluation set + span/char metrics.
+
+The tagger trains on ``deid/datagen.py``'s synthetic generator; every
+earlier quality signal was drawn from the SAME template distribution, so
+it measured memorization as much as generalization.  This module is the
+disjoint check: the sentences below were written by hand in registers the
+generator does not produce (narrative discharge prose, referral letters,
+nursing shorthand, French clinical snippets mirroring the service's
+prompt language, intake forms), and the metric code is shared by the test
+suite and bench config 2 (``deid.f1`` in ``bench_details.json``).
+
+Reference capability being measured: Presidio's pretrained 6-entity
+detection (``deid-service/anonymizer.py:41-48``).
+
+Span markup: ``[TYPE:text]`` inline markers; ``_parse`` strips them and
+records the character spans against the clean text.
+
+Metric definitions (privacy-first):
+
+* ``char_*`` — precision/recall/F1 over *characters* inside gold PHI
+  spans vs characters inside predicted spans, type-agnostic: masking a
+  name as LOCATION still hides it, so char metrics measure leak risk.
+* ``span_recall_any`` — fraction of gold spans overlapped by ANY
+  prediction (a partially masked identifier may still leak; this counts
+  any-contact coverage).
+* ``entity_f1`` + per-entity breakdown — type-aware span matching
+  (overlap with the same entity_type), the classic NER view.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+_MARK = re.compile(r"\[([A-Z_]+):([^\]]*)\]")
+
+
+@dataclass(frozen=True)
+class GoldSpan:
+    entity_type: str
+    start: int
+    end: int
+
+
+def _parse(marked: str) -> Tuple[str, List[GoldSpan]]:
+    out: List[str] = []
+    spans: List[GoldSpan] = []
+    pos = 0
+    plain_len = 0
+    for m in _MARK.finditer(marked):
+        out.append(marked[pos : m.start()])
+        plain_len += m.start() - pos
+        text = m.group(2)
+        spans.append(
+            GoldSpan(m.group(1), plain_len, plain_len + len(text))
+        )
+        out.append(text)
+        plain_len += len(text)
+        pos = m.end()
+    out.append(marked[pos:])
+    return "".join(out), spans
+
+
+# Registers deliberately absent from datagen.py's templates: flowing
+# multi-clause narrative, letters with salutations, nursing shorthand,
+# French prose, form fields with colons, possessives, mid-sentence dates.
+_MARKED: Sequence[str] = (
+    # narrative discharge prose
+    "The patient, [PERSON:Margaret O'Leary], tolerated the procedure "
+    "well and was discharged to her daughter's home in "
+    "[LOCATION:Worcester] with follow-up scheduled for "
+    "[DATE_TIME:April 12, 2026].",
+    "On examination [PERSON:Henry Whitfield] appeared comfortable; he "
+    "moved from [LOCATION:Portland] last winter and works nights.",
+    "We saw [PERSON:Amara Okafor] in clinic today; her sister drove "
+    "her from [LOCATION:Springfield] after the fall on "
+    "[DATE_TIME:2026-02-19].",
+    # referral-letter register
+    "Dear colleague, thank you for referring [PERSON:Tomasz Nowak] "
+    "regarding refractory hypertension; please fax results to "
+    "[PHONE_NUMBER:617-555-0182] or write to "
+    "[EMAIL_ADDRESS:cardiology.referrals@mercyhealth.org].",
+    "I reviewed the imaging with [PERSON:Dr. Elena Vasquez] by phone "
+    "([PHONE_NUMBER:+1 415 555 0101]) before the family meeting on "
+    "[DATE_TIME:March 3, 2026].",
+    # nursing shorthand
+    "0800 rounds: pt [PERSON:J. Castellano] resting, wife at bedside, "
+    "transfer from [LOCATION:Mount Auburn] pending bed.",
+    "Night shift note - [PERSON:Priya Raghunathan] c/o nausea, called "
+    "covering MD at [PHONE_NUMBER:(508) 555-0147], orders received.",
+    # intake-form fields (colon-delimited, sentence-initial entities)
+    "Next of kin: [PERSON:Robert Ashford]. Residence: "
+    "[LOCATION:New Bedford]. Contact: [PHONE_NUMBER:774-555-0133]. "
+    "Email: [EMAIL_ADDRESS:r.ashford@example.net].",
+    "Emergency contact [PERSON:Linda Zhao] can be reached after "
+    "[DATE_TIME:6:30 pm] at [PHONE_NUMBER:857-555-0190].",
+    # religious / community affiliation (NRP)
+    "The patient is a practicing [NRP:Buddhist] and requests a "
+    "vegetarian diet during admission.",
+    "Family identifies as [NRP:Jehovah's Witnesses]; blood products "
+    "declined, documented with [PERSON:Samuel Ferreira] present.",
+    "As an observant [NRP:Muslim] patient he fasts during daylight "
+    "hours; medication times adjusted accordingly.",
+    # French clinical prose (the service's prompt language)
+    "La patiente [PERSON:Camille Rousseau] de [LOCATION:Lyon] est "
+    "suivie depuis le [DATE_TIME:12/01/2026] pour un diabète de type 2.",
+    "Monsieur [PERSON:Olivier Mercier] sera revu en consultation le "
+    "[DATE_TIME:2026-03-28]; joindre le secrétariat au "
+    "[PHONE_NUMBER:01 44 55 01 22].",
+    # possessives and appositions
+    "[PERSON:Katherine Bell]'s INR remains labile; her pharmacist in "
+    "[LOCATION:Quincy] will supervise dosing.",
+    "The surgeon, [PERSON:Prof. Nathaniel Greene], operated on "
+    "[DATE_TIME:February 2, 2026] without complication.",
+    # mid-sentence machine-style identifiers
+    "Labs drawn [DATE_TIME:2026-02-20] at [DATE_TIME:07:45] show "
+    "improving renal function; repeat in ten days.",
+    "Telehealth visit recorded; patient joined from [LOCATION:Fall "
+    "River] and verified identity via "
+    "[EMAIL_ADDRESS:m.santos1958@webmail.com].",
+    # clean sentences (false-positive pressure — no PHI at all)
+    "Continue metformin 500 mg twice daily with meals and recheck the "
+    "hemoglobin A1c in three months.",
+    "Ambulating independently, pain controlled, diet advanced as "
+    "tolerated, wound edges clean and dry.",
+    "Echocardiogram shows preserved ejection fraction without "
+    "regional wall motion abnormality.",
+)
+
+EXAMPLES: List[Tuple[str, List[GoldSpan]]] = [_parse(m) for m in _MARKED]
+
+
+def _char_set(spans) -> set:
+    chars: set = set()
+    for s in spans:
+        chars.update(range(s.start, s.end))
+    return chars
+
+
+def _prf(tp: int, fp: int, fn: int) -> Tuple[float, float, float]:
+    p = tp / (tp + fp) if tp + fp else 0.0
+    r = tp / (tp + fn) if tp + fn else 0.0
+    f = 2 * p * r / (p + r) if p + r else 0.0
+    return p, r, f
+
+
+def evaluate_deid(engine, examples=None) -> Dict[str, object]:
+    """Run ``engine.analyze_batch`` over the eval set and score it.
+
+    Works with any object exposing the Presidio-shaped ``analyze_batch``
+    (``deid/engine.py``).  Returns a JSON-ready dict; see module docstring
+    for metric semantics.
+    """
+    examples = examples if examples is not None else EXAMPLES
+    texts = [t for t, _ in examples]
+    preds = engine.analyze_batch(texts)
+
+    c_tp = c_fp = c_fn = 0
+    gold_total = gold_hit = 0
+    ent_tp: Dict[str, int] = {}
+    ent_fp: Dict[str, int] = {}
+    ent_fn: Dict[str, int] = {}
+    for (_, gold), pred in zip(examples, preds):
+        gchars = _char_set(gold)
+        pchars = _char_set(pred)
+        c_tp += len(gchars & pchars)
+        c_fp += len(pchars - gchars)
+        c_fn += len(gchars - pchars)
+        gold_total += len(gold)
+        for g in gold:
+            if any(p.start < g.end and g.start < p.end for p in pred):
+                gold_hit += 1
+            matched = any(
+                p.entity_type == g.entity_type
+                and p.start < g.end
+                and g.start < p.end
+                for p in pred
+            )
+            key = g.entity_type
+            if matched:
+                ent_tp[key] = ent_tp.get(key, 0) + 1
+            else:
+                ent_fn[key] = ent_fn.get(key, 0) + 1
+        for p in pred:
+            if not any(
+                p.entity_type == g.entity_type
+                and p.start < g.end
+                and g.start < p.end
+                for g in gold
+            ):
+                ent_fp[p.entity_type] = ent_fp.get(p.entity_type, 0) + 1
+
+    cp, cr, cf = _prf(c_tp, c_fp, c_fn)
+    tp = sum(ent_tp.values())
+    fp = sum(ent_fp.values())
+    fn = sum(ent_fn.values())
+    ep, er, ef = _prf(tp, fp, fn)
+    per_entity = {}
+    for e in sorted(set(ent_tp) | set(ent_fp) | set(ent_fn)):
+        p, r, f = _prf(ent_tp.get(e, 0), ent_fp.get(e, 0), ent_fn.get(e, 0))
+        per_entity[e] = {
+            "precision": round(p, 3),
+            "recall": round(r, 3),
+            "f1": round(f, 3),
+        }
+    return {
+        "examples": len(examples),
+        "gold_spans": gold_total,
+        "char_precision": round(cp, 3),
+        "char_recall": round(cr, 3),
+        "char_f1": round(cf, 3),
+        "span_recall_any": round(gold_hit / max(gold_total, 1), 3),
+        "entity_precision": round(ep, 3),
+        "entity_recall": round(er, 3),
+        "entity_f1": round(ef, 3),
+        "per_entity": per_entity,
+    }

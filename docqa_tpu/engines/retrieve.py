@@ -144,3 +144,184 @@ class FusedRetriever:
         vals = np.asarray(vals)[:n]
         row_ids = np.asarray(row_ids)[:n]
         return store.assemble_results(vals, row_ids)
+
+
+class FusedTieredRetriever:
+    """Text-in, ranked-rows-out over a :class:`TieredIndex` in ONE dispatch.
+
+    The two-step tiered query costs three dispatches (encoder forward, IVF
+    probe, exact tail) — on a tunneled chip each carries the same fixed
+    host<->device round-trip the module docstring describes, tripling the
+    overhead of the hot serving path.  This program fuses all three:
+    encode -> L2 normalize -> coarse probe over the IVF cells -> exact tail
+    scan -> both tiers' top-k, one XLA program.  Host-side work (duplicate
+    -id dedup, tombstone filtering, tier merge, the under-fill exact
+    fallback) is shared with ``TieredIndex.search`` via ``_merge``.
+
+    Falls back to the fused-exact path (``FusedRetriever``) whenever the
+    tiered index itself would: no IVF tier yet, filtered queries, or a
+    multi-device mesh.
+    """
+
+    def __init__(self, encoder, tiered):
+        self.encoder = encoder
+        self.tiered = tiered
+        self._exact = FusedRetriever(encoder, tiered.store)
+        self._fns: Dict[Any, Any] = {}
+        self._tier_token: Any = None  # evicts _fns when the tier swaps
+
+    def _get_fn(self, fetch: int, nprobe: int, k_tail: int):
+        key = (fetch, nprobe, k_tail)
+        fn = self._fns.get(key)
+        if fn is None:
+            from docqa_tpu.index.ivf import _probe_kernel
+            from docqa_tpu.index.tiered import _tail_kernel
+
+            enc_cfg = self.encoder.cfg
+
+            def program(
+                enc_params, ids, lengths, cells, cell_ids, centroids,
+                spill, spill_ids, tail, n_live,
+            ):
+                emb = encode_batch(enc_params, enc_cfg, ids, lengths)
+                emb = emb / jnp.maximum(
+                    jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+                )
+                q = emb.astype(cells.dtype)
+                bulk_vals, bulk_ids = _probe_kernel(
+                    cells, cell_ids, centroids, spill, spill_ids, q,
+                    nprobe=nprobe, k=fetch,
+                )
+                if k_tail:
+                    tail_vals, tail_ids = _tail_kernel(
+                        tail, q, n_live, k_tail
+                    )
+                else:  # empty tail: nothing to scan
+                    tail_vals = jnp.zeros((q.shape[0], 0), jnp.float32)
+                    tail_ids = jnp.zeros((q.shape[0], 0), jnp.int32)
+                return bulk_vals, bulk_ids, tail_vals, tail_ids
+
+            fn = jax.jit(program)
+            self._fns[key] = fn
+        return fn
+
+    def search_texts(
+        self,
+        texts: Sequence[str],
+        k: Optional[int] = None,
+        filters: Optional[Dict[str, Any]] = None,
+    ) -> List[List[SearchResult]]:
+        """Same contract as ``TieredIndex.search`` but from raw texts."""
+        tiered = self.tiered
+        store = tiered.store
+        k = k or store.cfg.default_k
+        if not len(texts):
+            return []
+        tiered._maybe_background_rebuild()
+        tier = tiered._tier  # one read: (ivf, covered) stay consistent
+        if tier is None or filters:
+            # pre-IVF or filtered: the (masked) exact fused path is the
+            # right tool — identical policy to TieredIndex.search
+            return self._exact.search_texts(texts, k=k, filters=filters)
+        if not self._exact._fusable:
+            # multi-device mesh: fusion is off, but the TIER must still
+            # serve — an exact fallback here would silently full-scan the
+            # store the operator configured tiered serving to avoid
+            emb = np.asarray(
+                self.encoder.encode_texts(texts), np.float32
+            )
+            return tiered.search(emb, k=k)
+        ivf, covered = tier
+
+        n = len(texts)
+        ids_p, len_p = marshal_texts(
+            self.encoder.tokenizer,
+            self.encoder.cfg,
+            texts,
+            batch_buckets=QUERY_BATCH_BUCKETS,
+        )
+        if self._tier_token is not ivf:
+            # a rebuild swapped the tier: every cached program holds the
+            # OLD cell tensors' shapes — evict so dead executables don't
+            # accumulate across the service's lifetime
+            self._fns.clear()
+            self._tier_token = ivf
+        k_bulk = tiered._k_bulk(k, covered)
+        # mirror IVFIndex.search's duplicate-id over-fetch: rows assigned
+        # to multiple cells can appear nprobe times in the raw top list
+        pool = ivf.nprobe * ivf.cap + int(ivf._spill_ids.shape[0])
+        nprobe = min(ivf.nprobe, ivf.n_clusters)
+        fetch = min(min(k_bulk, ivf.n) * (ivf.n_assign + 1), pool)
+
+        _, _, tail_dev, n_live, tail_meta = tiered._tail_device(covered)
+        # NOT clamped to n_live: the tail buffer is NEG_INF-masked past
+        # n_live and the merge drops those rows, so asking for the full
+        # quantized ladder keeps ONE compiled program while the tail grows
+        # (an n_live-dependent k would recompile the whole fused program —
+        # encoder included — on every append while the tail is small).
+        # The padded bucket size bounds top_k's k.
+        k_tail = min(max(k_bulk, k), int(tail_dev.shape[0]))
+        fn = self._get_fn(fetch, nprobe, k_tail)
+        with span("fused_tiered_query", DEFAULT_REGISTRY):
+            bulk_vals, bulk_ids, tail_vals, tail_ids = fn(
+                self.encoder.params,
+                jnp.asarray(ids_p),
+                jnp.asarray(len_p),
+                ivf._cells,
+                ivf._cell_ids,
+                ivf._centroids,
+                ivf._spill,
+                ivf._spill_ids,
+                tail_dev,
+                jnp.int32(n_live),
+            )
+        bulk_vals = np.asarray(bulk_vals, np.float32)[:n]
+        bulk_ids = np.asarray(bulk_ids)[:n]
+        tail_vals = np.asarray(tail_vals, np.float32)[:n]
+        tail_ids = np.asarray(tail_ids)[:n]
+
+        # host dedup (IVFIndex.search's loop) -> bulk candidate rows
+        from docqa_tpu.index.store import NEG_INF
+
+        bulk_rows = []
+        for qi in range(n):
+            row = []
+            seen = set()
+            for score, rid in zip(bulk_vals[qi], bulk_ids[qi]):
+                if rid < 0 or score <= NEG_INF / 2 or int(rid) in seen:
+                    continue
+                seen.add(int(rid))
+                row.append((float(score), int(rid), ivf._meta[int(rid)]))
+                if len(row) >= k_bulk:
+                    break
+            bulk_rows.append(row)
+
+        # queries only matter to _merge for the under-fill exact fallback;
+        # hand it the raw embeddings-equivalent texts' encodings lazily is
+        # impossible here, so re-encode just the short ones via the store
+        # path inside _merge — pass the normalized embeddings we already
+        # computed?  The program keeps them on device; re-encoding a rare
+        # fallback query host-side is cheaper than always fetching them.
+        q_for_fallback = _FallbackQueries(self.encoder, texts)
+        return tiered._merge(
+            q_for_fallback, bulk_rows, tail_vals, tail_ids, tail_meta,
+            covered, k,
+        )
+
+
+class _FallbackQueries:
+    """Lazy query-embedding view for ``TieredIndex._merge``'s under-fill
+    fallback: ``_merge`` only touches ``queries[short]`` (rare) and
+    ``len(queries)``, so encoding is deferred until a fallback actually
+    fires and then covers only the short queries."""
+
+    def __init__(self, encoder, texts: Sequence[str]):
+        self._encoder = encoder
+        self._texts = list(texts)
+
+    def __len__(self) -> int:
+        return len(self._texts)
+
+    def __getitem__(self, idx) -> np.ndarray:
+        texts = [self._texts[i] for i in idx]
+        return np.asarray(self._encoder.encode_texts(texts), np.float32)

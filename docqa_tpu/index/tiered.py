@@ -144,68 +144,40 @@ class TieredIndex:
 
     # ---- search --------------------------------------------------------------
 
-    def search(
-        self,
-        queries: np.ndarray,
-        k: Optional[int] = None,
-        where: Optional[Callable[[Dict[str, Any]], bool]] = None,
-        filters: Optional[Dict[str, Any]] = None,
-    ) -> List[List[SearchResult]]:
-        self._maybe_background_rebuild()
-        tier = self._tier  # one read: (ivf, covered) stay consistent
-        if tier is None or where is not None or filters:
-            # filtered or pre-IVF: masked exact search is the right tool
-            return self.store.search(queries, k=k, where=where, filters=filters)
-        ivf, covered = tier
+    def _k_bulk(self, k: int, covered: int) -> int:
+        """Candidate fetch size for the IVF tier.
 
-        k = k or self.store.cfg.default_k
-        queries = np.asarray(queries, np.float32)
-        if queries.ndim == 1:
-            queries = queries[None]
-        # tombstoned rows are filtered host-side AFTER top-k; without
-        # headroom a query between rebuilds could return fewer than k live
-        # results even when enough exist in the tier.  The over-fetch is
-        # QUANTIZED to {k, 2k, 4k} — a continuously varying fetch would
-        # recompile the probe/tail kernels on every deletion (both are
-        # jit-specialized on k) — and backstopped by an exact-search
-        # fallback below for the correlated case (deleting one document
-        # tombstones mutually-similar chunks that cluster at the top of
-        # the ranking for related queries, which no fraction-based
-        # headroom can bound).
+        Tombstoned rows are filtered host-side AFTER top-k; without
+        headroom a query between rebuilds could return fewer than k live
+        results even when enough exist in the tier.  The over-fetch is
+        QUANTIZED to {k, 2k, 4k} — a continuously varying fetch would
+        recompile the probe/tail kernels on every deletion (both are
+        jit-specialized on k) — and backstopped by the exact-search
+        fallback in ``_merge`` for the correlated case (deleting one
+        document tombstones mutually-similar chunks that cluster at the
+        top of the ranking for related queries, which no fraction-based
+        headroom can bound)."""
         deleted_frac = self.store.deleted_count / max(self.store.count, 1)
         if deleted_frac == 0:
-            k_bulk = k
-        elif deleted_frac <= 0.25:
-            k_bulk = min(covered, 2 * k)
-        else:
-            k_bulk = min(covered, 4 * k)
-        with span("tiered_search", DEFAULT_REGISTRY):
-            bulk = ivf.search(queries, k=k_bulk, nprobe=self.nprobe)
+            return k
+        if deleted_frac <= 0.25:
+            return min(covered, 2 * k)
+        return min(covered, 4 * k)
 
-            _, _, tail_dev, n_live, tail_meta = self._tail_device(covered)
-            if n_live == 0:
-                # empty tail: bulk-only, but still through the merge loop
-                # below so the under-fill fallback applies
-                vals = np.empty((len(queries), 0), np.float32)
-                ids = np.empty((len(queries), 0), np.int32)
-            else:
-                qn = queries / np.maximum(
-                    np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
-                )
-                # tombstone headroom like the bulk fetch, but never below k:
-                # k_bulk is capped at `covered`, and a tier built over few
-                # rows must not shrink the tail fetch (that would under-fill
-                # every query and force the exact fallback permanently)
-                k_tail = min(max(k_bulk, k), n_live)
-                vals, ids = _tail_kernel(
-                    tail_dev,
-                    jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
-                    jnp.int32(n_live),
-                    k_tail,
-                )
-                vals = np.asarray(vals, np.float32)
-                ids = np.asarray(ids)
-
+    def _merge(
+        self,
+        queries: np.ndarray,
+        bulk: List[List[tuple]],
+        tail_vals: np.ndarray,
+        tail_ids: np.ndarray,
+        tail_meta: List[Dict[str, Any]],
+        covered: int,
+        k: int,
+    ) -> List[List[SearchResult]]:
+        """Host-side tier merge: tombstone filter, score sort, and the
+        exact fallback for under-filled queries.  Shared by the two-step
+        path (``search``) and the fused one-dispatch path
+        (``engines/retrieve.py:FusedTieredRetriever``)."""
         out: List[List[SearchResult]] = []
         short: List[int] = []
         for qi in range(len(queries)):
@@ -217,7 +189,7 @@ class TieredIndex:
                 for s, rid, md in bulk[qi]
                 if not md.get("deleted")
             ]
-            for s, tid in zip(vals[qi], ids[qi]):
+            for s, tid in zip(tail_vals[qi], tail_ids[qi]):
                 if s <= NEG_INF / 2:
                     continue
                 md = tail_meta[int(tid)]
@@ -239,6 +211,59 @@ class TieredIndex:
                 if len(exact[j]) > len(out[qi]):
                     out[qi] = exact[j]
         return out
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: Optional[int] = None,
+        where: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        filters: Optional[Dict[str, Any]] = None,
+    ) -> List[List[SearchResult]]:
+        self._maybe_background_rebuild()
+        tier = self._tier  # one read: (ivf, covered) stay consistent
+        if tier is None or where is not None or filters:
+            # filtered or pre-IVF: masked exact search is the right tool
+            return self.store.search(queries, k=k, where=where, filters=filters)
+        ivf, covered = tier
+
+        k = k or self.store.cfg.default_k
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        k_bulk = self._k_bulk(k, covered)
+        with span("tiered_search", DEFAULT_REGISTRY):
+            bulk = ivf.search(queries, k=k_bulk, nprobe=self.nprobe)
+
+            _, _, tail_dev, n_live, tail_meta = self._tail_device(covered)
+            if n_live == 0:
+                # empty tail: bulk-only, but still through the merge loop
+                # below so the under-fill fallback applies
+                vals = np.empty((len(queries), 0), np.float32)
+                ids = np.empty((len(queries), 0), np.int32)
+            else:
+                qn = queries / np.maximum(
+                    np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+                )
+                # tombstone headroom like the bulk fetch, but never below k
+                # (k_bulk is capped at `covered`), and NOT clamped to
+                # n_live: rows past n_live are NEG_INF-masked and dropped
+                # in the merge, so the quantized ladder value keeps ONE
+                # compiled tail kernel while the tail grows instead of
+                # recompiling per append.  The padded bucket size bounds
+                # top_k's k and only changes when the bucket grows.
+                k_tail = min(max(k_bulk, k), int(tail_dev.shape[0]))
+                vals, ids = _tail_kernel(
+                    tail_dev,
+                    jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
+                    jnp.int32(n_live),
+                    k_tail,
+                )
+                vals = np.asarray(vals, np.float32)
+                ids = np.asarray(ids)
+
+        return self._merge(
+            queries, bulk, vals, ids, tail_meta, covered, k
+        )
 
     def reset(self) -> None:
         """Drop the IVF tier and tail cache (searches fall back to exact

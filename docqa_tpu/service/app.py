@@ -252,13 +252,21 @@ class DocQARuntime:
                 self._snapshot()
         # Fused encode+search retrieval (one dispatch) applies when serving
         # exact search over the plain store with a real device encoder;
-        # tiered/IVF serving and the hash-encoder fake keep the generic
-        # two-step path.
+        # the hash-encoder fake keeps the generic two-step path; real
+        # encoders get the one-dispatch fused program matched to the
+        # serving index (exact store or tiered IVF+tail).
         retriever = None
-        if self.search_index is self.store and not self.cfg.flags.use_fake_encoder:
-            from docqa_tpu.engines.retrieve import FusedRetriever
+        if not self.cfg.flags.use_fake_encoder:
+            if self.search_index is self.store:
+                from docqa_tpu.engines.retrieve import FusedRetriever
 
-            retriever = FusedRetriever(self.encoder, self.store)
+                retriever = FusedRetriever(self.encoder, self.store)
+            else:
+                from docqa_tpu.engines.retrieve import FusedTieredRetriever
+
+                retriever = FusedTieredRetriever(
+                    self.encoder, self.search_index
+                )
         self.qa = QAService(
             self.encoder,
             self.search_index,

@@ -1,0 +1,149 @@
+"""A/B-verify the host-init dispatch theory on the real chip.
+
+docs/PERF.md §1 records the round-2 observation: after running a
+device-side ``jax.random``-based decoder init (~140 random programs), every
+subsequent dispatch in that process cost a flat ~70 ms — so serving engines
+host-init (``numpy`` draw + one transfer) while one-shot bench sections
+device-init.  The theory shaped all serving code but was never A/B
+confirmed.  This script runs both arms in FRESH subprocesses (the
+degradation, if real, is process-sticky) and prints the per-arm dispatch
+latencies.
+
+Usage (on the tunneled chip — do NOT force cpu):
+
+    python scripts/ab_hostinit.py            # both arms
+    python scripts/ab_hostinit.py device     # one arm, in-process
+
+Writes a JSON line per arm; the wrapper prints a verdict comparing
+post-init dispatch medians.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import subprocess
+import sys
+import time
+
+ARM_CODE_SHARED = r"""
+import json, statistics, sys, time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __REPO__)
+from docqa_tpu.config import DecoderConfig
+
+ARM = __ARM__
+
+cfg = DecoderConfig(
+    vocab_size=4096, hidden_dim=512, num_layers=4, num_heads=8,
+    num_kv_heads=8, head_dim=64, mlp_dim=1024, max_seq_len=512,
+)
+
+
+def measure_dispatch(tag, n=50):
+    f = jax.jit(lambda a, b: a @ b)
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    f(x, x).block_until_ready()  # compile
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f(x, x).block_until_ready()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    med = statistics.median(lat)
+    p90 = sorted(lat)[int(0.9 * len(lat))]
+    return {"tag": tag, "median_ms": round(med, 3), "p90_ms": round(p90, 3)}
+
+
+before = measure_dispatch("before_init")
+
+t0 = time.perf_counter()
+from docqa_tpu.models.decoder import init_decoder_params
+
+params = init_decoder_params(
+    jax.random.PRNGKey(0), cfg,
+    param_dtype=jnp.bfloat16,
+    host_init=(ARM == "host"),
+)
+jax.block_until_ready(params)
+init_s = time.perf_counter() - t0
+
+after = measure_dispatch("after_init")
+
+print(json.dumps({
+    "arm": ARM,
+    "init_s": round(init_s, 2),
+    "before": before,
+    "after": after,
+    "degradation_ms": round(after["median_ms"] - before["median_ms"], 3),
+}), flush=True)
+"""
+
+
+def _render(arm: str, repo: str) -> str:
+    # plain token replacement: str.format would trip on the template's
+    # own dict braces
+    return ARM_CODE_SHARED.replace("__ARM__", repr(arm)).replace(
+        "__REPO__", repr(repo)
+    )
+
+
+def run_arm(arm: str, repo: str) -> dict:
+    code = _render(arm, repo)
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    if r.returncode != 0:
+        return {"arm": arm, "error": (r.stderr or r.stdout)[-500:]}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"arm": arm, "error": "no JSON line in output"}
+
+
+def main() -> None:
+    import os
+
+    try:
+        here = os.path.abspath(__file__)
+    except NameError:  # exec'd without __file__ (driver-style)
+        here = os.path.abspath(os.path.join(os.getcwd(), "scripts", "x.py"))
+    repo = os.path.dirname(os.path.dirname(here))
+    if len(sys.argv) > 1:  # single-arm, in-process (driver-style)
+        code = _render(sys.argv[1], repo)
+        exec(compile(code, "<arm>", "exec"), {})
+        return
+    results = {}
+    for arm in ("host", "device"):
+        print(f"== arm: {arm} (fresh process)", flush=True)
+        t0 = time.time()
+        results[arm] = run_arm(arm, repo)
+        print(json.dumps(results[arm]), f"({time.time()-t0:.0f}s)", flush=True)
+    if all("degradation_ms" in r for r in results.values()):
+        d_host = results["host"]["degradation_ms"]
+        d_dev = results["device"]["degradation_ms"]
+        verdict = (
+            "CONFIRMED: device-side random init degrades subsequent "
+            f"dispatches by ~{d_dev:.0f} ms while host init does not "
+            f"({d_host:.1f} ms)"
+            if d_dev > 10 and d_host < 5
+            else "NOT CONFIRMED: dispatch deltas "
+            f"host={d_host:.1f}ms device={d_dev:.1f}ms — update "
+            "docs/PERF.md §1 accordingly"
+        )
+        print(json.dumps({"verdict": verdict, **{
+            f"{k}_degradation_ms": v["degradation_ms"]
+            for k, v in results.items()
+        }}))
+
+
+if __name__ == "__main__":
+    main()

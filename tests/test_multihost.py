@@ -44,7 +44,10 @@ def test_two_process_mesh_and_global_reduction():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=180)
+            # generous: two JAX processes compile on a possibly-contended
+            # CI core (the solo run takes ~6 s; a loaded 1-core box can
+            # stretch far past 3 min)
+            out, err = p.communicate(timeout=600)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:

@@ -33,8 +33,12 @@ CFG = NERConfig(
 def trained_params():
     from docqa_tpu.training.ner import train_ner
 
+    # 550 steps: the round-3 datagen widening (narrative/letter/French/NRP
+    # registers, deid/datagen.py) enlarged the template space, and 350
+    # steps under-fit it (template-eval F1 0.72; 550 restores 0.94 and
+    # lifts the handwritten-eval entity F1 to 0.76)
     return train_ner(
-        CFG, steps=350, batch_size=32, seq=96, lr=2e-3, seed=0, log_every=0
+        CFG, steps=550, batch_size=32, seq=96, lr=2e-3, seed=0, log_every=0
     )
 
 
@@ -142,6 +146,27 @@ class TestContextualPHI:
 
         metrics = evaluate_ner(trained_params, CFG, n_examples=48)
         assert metrics["f1"] >= 0.8, metrics
+
+    def test_handwritten_evalset_floors(self, engine):
+        """Round-3 quality gate (VERDICT item 6): the tagger must clear
+        fixed floors on the HAND-WRITTEN eval set (deid/evalset.py),
+        whose sentences are written in registers the training generator
+        does not emit — this measures generalization, not memorization.
+        Floors sit under the measured values (entity F1 0.76, char F1
+        0.91, span recall 0.95 at this test size) with slack for
+        platform-to-platform training drift.  Typed precision trails
+        recall by design: for a privacy masker the safe failure direction
+        is over-masking, never leaking."""
+        from docqa_tpu.deid.evalset import evaluate_deid
+
+        ev = evaluate_deid(engine)
+        assert ev["span_recall_any"] >= 0.85, ev
+        assert ev["char_f1"] >= 0.75, ev
+        assert ev["entity_f1"] >= 0.50, ev
+        # the two pattern-backed entities must be near-perfect regardless
+        # of tagger quality
+        assert ev["per_entity"]["EMAIL_ADDRESS"]["f1"] >= 0.99, ev
+        assert ev["per_entity"]["DATE_TIME"]["recall"] >= 0.99, ev
 
     def test_six_entity_contract_end_to_end(self, engine):
         # model entities + pattern entities in one document

@@ -102,3 +102,109 @@ class TestFusedMatchesTwoStep:
         rows = retr.search_texts(["ginseng formulas"], k=1)[0]
         assert rows[0].metadata["doc_id"].startswith("d")
         assert rows[0].metadata["text_content"] in texts
+
+
+class TestFusedTiered:
+    """FusedTieredRetriever: encode + IVF probe + tail scan in one program
+    must rank exactly like the two-step encode -> TieredIndex.search."""
+
+    @pytest.fixture(scope="class")
+    def tiered_setup(self):
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+        from docqa_tpu.index.tiered import TieredIndex
+
+        enc = EncoderEngine(TINY)
+        store = VectorStore(StoreConfig(dim=64, shard_capacity=256))
+        texts = [
+            f"note {i}: " + w
+            for i, w in enumerate(
+                [
+                    "aspirin for cardiac prevention",
+                    "metformin manages diabetes",
+                    "ginseng root in formulas",
+                    "persistent headache reported",
+                    "chest pain on exertion",
+                    "influenza vaccination given",
+                    "lisinopril for hypertension",
+                    "atorvastatin at bedtime",
+                    "warfarin with INR checks",
+                    "insulin sliding scale",
+                    "albuterol as needed",
+                    "prednisone taper planned",
+                ]
+            )
+        ]
+        store.add(
+            enc.encode_texts(texts),
+            [
+                {"doc_id": f"d{i}", "source": t, "text_content": t}
+                for i, t in enumerate(texts)
+            ],
+        )
+        tiered = TieredIndex(store, min_rows=4, n_clusters=3, nprobe=3)
+        assert tiered.rebuild()
+        return enc, store, texts, tiered
+
+    def test_matches_two_step_tiered(self, tiered_setup):
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+
+        enc, store, texts, tiered = tiered_setup
+        retr = FusedTieredRetriever(enc, tiered)
+        queries = ["diabetes medication", "heart symptoms"]
+        fused = retr.search_texts(queries, k=4)
+        emb = np.asarray(enc.encode_texts(queries), np.float32)
+        plain = tiered.search(emb, k=4)
+        assert len(fused) == len(plain) == 2
+        for f_row, p_row in zip(fused, plain):
+            assert [r.row_id for r in f_row] == [r.row_id for r in p_row]
+            np.testing.assert_allclose(
+                [r.score for r in f_row],
+                [r.score for r in p_row],
+                rtol=2e-4,
+            )
+
+    def test_tail_rows_visible(self, tiered_setup):
+        """Rows appended after the rebuild live in the exact tail; the
+        fused program must surface them (recall on fresh docs is the
+        reference's defining race, llm-qa/main.py:35)."""
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+
+        enc, store, texts, tiered = tiered_setup
+        fresh = "brand new dermatology consult about psoriasis"
+        store.add(
+            enc.encode_texts([fresh]),
+            [{"doc_id": "fresh", "source": fresh, "text_content": fresh}],
+        )
+        retr = FusedTieredRetriever(enc, tiered)
+        rows = retr.search_texts([fresh], k=3)[0]
+        assert rows and rows[0].metadata["doc_id"] == "fresh"
+
+    def test_pre_tier_falls_back_to_exact(self):
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+        from docqa_tpu.index.tiered import TieredIndex
+
+        enc = EncoderEngine(TINY)
+        store = VectorStore(StoreConfig(dim=64, shard_capacity=256))
+        t = "only one note about metformin"
+        store.add(
+            enc.encode_texts([t]),
+            [{"doc_id": "d0", "source": t, "text_content": t}],
+        )
+        tiered = TieredIndex(store, min_rows=50_000)  # never builds a tier
+        retr = FusedTieredRetriever(enc, tiered)
+        rows = retr.search_texts([t], k=1)[0]
+        assert rows and rows[0].metadata["doc_id"] == "d0"
+
+    def test_tombstones_and_fallback(self, tiered_setup):
+        """Deleted rows must vanish from the fused path too, including the
+        under-fill exact fallback (lazy re-encode of short queries)."""
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+
+        enc, store, texts, tiered = tiered_setup
+        retr = FusedTieredRetriever(enc, tiered)
+        target = retr.search_texts(["warfarin with INR checks"], k=1)[0][0]
+        doc = target.metadata["doc_id"]
+        store.delete_docs([doc])
+        rows = retr.search_texts(["warfarin with INR checks"], k=4)[0]
+        assert all(r.metadata["doc_id"] != doc for r in rows)
+        assert len(rows) == 4  # headroom/fallback keeps the quota
