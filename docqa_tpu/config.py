@@ -107,10 +107,13 @@ class DecoderConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     sliding_window: Optional[int] = None
-    # int8 weight-only quantization (models/quant.py): halves the weight
-    # tree AND the bytes read per decode step — the configuration that
-    # fits a Mistral-7B-class decoder on one 16 GB v5e chip
+    # weight-only quantization (models/quant.py): shrinks the weight tree
+    # AND the bytes read per decode step — the configuration that fits a
+    # Mistral-7B-class decoder on one 16 GB v5e chip.  quant_bits: 8 =
+    # per-channel int8 (w8a16, ~7.2 GB at 7B); 4 = grouped int4 (w4a16,
+    # ~3.6 GB at 7B — the q4 class the reference's Ollama runtime served)
     quantize_weights: bool = False
+    quant_bits: int = 8
 
     @staticmethod
     def mistral_7b() -> "DecoderConfig":

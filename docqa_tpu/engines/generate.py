@@ -71,7 +71,10 @@ class GenerateEngine:
                 )
 
                 params = init_quantized_decoder_params(
-                    jax.random.PRNGKey(seed), cfg, host_init=True
+                    jax.random.PRNGKey(seed),
+                    cfg,
+                    host_init=True,
+                    bits=cfg.quant_bits,
                 )
             else:
                 # host_init: draw on host + device_put per tensor — the same
@@ -96,12 +99,13 @@ class GenerateEngine:
                 # HF checkpoints take) — requires the float tree to fit
                 # transiently; the tensor-by-tensor init path covers
                 # random-init at scales where it doesn't
-                params = quantize_decoder_params(params)
+                params = quantize_decoder_params(params, bits=cfg.quant_bits)
             if param_dtype is not None:
-                # never cast int8 weights or their scales
+                # never cast quantized weights or their scales
                 params = {
                     k: v
-                    if v.dtype == jnp.int8 or k.endswith(SCALE_SUFFIX)
+                    if v.dtype in (jnp.int8, jnp.int4)
+                    or k.endswith(SCALE_SUFFIX)
                     else v.astype(param_dtype)
                     for k, v in params.items()
                 }

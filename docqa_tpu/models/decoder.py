@@ -126,17 +126,26 @@ def _write_cache(cache_layer: jax.Array, new: jax.Array, offsets: jax.Array):
 
 
 def _weight(params: Params, name: str, dtype) -> jax.Array:
-    """Weight fetch with transparent int8 dequantization (models/quant.py):
-    ``q.astype(dtype) * scale`` feeds the consuming matmul directly — XLA
-    fuses the convert+scale into the dot's operand read, so int8 halves the
-    HBM bytes per decode step without a materialized float copy."""
+    """Weight fetch with transparent int8/int4 dequantization
+    (models/quant.py): ``q.astype(dtype) * scale`` feeds the consuming
+    matmul directly — XLA fuses the convert+scale into the dot's operand
+    read, so the quantized bytes are what HBM serves per decode step, with
+    no materialized float copy.  A 1-D scale is int8 per-output-channel; a
+    2-D scale is int4 grouped along the ``in`` axis."""
     from docqa_tpu.models.quant import SCALE_SUFFIX
 
     w = params[name]
     scale = params.get(name + SCALE_SUFFIX)
     if scale is None:
         return w.astype(dtype)
-    return w.astype(dtype) * scale.astype(dtype)[None, :]
+    if scale.ndim == 1:  # int8: scale [out]
+        return w.astype(dtype) * scale.astype(dtype)[None, :]
+    # int4: scale [groups, out], group g = in // groups
+    in_dim, out_dim = w.shape
+    groups = scale.shape[0]
+    wf = w.astype(dtype).reshape(groups, in_dim // groups, out_dim)
+    wf = wf * scale.astype(dtype)[:, None, :]
+    return wf.reshape(in_dim, out_dim)
 
 
 def decoder_forward(

@@ -1,35 +1,43 @@
-"""Int8 weight-only quantization (w8a16) for the decoder.
+"""Int8 (w8a16) and grouped-int4 (w4a16) weight-only decoder quantization.
 
 Why this exists: BASELINE config 3 names a Mistral-7B-class generator
 (reference: Ollama/llama.cpp host-side, ``llm-qa/main.py:66-69``), but one
 v5e chip has 16 GB HBM and a 7B bf16 weight tree is ~14.5 GB — it OOMs
 once the KV cache and XLA workspace join it (measured).  Weight-only int8
 halves the tree to ~7.2 GB *and* halves the bytes read per decode step,
-which is the whole cost of bandwidth-bound decoding.
+which is the whole cost of bandwidth-bound decoding.  Int4 halves it
+again (~3.6 GB at 7B) — the llama.cpp default the reference actually ran
+(Ollama ships q4 GGUF) — at the cost of a coarser grid.
 
-Scheme: per-output-channel absmax.  For each 2-D weight ``w [in, out]``:
+Schemes (for each 2-D weight ``w [in, out]``):
 
-    scale[out] = max(|w|, axis=in) / 127
-    q[in, out] = round(w / scale)  as int8
+* **int8, per-output-channel absmax** —
+  ``scale[out] = max(|w|, axis=in) / 127``; worst-case relative weight
+  error ≤ 1/254.  No grouping needed at 8 bits.
+* **int4, grouped absmax** — 15 levels is too coarse for a whole input
+  column, so rows are grouped along ``in`` (default 128, llama.cpp/AWQ
+  convention): ``scale[in//g, out] = absmax over the group / 7``.  The
+  scale overhead is one f32 per 128 int4s (~6%).
 
 The forward pass dequantizes in-kernel — ``q.astype(bf16) * scale`` feeds
 the matmul directly, and XLA fuses the convert+multiply into the dot's
-operand read, so the dequantized tree never materializes in HBM.
-Activations stay bf16 (w8a16): no calibration data needed, and per-channel
-absmax keeps the worst-case relative weight error ≤ 1/254.
+operand read, so the dequantized tree never materializes in HBM (the
+grouped variant reshapes ``[in, out] → [groups, g, out]`` for the
+broadcast; XLA TPU stores int4 packed two-per-byte).  Activations stay
+bf16: no calibration data needed.
 
 Embeddings and norm gains stay in bf16/f32: ``tok_emb`` is a gather (only
 ``seq`` rows read per step — no bandwidth win) and norm vectors are tiny.
 
 Memory discipline: ``init_quantized_decoder_params`` quantizes tensor-by-
-tensor as it initializes, so peak HBM is the int8 tree plus ONE float
+tensor as it initializes, so peak HBM is the quantized tree plus ONE float
 tensor — a quantize-after-full-init would need bf16 + int8 simultaneously
 (~21 GB at 7B, un-materializable on the target chip).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,8 +48,19 @@ Params = Dict[str, jax.Array]
 
 SCALE_SUFFIX = "__scale"
 
+GROUP_SIZE = 128  # int4 grouping along the `in` axis (llama.cpp/AWQ size)
+
 # 2-D matmul weights that quantize; everything else passes through
 _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _int4_group(in_dim: int, group: Optional[int] = None) -> int:
+    """Largest usable group ≤ GROUP_SIZE that divides ``in_dim`` (tiny test
+    configs have in_dim < 128)."""
+    g = min(group or GROUP_SIZE, in_dim)
+    while in_dim % g:
+        g -= 1
+    return g
 
 
 def is_quantized(params: Params) -> bool:
@@ -64,12 +83,40 @@ def quantize_array(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q, scale
 
 
-def quantize_decoder_params(params: Params) -> Params:
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _quantize_int4_jit(w: jax.Array, g: int) -> Tuple[jax.Array, jax.Array]:
+    in_dim, out_dim = w.shape
+    w32 = w.astype(jnp.float32).reshape(in_dim // g, g, out_dim)
+    scale = jnp.max(jnp.abs(w32), axis=1) / 7.0  # [groups, out]
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale[:, None, :]), -7, 7)
+    return q.reshape(in_dim, out_dim).astype(jnp.int4), scale
+
+
+def quantize_array_int4(
+    w: jax.Array, group: Optional[int] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """w [in, out] → (int4 [in, out], f32 scale [in//g, out]) grouped
+    absmax.  Fused under jit like ``quantize_array``: the eager op
+    sequence would materialize several f32 temporaries per tensor on the
+    transient-fit checkpoint-quantization path."""
+    g = _int4_group(w.shape[0], group)
+    return _quantize_int4_jit(w, g)
+
+
+def quantize_decoder_params(params: Params, bits: int = 8) -> Params:
     """Quantize an existing float tree (fits when the float tree fits)."""
+    if bits not in (4, 8):
+        raise ValueError(f"quantization bits must be 4 or 8, got {bits}")
     out: Params = {}
     for name, w in params.items():
         if should_quantize(name) and w.ndim == 2:
-            q, scale = quantize_array(w)
+            q, scale = (
+                quantize_array(w) if bits == 8 else quantize_array_int4(w)
+            )
             out[name] = q
             out[name + SCALE_SUFFIX] = scale
         else:
@@ -78,7 +125,10 @@ def quantize_decoder_params(params: Params) -> Params:
 
 
 def init_quantized_decoder_params(
-    rng: jax.Array, cfg: DecoderConfig, host_init: bool = False
+    rng: jax.Array,
+    cfg: DecoderConfig,
+    host_init: bool = False,
+    bits: int = 8,
 ) -> Params:
     """Random-init directly into int8 — tensor-by-tensor, so a 7B tree
     peaks at ~7.2 GB + one float tensor instead of bf16+int8 together.
@@ -98,7 +148,12 @@ def init_quantized_decoder_params(
 
     import numpy as _np
 
+    if bits not in (4, 8):
+        raise ValueError(f"quantization bits must be 4 or 8, got {bits}")
+
     if host_init:
+        import ml_dtypes as _ml
+
         seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
         host_rng = _np.random.default_rng(seed)
         out: Params = {}
@@ -109,7 +164,7 @@ def init_quantized_decoder_params(
             w = host_rng.standard_normal(shape, _np.float32) * (
                 fan_in ** -0.5
             )
-            if should_quantize(name):
+            if should_quantize(name) and bits == 8:
                 scale = _np.maximum(
                     _np.max(_np.abs(w), axis=0) / 127.0, 1e-12
                 ).astype(_np.float32)
@@ -117,6 +172,18 @@ def init_quantized_decoder_params(
                     _np.round(w / scale[None, :]), -127, 127
                 ).astype(_np.int8)
                 out[name] = jax.device_put(q)
+                out[name + SCALE_SUFFIX] = jax.device_put(scale)
+            elif should_quantize(name):  # int4, grouped
+                in_dim, out_dim = shape
+                g = _int4_group(in_dim)
+                wg = w.reshape(in_dim // g, g, out_dim)
+                scale = _np.maximum(
+                    _np.max(_np.abs(wg), axis=1) / 7.0, 1e-12
+                ).astype(_np.float32)
+                q = _np.clip(_np.round(wg / scale[:, None, :]), -7, 7)
+                out[name] = jax.device_put(
+                    q.reshape(in_dim, out_dim).astype(_ml.int4)
+                )
                 out[name + SCALE_SUFFIX] = jax.device_put(scale)
             else:
                 out[name] = jax.device_put(w.astype(jnp.bfloat16))
@@ -133,7 +200,9 @@ def init_quantized_decoder_params(
             fan_in ** -0.5
         )
         if should_quantize(name):
-            q, scale = quantize_array(w)
+            q, scale = (
+                quantize_array(w) if bits == 8 else quantize_array_int4(w)
+            )
             out[name] = q
             out[name + SCALE_SUFFIX] = scale
         else:

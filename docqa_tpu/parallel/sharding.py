@@ -63,16 +63,26 @@ def shard_decoder_params(params, cfg: DecoderConfig, mesh: MeshContext):
 
     specs = decoder_param_pspecs(cfg, mesh.model_axis)
 
-    def spec_for(name):
+    def spec_for(name, v):
         if name.endswith(SCALE_SUFFIX):
-            # per-output-channel int8 scale [out] follows its weight's
-            # output-dim sharding (models/quant.py)
+            # scales mirror their weight's sharding (models/quant.py):
+            # int8 scale [out] → P(out_spec); int4 grouped scale
+            # [groups, out] → the weight's own spec, because groups ride
+            # the in axis (sharded for row-parallel wo/w_down, replicated
+            # for column-parallel).  When a group spans shards (groups
+            # not divisible — tiny configs), replicate the groups axis:
+            # GSPMD broadcasts it into the dequant either way.
             base = specs[name[: -len(SCALE_SUFFIX)]]
-            return P(base[1])
+            if v.ndim == 1:
+                return P(base[1])
+            d0 = base[0]
+            if d0 is not None and v.shape[0] % mesh.mesh.shape[d0]:
+                d0 = None
+            return P(d0, base[1])
         return specs[name]
 
     return {
-        k: jax.device_put(v, NamedSharding(mesh.mesh, spec_for(k)))
+        k: jax.device_put(v, NamedSharding(mesh.mesh, spec_for(k, v)))
         for k, v in params.items()
     }
 

@@ -1,0 +1,122 @@
+"""Tiny-scale rehearsal of the bench's TPU-only call shapes.
+
+The `small` (CPU smoke) bench run never executes the 7B sections, the
+knob sweeps, or the speculation arms — so a signature typo there would
+only surface on the real chip, wasting a hardware window.  These tests
+execute the exact same API sequences at toy sizes on CPU.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from docqa_tpu.config import DecoderConfig, GenerateConfig
+
+
+TINY = DecoderConfig(
+    vocab_size=256, hidden_dim=32, num_layers=1, num_heads=4,
+    num_kv_heads=4, head_dim=8, mlp_dim=64, max_seq_len=128,
+)
+
+
+class TestBenchSevenBShapes:
+    def test_quantized_host_init_engine_path(self):
+        """bench config 3c: init_quantized_decoder_params(host_init=True)
+        -> GenerateEngine(cfg, GenerateConfig, params=...) ->
+        generate_ids, exactly the bench's call sequence."""
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.models.quant import init_quantized_decoder_params
+
+        params8 = init_quantized_decoder_params(
+            jax.random.PRNGKey(0), TINY, host_init=True
+        )
+        eng = GenerateEngine(
+            TINY,
+            GenerateConfig(max_new_tokens=8, prefill_buckets=(16,)),
+            params=params8,
+        )
+        out = eng.generate_ids([[5, 9, 11]], max_new_tokens=8)
+        assert len(out[0]) <= 8
+
+    def test_speculation_sweep_engine_variants(self):
+        """bench headline sweep: engines sharing one params tree with
+        speculative_k in {0, 4, 8} must produce identical greedy output
+        (speculation is output-exact by construction)."""
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.models.quant import init_quantized_decoder_params
+
+        params8 = init_quantized_decoder_params(
+            jax.random.PRNGKey(0), TINY, host_init=True
+        )
+        outs = []
+        for spec_k in (0, 4, 8):
+            eng = GenerateEngine(
+                TINY,
+                GenerateConfig(
+                    max_new_tokens=12,
+                    prefill_buckets=(16,),
+                    speculative_k=spec_k,
+                ),
+                params=params8,
+            )
+            outs.append(eng.generate_ids([[5, 9, 11]], max_new_tokens=12)[0])
+            del eng
+        assert outs[0] == outs[1] == outs[2]
+
+    def test_bf16_device_init_engine_path(self):
+        """bench config 3b: init_decoder_params(param_dtype=bf16) ->
+        engine -> generate_ids."""
+        import jax.numpy as jnp
+
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.models.decoder import init_decoder_params
+
+        params7 = init_decoder_params(
+            jax.random.PRNGKey(0), TINY, param_dtype=jnp.bfloat16
+        )
+        eng = GenerateEngine(
+            TINY,
+            GenerateConfig(max_new_tokens=8, prefill_buckets=(16,)),
+            params=params7,
+        )
+        assert eng.generate_ids([[5, 9, 11]], max_new_tokens=8)
+
+
+class TestBenchLoadSweepShapes:
+    def test_batcher_32_slots_and_spec(self):
+        """bench sweep combos use n_slots up to 32 and a speculative
+        engine through the same ContinuousBatcher kwargs."""
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        eng = GenerateEngine(
+            TINY,
+            GenerateConfig(
+                max_new_tokens=8, prefill_buckets=(16,), speculative_k=4
+            ),
+        )
+        b = ContinuousBatcher(eng, n_slots=32, chunk=32, cache_len=128)
+        try:
+            prompts = [[7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(40)]
+            handles = [b.submit_ids(p, max_new_tokens=8) for p in prompts]
+            results = [h.result(timeout=120) for h in handles]
+            assert len(results) == 40
+            assert all(len(r) <= 8 for r in results)
+        finally:
+            b.stop()
+
+    def test_delta_windowed_histogram_math(self):
+        """bench 5b's serve_tokens_per_chunk delta-mean formula."""
+        from docqa_tpu.runtime.metrics import Histogram
+
+        h = Histogram("x")
+        for v in (2.0, 4.0):
+            h.observe(v)  # the "config 5" contamination
+        count0 = h.count
+        sum0 = (h.mean * count0) if count0 else 0.0
+        for v in (10.0, 20.0, 30.0):
+            h.observe(v)  # the "config 5b" window
+        d_count = h.count - count0
+        delta_mean = (h.mean * h.count - sum0) / d_count
+        assert abs(delta_mean - 20.0) < 1e-9

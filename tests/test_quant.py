@@ -219,3 +219,132 @@ class TestQuantizedTP:
             cfg, gen_cfg, params=qparams, mesh=mesh_tp8
         ).generate_ids([[5, 9, 11]])[0]
         assert tp == solo  # TP sharding of int8+scales is numerics-neutral
+
+
+class TestInt4:
+    def test_grouped_roundtrip_error_bounded(self):
+        from docqa_tpu.models.quant import quantize_array_int4
+
+        w = jnp.asarray(
+            np.random.default_rng(0).normal(size=(256, 32)).astype(np.float32)
+        )
+        q, scale = quantize_array_int4(w)
+        assert str(q.dtype) == "int4"
+        assert scale.shape == (2, 32)  # 256 / group(128)
+        deq = (
+            np.asarray(q, np.float32).reshape(2, 128, 32)
+            * np.asarray(scale)[:, None, :]
+        ).reshape(256, 32)
+        err = np.abs(deq - np.asarray(w))
+        # per-group absmax: error bounded by half a step of that group
+        bound = np.repeat(np.asarray(scale), 128, axis=0) * 0.5 + 1e-7
+        assert np.all(err <= bound)
+
+    def test_small_in_dim_group_clamps(self):
+        from docqa_tpu.models.quant import quantize_array_int4
+
+        w = jnp.ones((48, 8), jnp.float32)
+        q, scale = quantize_array_int4(w)
+        assert scale.shape[0] * (48 // scale.shape[0]) == 48
+
+    def test_int4_forward_close(self):
+        params = init_decoder_params(jax.random.PRNGKey(0), CFG)
+        q4 = quantize_decoder_params(params, bits=4)
+        ids = np.array([[3, 9, 17, 4]], np.int32)
+        lengths = np.array([4], np.int32)
+
+        def run(p):
+            cache = init_kv_cache(CFG, 1, max_len=32)
+            logits, _ = decoder_forward(
+                p, CFG, ids, cache, np.zeros((1,), np.int32),
+                attn_lengths=lengths,
+            )
+            return np.asarray(logits)
+
+        full = run(params)
+        quant = run(q4)
+        denom = max(float(np.std(full)), 1e-6)
+        rel = float(np.max(np.abs(full - quant))) / denom
+        # grouped int4 at this TINY config degenerates to per-column
+        # (hidden 64 < group 128 → one group), the worst case for 15
+        # levels; real configs get 32+ groups per column.  The bound here
+        # only guards against a broken dequant (order-of-magnitude blowup
+        # or NaN), not production quality.
+        assert np.isfinite(rel) and rel < 3.0, rel
+
+    def test_int4_greedy_generation_deterministic(self):
+        """Int4 generation must be internally deterministic (same engine,
+        same prompt, same greedy tokens) and produce a non-trivial
+        rollout — guards a dequant regression that a single loose
+        forward-error bound would miss."""
+        gen_cfg = GenerateConfig(max_new_tokens=16, prefill_buckets=(16,))
+        params = init_decoder_params(jax.random.PRNGKey(3), CFG)
+        eng = GenerateEngine(
+            CFG, gen_cfg, params=quantize_decoder_params(params, bits=4)
+        )
+        a = eng.generate_ids([[5, 9, 11]])[0]
+        b = eng.generate_ids([[5, 9, 11]])[0]
+        assert a == b
+        assert len(a) >= 4, a
+        # no float-prefix expectation here: at this TINY config the group
+        # degenerates to the whole 64-row column (15 levels), where greedy
+        # divergence from float at token 1 is legitimate; the roundtrip
+        # bound test above covers dequant numerics at real group shapes
+
+    def test_int4_engine_via_config_knob(self):
+        import dataclasses
+
+        cfg4 = dataclasses.replace(CFG, quantize_weights=True, quant_bits=4)
+        eng = GenerateEngine(
+            cfg4, GenerateConfig(max_new_tokens=8, prefill_buckets=(16,))
+        )
+        assert any(str(v.dtype) == "int4" for v in eng.params.values())
+        out = eng.generate_ids([[5, 9, 11]], max_new_tokens=8)[0]
+        assert len(out) <= 8
+
+    def test_int4_host_init_matches_device_init_structure(self):
+        a = init_quantized_decoder_params(
+            jax.random.PRNGKey(0), CFG, host_init=True, bits=4
+        )
+        b = init_quantized_decoder_params(
+            jax.random.PRNGKey(0), CFG, host_init=False, bits=4
+        )
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k].shape == b[k].shape, k
+            assert a[k].dtype == b[k].dtype, k
+
+    def test_int4_tree_is_half_of_int8(self):
+        p8 = init_quantized_decoder_params(jax.random.PRNGKey(0), CFG, bits=8)
+        p4 = init_quantized_decoder_params(jax.random.PRNGKey(0), CFG, bits=4)
+
+        def quant_bits_total(p, nbits):
+            total = 0
+            for k, v in p.items():
+                if str(v.dtype).startswith("int"):
+                    total += int(np.prod(v.shape)) * nbits
+            return total
+
+        assert quant_bits_total(p4, 4) * 2 == quant_bits_total(p8, 8)
+
+    def test_int4_tp_sharding_compiles(self):
+        import dataclasses
+
+        from docqa_tpu.runtime.mesh import host_cpu_mesh
+
+        mesh = host_cpu_mesh(8, data=1)
+        cfg4 = dataclasses.replace(
+            CFG,
+            quantize_weights=True,
+            quant_bits=4,
+            num_heads=8,
+            num_kv_heads=8,
+            head_dim=8,
+        )
+        eng = GenerateEngine(
+            cfg4,
+            GenerateConfig(max_new_tokens=4, prefill_buckets=(16,)),
+            mesh=mesh,
+        )
+        out = eng.generate_ids([[5, 9, 11]], max_new_tokens=4)[0]
+        assert len(out) <= 4
