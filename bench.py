@@ -794,6 +794,45 @@ def main() -> None:
         gen4 = params4 = None
         try:
             cfg7 = DecoderConfig.mistral_7b()
+            # fusion probe BEFORE allocating the tree: if the backend
+            # materializes the dequantized bf16 weight instead of fusing
+            # the grouped dequant into the dot, the temp allocation shows
+            # it here (one mlp weight = 117 MB bf16) and the section's
+            # tok/s will confirm — record both, never assume
+            try:
+                import jax.numpy as _jnp
+
+                from docqa_tpu.models.decoder import _qmatmul
+
+                _g = 128
+                _probe_p = {
+                    "w": _jnp.zeros(
+                        (cfg7.mlp_dim // _g, _g, cfg7.hidden_dim),
+                        _jnp.int4,
+                    ),
+                    "w__scale": _jnp.zeros(
+                        (cfg7.mlp_dim // _g, cfg7.hidden_dim), _jnp.float32
+                    ),
+                }
+                _x = _jnp.zeros((1, cfg7.mlp_dim), _jnp.bfloat16)
+                _ma = (
+                    jax.jit(
+                        lambda x, p: _qmatmul(x, p, "w", _jnp.bfloat16)
+                    )
+                    .lower(_x, _probe_p)
+                    .compile()
+                    .memory_analysis()
+                )
+                DETAILS["int4_fusion_probe"] = {
+                    "temp_bytes": int(_ma.temp_size_in_bytes),
+                    "materialized_tree_bytes": cfg7.mlp_dim
+                    * cfg7.hidden_dim
+                    * 2,
+                }
+                log(f"int4 fusion probe: {DETAILS['int4_fusion_probe']}")
+                del _probe_p, _x
+            except Exception as e:
+                log(f"int4 fusion probe failed: {e!r}")
             params4 = init_quantized_decoder_params(
                 jax.random.PRNGKey(0), cfg7, host_init=True, bits=4
             )

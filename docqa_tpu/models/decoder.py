@@ -125,27 +125,32 @@ def _write_cache(cache_layer: jax.Array, new: jax.Array, offsets: jax.Array):
     return jax.vmap(one)(cache_layer, new, offsets)
 
 
-def _weight(params: Params, name: str, dtype) -> jax.Array:
-    """Weight fetch with transparent int8/int4 dequantization
-    (models/quant.py): ``q.astype(dtype) * scale`` feeds the consuming
-    matmul directly — XLA fuses the convert+scale into the dot's operand
-    read, so the quantized bytes are what HBM serves per decode step, with
-    no materialized float copy.  A 1-D scale is int8 per-output-channel; a
-    2-D scale is int4 grouped along the ``in`` axis."""
+def _qmatmul(x: jax.Array, params: Params, name: str, dtype) -> jax.Array:
+    """``x [..., in] @ W`` with dequantization fused into the dot.
+
+    int8 (2-D store, scale [out]): broadcast-scale the operand — XLA fuses
+    the convert+multiply into the dot read (proven on hardware: the 7B
+    int8 engine runs in 16 GB and beats bf16 tok/s, impossible with a
+    materialized tree).  int4 (3-D grouped store [groups, g, out], scale
+    [groups, out]): the SAME producer shape — pure broadcast multiply, no
+    reshape between the multiply and the dot — contracted over both group
+    axes via ``dot_general``; the activation-side regroup is a free
+    reshape of the small operand."""
     from docqa_tpu.models.quant import SCALE_SUFFIX
 
     w = params[name]
     scale = params.get(name + SCALE_SUFFIX)
     if scale is None:
-        return w.astype(dtype)
-    if scale.ndim == 1:  # int8: scale [out]
-        return w.astype(dtype) * scale.astype(dtype)[None, :]
-    # int4: scale [groups, out], group g = in // groups
-    in_dim, out_dim = w.shape
-    groups = scale.shape[0]
-    wf = w.astype(dtype).reshape(groups, in_dim // groups, out_dim)
-    wf = wf * scale.astype(dtype)[:, None, :]
-    return wf.reshape(in_dim, out_dim)
+        return x @ w.astype(dtype)
+    if w.ndim == 2:  # int8
+        return x @ (w.astype(dtype) * scale.astype(dtype)[None, :])
+    groups, g, _out = w.shape  # int4 grouped
+    wf = w.astype(dtype) * scale.astype(dtype)[:, None, :]
+    x3 = x.reshape(*x.shape[:-1], groups, g)
+    n = x3.ndim
+    return jax.lax.dot_general(
+        x3, wf, (((n - 2, n - 1), (0, 1)), ((), ()))
+    )
 
 
 def decoder_forward(
@@ -183,13 +188,13 @@ def decoder_forward(
 
     for i in range(cfg.num_layers):
         y = rms_norm(x, params[f"l{i}_attn_norm_g"], cfg.norm_eps)
-        q = (y @ _weight(params, f"l{i}_wq", dtype)).reshape(
+        q = _qmatmul(y, params, f"l{i}_wq", dtype).reshape(
             b, s, cfg.num_heads, cfg.head_dim
         )
-        k = (y @ _weight(params, f"l{i}_wk", dtype)).reshape(
+        k = _qmatmul(y, params, f"l{i}_wk", dtype).reshape(
             b, s, cfg.num_kv_heads, cfg.head_dim
         )
-        v = (y @ _weight(params, f"l{i}_wv", dtype)).reshape(
+        v = _qmatmul(y, params, f"l{i}_wv", dtype).reshape(
             b, s, cfg.num_kv_heads, cfg.head_dim
         )
         q = apply_rope(q, cos, sin, positions)
@@ -208,20 +213,20 @@ def decoder_forward(
             sliding_window=cfg.sliding_window,
         )
         attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim)
-        x = x + (attn @ _weight(params, f"l{i}_wo", dtype))
+        x = x + _qmatmul(attn, params, f"l{i}_wo", dtype)
 
         y = rms_norm(x, params[f"l{i}_mlp_norm_g"], cfg.norm_eps)
-        gate = y @ _weight(params, f"l{i}_w_gate", dtype)
-        up = y @ _weight(params, f"l{i}_w_up", dtype)
+        gate = _qmatmul(y, params, f"l{i}_w_gate", dtype)
+        up = _qmatmul(y, params, f"l{i}_w_up", dtype)
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(dtype) * up
-        x = x + (act @ _weight(params, f"l{i}_w_down", dtype))
+        x = x + _qmatmul(act, params, f"l{i}_w_down", dtype)
 
     if last_token_only and s > 1:
         # prefill path: only the last valid row per lane feeds sampling —
         # skip the [s, vocab] lm_head matmul for the rest (~s x fewer FLOPs)
         x = jnp.take_along_axis(x, (new_lengths - 1)[:, None, None], axis=1)
     x = rms_norm(x, params["final_norm_g"], cfg.norm_eps)
-    logits = (x @ _weight(params, "lm_head", dtype)).astype(jnp.float32)
+    logits = _qmatmul(x, params, "lm_head", dtype).astype(jnp.float32)
     return logits, cache
 
 

@@ -21,10 +21,12 @@ Schemes (for each 2-D weight ``w [in, out]``):
 
 The forward pass dequantizes in-kernel — ``q.astype(bf16) * scale`` feeds
 the matmul directly, and XLA fuses the convert+multiply into the dot's
-operand read, so the dequantized tree never materializes in HBM (the
-grouped variant reshapes ``[in, out] → [groups, g, out]`` for the
-broadcast; XLA TPU stores int4 packed two-per-byte).  Activations stay
-bf16: no calibration data needed.
+operand read, so the dequantized tree never materializes in HBM.  The
+int4 weight is STORED grouped-3-D ``[groups, g, out]`` so its dequant is
+the same reshape-free broadcast-multiply producer shape as int8's (see
+``decoder._qmatmul``; a 2-D store would interpose reshapes the compiler
+may refuse to fuse through).  XLA TPU stores int4 packed two-per-byte.
+Activations stay bf16: no calibration data needed.
 
 Embeddings and norm gains stay in bf16/f32: ``tok_emb`` is a gather (only
 ``seq`` rows read per step — no bandwidth win) and norm vectors are tiny.
@@ -37,6 +39,7 @@ tensor — a quantize-after-full-init would need bf16 + int8 simultaneously
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -83,9 +86,6 @@ def quantize_array(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q, scale
 
 
-import functools
-
-
 @functools.partial(jax.jit, static_argnums=(1,))
 def _quantize_int4_jit(w: jax.Array, g: int) -> Tuple[jax.Array, jax.Array]:
     in_dim, out_dim = w.shape
@@ -93,16 +93,24 @@ def _quantize_int4_jit(w: jax.Array, g: int) -> Tuple[jax.Array, jax.Array]:
     scale = jnp.max(jnp.abs(w32), axis=1) / 7.0  # [groups, out]
     scale = jnp.maximum(scale, 1e-12)
     q = jnp.clip(jnp.round(w32 / scale[:, None, :]), -7, 7)
-    return q.reshape(in_dim, out_dim).astype(jnp.int4), scale
+    return q.astype(jnp.int4), scale
 
 
 def quantize_array_int4(
     w: jax.Array, group: Optional[int] = None
 ) -> Tuple[jax.Array, jax.Array]:
-    """w [in, out] → (int4 [in, out], f32 scale [in//g, out]) grouped
-    absmax.  Fused under jit like ``quantize_array``: the eager op
-    sequence would materialize several f32 temporaries per tensor on the
-    transient-fit checkpoint-quantization path."""
+    """w [in, out] → (int4 [in//g, g, out], f32 scale [in//g, out])
+    grouped absmax.
+
+    The quantized weight is STORED 3-D, grouped layout — dequant is then
+    a pure broadcast multiply (``q.astype(bf16) * scale[:, None, :]``)
+    feeding a two-axis ``dot_general``, the same producer shape XLA
+    provably fuses into the dot's operand read for the int8 path.  A 2-D
+    store would need reshape(dequant(reshape)) around the multiply, a
+    pattern the compiler may materialize as a full bf16 tree (14.5 GB at
+    7B — un-servable).  Fused under jit like ``quantize_array``: the
+    eager op sequence would materialize several f32 temporaries per
+    tensor on the transient-fit checkpoint-quantization path."""
     g = _int4_group(w.shape[0], group)
     return _quantize_int4_jit(w, g)
 
@@ -173,7 +181,7 @@ def init_quantized_decoder_params(
                 ).astype(_np.int8)
                 out[name] = jax.device_put(q)
                 out[name + SCALE_SUFFIX] = jax.device_put(scale)
-            elif should_quantize(name):  # int4, grouped
+            elif should_quantize(name):  # int4, grouped (3-D store)
                 in_dim, out_dim = shape
                 g = _int4_group(in_dim)
                 wg = w.reshape(in_dim // g, g, out_dim)
@@ -181,9 +189,7 @@ def init_quantized_decoder_params(
                     _np.max(_np.abs(wg), axis=1) / 7.0, 1e-12
                 ).astype(_np.float32)
                 q = _np.clip(_np.round(wg / scale[:, None, :]), -7, 7)
-                out[name] = jax.device_put(
-                    q.reshape(in_dim, out_dim).astype(_ml.int4)
-                )
+                out[name] = jax.device_put(q.astype(_ml.int4))
                 out[name + SCALE_SUFFIX] = jax.device_put(scale)
             else:
                 out[name] = jax.device_put(w.astype(jnp.bfloat16))

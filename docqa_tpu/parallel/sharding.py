@@ -79,7 +79,17 @@ def shard_decoder_params(params, cfg: DecoderConfig, mesh: MeshContext):
             if d0 is not None and v.shape[0] % mesh.mesh.shape[d0]:
                 d0 = None
             return P(d0, base[1])
-        return specs[name]
+        spec = specs[name]
+        if v.ndim == 3 and len(spec) == 2:
+            # int4 grouped 3-D store [groups, g, out] for a 2-D weight
+            # spec [in, out]: the in-axis sharding moves to the groups
+            # axis (whole groups per shard keeps scale rows local); the
+            # in-group axis is never sharded
+            d0 = spec[0]
+            if d0 is not None and v.shape[0] % mesh.mesh.shape[d0]:
+                d0 = None  # a group would span shards: replicate instead
+            return P(d0, None, spec[1])
+        return spec
 
     return {
         k: jax.device_put(v, NamedSharding(mesh.mesh, spec_for(k, v)))

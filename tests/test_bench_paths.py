@@ -6,10 +6,7 @@ only surface on the real chip, wasting a hardware window.  These tests
 execute the exact same API sequences at toy sizes on CPU.
 """
 
-import dataclasses
-
 import jax
-import numpy as np
 
 from docqa_tpu.config import DecoderConfig, GenerateConfig
 

@@ -230,10 +230,10 @@ class TestInt4:
         )
         q, scale = quantize_array_int4(w)
         assert str(q.dtype) == "int4"
+        assert q.shape == (2, 128, 32)  # 3-D grouped store (fusion-safe)
         assert scale.shape == (2, 32)  # 256 / group(128)
         deq = (
-            np.asarray(q, np.float32).reshape(2, 128, 32)
-            * np.asarray(scale)[:, None, :]
+            np.asarray(q, np.float32) * np.asarray(scale)[:, None, :]
         ).reshape(256, 32)
         err = np.abs(deq - np.asarray(w))
         # per-group absmax: error bounded by half a step of that group
