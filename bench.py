@@ -47,6 +47,23 @@ def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
+def flush_details() -> None:
+    """Write bench_details.json NOW — called after every section so a
+    driver-side timeout mid-run still leaves every completed measurement
+    on disk."""
+    try:
+        with open(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "bench_details.json",
+            ),
+            "w",
+        ) as f:
+            json.dump(DETAILS, f, indent=2)
+    except Exception as e:
+        log(f"details write failed: {e!r}")
+
+
 def timed(fn, n=1):
     t0 = time.perf_counter()
     for _ in range(n):
@@ -151,6 +168,10 @@ def main() -> None:
     mesh = make_mesh() if jax.device_count() > 1 else None
     DETAILS["backend"] = backend
     DETAILS["n_chunks"] = n_chunks
+    # sections that are slow and NOT headline-critical (long compiles,
+    # training) run after the summary line is already printed, so a
+    # driver-side timeout cannot cost the round its headline
+    late_sections = []
 
     # ---- corpus: 1M clustered chunks, HBM-resident -------------------------
     rng = np.random.default_rng(0)
@@ -205,6 +226,7 @@ def main() -> None:
         f"exact top-10 @ {n_chunks}: {t_search*1e3:.1f}ms, "
         f"fused text->top-10: {t_fused*1e3:.1f}ms"
     )
+    flush_details()
 
     # ---- IVF / tiered: recall@10 + latency vs exact -------------------------
     try:
@@ -258,6 +280,7 @@ def main() -> None:
     except Exception as e:  # keep the headline alive
         log(f"ivf bench failed: {e!r}")
         DETAILS["ivf"] = {"error": repr(e)}
+    flush_details()
 
     # ---- headline: e2e QA latency (solo requests) ---------------------------
     # The serving default is int8 weight-only (w8a16, models/quant.py):
@@ -338,6 +361,7 @@ def main() -> None:
     }
     DETAILS["headline_config"] = "qa_e2e"  # upgraded to 7B-int8 below
     measure_decode(gen, "decode_1b_int8", "config3a int8")
+    flush_details()
 
     # ---- config 5: sustained QPS through the continuous batcher -------------
     def run_load(engine, n_slots, chunk, n_req, cache_len):
@@ -449,6 +473,7 @@ def main() -> None:
     except Exception as e:
         log(f"qps bench failed: {e!r}")
         DETAILS["rag_load"] = {"error": repr(e)}
+    flush_details()
 
     # ---- config 4: summarizer, 5 retrieved chunks ---------------------------
     summ = None
@@ -519,43 +544,51 @@ def main() -> None:
         del s2s, summ2
         gc.collect()
         if not small:
-            # beam-4 with the full generation constraints — BASELINE
-            # config 4 names bart-large-cnn whose published decode IS
-            # beam.  Kept in a separate try: the beam program's XLA
-            # compile at this depth is the risk (minutes on a slow host),
-            # not its runtime.
-            try:
-                s2s_beam = Seq2SeqEngine(Seq2SeqConfig.bart_large_cnn())
-                summ_b = SummarizeEngine(
-                    s2s_beam,
-                    SummarizerConfig(max_input_tokens=s2s_cfg.max_src_len),
-                    instruction_prompts=False,
-                )
-                t0 = time.perf_counter()
-                summ_b.summarize_patient("p1", docs, max_tokens=128)
-                compile_s = time.perf_counter() - t0
-                t_beam, _ = timed(
-                    lambda: summ_b.summarize_patient(
-                        "p1", docs, max_tokens=128
+            def run_beam_late():
+                # beam-4 with the full generation constraints — BASELINE
+                # config 4 names bart-large-cnn whose published decode IS
+                # beam.  Deferred: the beam program's XLA compile at this
+                # depth is the risk (minutes), not its runtime — it must
+                # not sit between the driver and the headline.
+                try:
+                    s2s_beam = Seq2SeqEngine(Seq2SeqConfig.bart_large_cnn())
+                    summ_b = SummarizeEngine(
+                        s2s_beam,
+                        SummarizerConfig(
+                            max_input_tokens=s2s_cfg.max_src_len
+                        ),
+                        instruction_prompts=False,
                     )
-                )
-                DETAILS["summarize_seq2seq_beam"] = {
-                    "five_chunk_ms": round(t_beam * 1e3, 1),
-                    "compile_s": round(compile_s, 1),
-                    "num_beams": Seq2SeqConfig.bart_large_cnn().num_beams,
-                }
-                log(
-                    f"config4b beam summarize (5 chunks): "
-                    f"{t_beam*1e3:.0f}ms (compile {compile_s:.0f}s)"
-                )
-                del s2s_beam, summ_b
-                gc.collect()
-            except Exception as e:
-                log(f"beam summarize bench failed: {e!r}")
-                DETAILS["summarize_seq2seq_beam"] = {"error": repr(e)[:300]}
+                    t0 = time.perf_counter()
+                    summ_b.summarize_patient("p1", docs, max_tokens=128)
+                    compile_s = time.perf_counter() - t0
+                    t_beam, _ = timed(
+                        lambda: summ_b.summarize_patient(
+                            "p1", docs, max_tokens=128
+                        )
+                    )
+                    DETAILS["summarize_seq2seq_beam"] = {
+                        "five_chunk_ms": round(t_beam * 1e3, 1),
+                        "compile_s": round(compile_s, 1),
+                        "num_beams": (
+                            Seq2SeqConfig.bart_large_cnn().num_beams
+                        ),
+                    }
+                    log(
+                        f"config4b beam summarize (5 chunks): "
+                        f"{t_beam*1e3:.0f}ms (compile {compile_s:.0f}s)"
+                    )
+                except Exception as e:
+                    log(f"beam summarize bench failed: {e!r}")
+                    DETAILS["summarize_seq2seq_beam"] = {
+                        "error": repr(e)[:300]
+                    }
+
+            late_sections.append(run_beam_late)
     except Exception as e:
         log(f"seq2seq summarize bench failed: {e!r}")
         DETAILS["summarize_seq2seq"] = {"error": repr(e)[:300]}
+    flush_details()
 
     # ---- config 2: deid NER throughput, batch = 32 --------------------------
     try:
@@ -579,38 +612,44 @@ def main() -> None:
         del deid
         gc.collect()
         if not small:
-            # quality, not just speed: train the real tagger and score it
-            # on the HAND-WRITTEN eval set (deid/evalset.py — sentences
-            # disjoint from the training generator's templates, so this
-            # measures generalization, not memorization)
-            try:
-                from docqa_tpu.deid.evalset import evaluate_deid
+            def run_deid_quality_late():
+                # quality, not just speed: train the real tagger and
+                # score it on the HAND-WRITTEN eval set (deid/evalset.py
+                # — sentences disjoint from the training generator's
+                # templates, so this measures generalization, not
+                # memorization).  Deferred: training takes minutes and
+                # must not sit between the driver and the headline.
+                try:
+                    from docqa_tpu.deid.evalset import evaluate_deid
 
-                t0 = time.perf_counter()
-                deid_trained = DeidEngine.trained(NERConfig())
-                ev = evaluate_deid(deid_trained)
-                DETAILS["deid"].update(
-                    {
-                        "train_s": round(time.perf_counter() - t0, 1),
-                        "f1": ev["entity_f1"],
-                        "char_f1": ev["char_f1"],
-                        "span_recall_any": ev["span_recall_any"],
-                        "eval": ev,
-                    }
-                )
-                log(
-                    f"config2 deid quality (handwritten eval): entity F1 "
-                    f"{ev['entity_f1']}, char F1 {ev['char_f1']}, "
-                    f"span recall {ev['span_recall_any']}"
-                )
-                del deid_trained
-                gc.collect()
-            except Exception as e:
-                log(f"deid quality eval failed: {e!r}")
-                DETAILS["deid"]["eval_error"] = repr(e)[:300]
+                    t0 = time.perf_counter()
+                    deid_trained = DeidEngine.trained(NERConfig())
+                    ev = evaluate_deid(deid_trained)
+                    DETAILS["deid"].update(
+                        {
+                            "train_s": round(time.perf_counter() - t0, 1),
+                            "f1": ev["entity_f1"],
+                            "char_f1": ev["char_f1"],
+                            "span_recall_any": ev["span_recall_any"],
+                            "eval": ev,
+                        }
+                    )
+                    log(
+                        f"config2 deid quality (handwritten eval): entity "
+                        f"F1 {ev['entity_f1']}, char F1 {ev['char_f1']}, "
+                        f"span recall {ev['span_recall_any']}"
+                    )
+                    del deid_trained
+                    gc.collect()
+                except Exception as e:
+                    log(f"deid quality eval failed: {e!r}")
+                    DETAILS["deid"]["eval_error"] = repr(e)[:300]
+
+            late_sections.append(run_deid_quality_late)
     except Exception as e:
         log(f"deid bench failed: {e!r}")
         DETAILS["deid"] = {"error": repr(e)}
+    flush_details()
 
     # ---- configs 3c/5b/3b: Mistral-7B-class on one chip ---------------------
     if not small:
@@ -786,6 +825,7 @@ def main() -> None:
         except Exception as e:
             log(f"config3c 7B int8 attempt failed: {e!r}")
             DETAILS["decode_7b_int8"] = {"error": repr(e)[:500]}
+        flush_details()
 
         # ---- config 3d: 7B grouped-int4 (w4a16, ~3.6 GB — the q4 class
         # the reference's Ollama runtime actually served).  Decode reads
@@ -917,6 +957,7 @@ def main() -> None:
             # 3b's 14.5 GB bf16 attempt OOM for the wrong reason
             del gen4, params4
             gc.collect()
+            flush_details()
 
         # ---- config 3b: the same 7B in bf16 (14.5 GB) — needs ALL the
         # HBM, so the store/encoder go first; runs last for that reason
@@ -970,18 +1011,13 @@ def main() -> None:
     # A CPU fallback run must be UNMISTAKABLE in the one line the driver
     # parses: distinct metric name AND an explicit degraded flag, so no
     # artifact comparison can mistake a smoke run for a TPU measurement
-    # (the r02 artifact was misleading exactly this way).
+    # (the r02 artifact was misleading exactly this way).  The line prints
+    # BEFORE the deferred slow sections (NER training, beam compile): a
+    # driver-side timeout during those must not cost the round its
+    # headline number.
     degraded = not on_tpu
     DETAILS["degraded"] = degraded
-    try:
-        with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_details.json"),
-            "w",
-        ) as f:
-            json.dump(DETAILS, f, indent=2)
-    except Exception as e:
-        log(f"details write failed: {e!r}")
-    log(f"details: {json.dumps(DETAILS)}")
+    flush_details()
     summary = {
         "metric": "qa_e2e_p50_ms" + ("_cpu_smoke" if degraded else ""),
         "value": round(p50, 2),
@@ -990,7 +1026,12 @@ def main() -> None:
     }
     if degraded:
         summary["degraded"] = True
-    print(json.dumps(summary))
+    print(json.dumps(summary), flush=True)
+
+    for section in late_sections:
+        section()
+        flush_details()
+    log(f"details: {json.dumps(DETAILS)}")
 
 
 if __name__ == "__main__":
