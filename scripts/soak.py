@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""CPU soak drive: concurrent ingests + asks + deletes against a running
+service, then consistency assertions (registry vs index vs search).
+
+Start the service first (any backend):
+
+    python scripts/start_all.py --port 8127 --cpu --work-dir /tmp/soak_wd
+    python scripts/soak.py [base_url]
+
+Exercises the races round 3 hardened: deletes against in-flight
+documents, erasure vs replay, concurrent /ask during ingest.  Exits
+non-zero on any consistency violation.
+"""
+import json
+import random
+import threading
+import time
+import urllib.request
+import urllib.error
+
+import sys
+BASE = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8127"
+random.seed(7)
+
+def req(method, path, data=None, headers=None, timeout=120):
+    r = urllib.request.Request(BASE + path, data=data, headers=headers or {}, method=method)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode() or "{}")
+
+def ingest(i):
+    boundary = "XBOUND"
+    text = f"Note {i}: patient on medication {i % 7}, vitals stable, plan follow-up."
+    body = (
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"file\"; filename=\"n{i}.txt\"\r\n"
+        f"Content-Type: text/plain\r\n\r\n{text}\r\n"
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"patient_id\"\r\n\r\npt{i % 5}\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    st, js = req("POST", "/ingest/?wait=1", body,
+                 {"Content-Type": f"multipart/form-data; boundary={boundary}"})
+    assert st == 200, (st, js)
+    return js["doc_id"]
+
+results = {"asks": 0, "ask_errors": 0, "deleted": [], "doc_ids": [], "errors": []}
+lock = threading.Lock()
+
+def uploader(n):
+    for i in range(n):
+        try:
+            d = ingest(i)
+            with lock:
+                results["doc_ids"].append(d)
+        except Exception as e:
+            with lock:
+                results["errors"].append(f"ingest {i}: {e!r}")
+    results["uploads_done"] = True
+
+def asker(n):
+    # run until the uploader finishes (plus n tail asks): early asks
+    # legitimately 503 while the first jit compiles gate the pipeline
+    i = 0
+    while not results.get("uploads_done") or i < n:
+        if i >= n and results.get("uploads_done"):
+            break
+        i += 1
+        try:
+            body = json.dumps({"question": f"medication {i % 7} status?"}).encode()
+            st, js = req("POST", "/ask/", body, {"Content-Type": "application/json"})
+            with lock:
+                if st == 200 and js.get("answer"):
+                    results["asks"] += 1
+                else:
+                    results["ask_errors"] += 1
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode()[:60]
+            except Exception:
+                pass
+            with lock:
+                if e.code in (503,):  # empty index early on: legal
+                    results["ask_errors"] += 1
+                    k = f"503:{detail}"
+                    results.setdefault("ask_err_kinds", {})
+                    results["ask_err_kinds"][k] = results["ask_err_kinds"].get(k, 0) + 1
+                else:
+                    results["errors"].append(f"ask {i}: HTTP {e.code} {detail}")
+        except Exception as e:
+            with lock:
+                results["errors"].append(f"ask {i}: {e!r}")
+        time.sleep(0.1)
+
+def deleter(n):
+    for i in range(n):
+        time.sleep(0.3)
+        with lock:
+            pool = [d for d in results["doc_ids"] if d not in results["deleted"]]
+        if not pool:
+            continue
+        doc = random.choice(pool)
+        try:
+            st, js = req("DELETE", f"/documents/{doc}?erase={i % 2}")
+            assert st == 200, (st, js)
+            with lock:
+                results["deleted"].append(doc)
+        except Exception as e:
+            with lock:
+                results["errors"].append(f"delete {doc}: {e!r}")
+
+threads = (
+    [threading.Thread(target=uploader, args=(30,))]
+    + [threading.Thread(target=asker, args=(25,)) for _ in range(3)]
+    + [threading.Thread(target=deleter, args=(10,))]
+)
+t0 = time.time()
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+wall = time.time() - t0
+
+# settle, then consistency checks
+time.sleep(2.0)
+st, docs = req("GET", "/documents/?limit=200")
+by_id = {d["doc_id"]: d for d in docs}
+bad = []
+for d in results["doc_ids"]:
+    rec = by_id.get(d)
+    if rec is None:
+        bad.append(f"{d}: missing from registry")
+        continue
+    if d in results["deleted"]:
+        if rec["status"] != "DELETED":
+            bad.append(f"{d}: deleted but status={rec['status']}")
+    elif rec["status"] != "INDEXED":
+        bad.append(f"{d}: expected INDEXED got {rec['status']}")
+st, status = req("GET", "/api/status")
+live_expected = len(results["doc_ids"]) - len(set(results["deleted"]))
+print(json.dumps({
+    "wall_s": round(wall, 1),
+    "ingested": len(results["doc_ids"]),
+    "deleted": len(set(results["deleted"])),
+    "asks_ok": results["asks"],
+    "ask_errors": results["ask_errors"],
+    "ask_err_kinds": results.get("ask_err_kinds", {}),
+    "errors": results["errors"][:10],
+    "consistency_violations": bad[:10],
+    "indexed_vectors": status.get("indexed_vectors"),
+    "live_docs_expected": live_expected,
+    "queue_depths": status.get("queue_depths"),
+    "dead_letters": status.get("dead_letters"),
+}, indent=1))
+assert not results["errors"], results["errors"][:5]
+assert not bad, bad[:5]
+print("SOAK OK")
