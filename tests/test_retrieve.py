@@ -208,3 +208,31 @@ class TestFusedTiered:
         rows = retr.search_texts(["warfarin with INR checks"], k=4)[0]
         assert all(r.metadata["doc_id"] != doc for r in rows)
         assert len(rows) == 4  # headroom/fallback keeps the quota
+
+    def test_mesh_falls_back_to_tiered_not_exact(self, tiered_setup, mesh8):
+        """On a multi-device mesh fusion is off, but the fallback must be
+        encode + TieredIndex.search — NOT a full exact scan of the store
+        the operator configured tiered serving to avoid."""
+        from docqa_tpu.config import StoreConfig
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+        from docqa_tpu.index.tiered import TieredIndex
+
+        enc, store, texts, _ = tiered_setup
+        mstore = VectorStore(
+            StoreConfig(dim=64, shard_capacity=256), mesh=mesh8
+        )
+        mstore.add(
+            enc.encode_texts(texts),
+            [
+                {"doc_id": f"d{i}", "source": t, "text_content": t}
+                for i, t in enumerate(texts)
+            ],
+        )
+        tiered = TieredIndex(mstore, min_rows=4, n_clusters=3, nprobe=3)
+        assert tiered.rebuild()
+        retr = FusedTieredRetriever(enc, tiered)
+        assert not retr._exact._fusable
+        rows = retr.search_texts(["warfarin with INR checks"], k=3)[0]
+        emb = np.asarray(enc.encode_texts(["warfarin with INR checks"]), np.float32)
+        plain = tiered.search(emb, k=3)[0]
+        assert [r.row_id for r in rows] == [r.row_id for r in plain]
