@@ -116,14 +116,20 @@ def quantize_array_int4(
 
 
 def quantize_decoder_params(params: Params, bits: int = 8) -> Params:
-    """Quantize an existing float tree (fits when the float tree fits)."""
+    """Quantize an existing float tree (fits when the float tree fits).
+
+    In int4 mode ``lm_head`` stays int8: the output projection's logit
+    errors bite directly into token choice (llama.cpp's q4 presets keep
+    it at higher precision for the same reason) and it is ~3 % of a 7B
+    tree's bytes — negligible bandwidth, meaningful quality."""
     if bits not in (4, 8):
         raise ValueError(f"quantization bits must be 4 or 8, got {bits}")
     out: Params = {}
     for name, w in params.items():
         if should_quantize(name) and w.ndim == 2:
+            use_int8 = bits == 8 or name == "lm_head"
             q, scale = (
-                quantize_array(w) if bits == 8 else quantize_array_int4(w)
+                quantize_array(w) if use_int8 else quantize_array_int4(w)
             )
             out[name] = q
             out[name + SCALE_SUFFIX] = scale
@@ -172,7 +178,7 @@ def init_quantized_decoder_params(
             w = host_rng.standard_normal(shape, _np.float32) * (
                 fan_in ** -0.5
             )
-            if should_quantize(name) and bits == 8:
+            if should_quantize(name) and (bits == 8 or name == "lm_head"):
                 scale = _np.maximum(
                     _np.max(_np.abs(w), axis=0) / 127.0, 1e-12
                 ).astype(_np.float32)
@@ -206,8 +212,9 @@ def init_quantized_decoder_params(
             fan_in ** -0.5
         )
         if should_quantize(name):
+            use_int8 = bits == 8 or name == "lm_head"  # see above
             q, scale = (
-                quantize_array(w) if bits == 8 else quantize_array_int4(w)
+                quantize_array(w) if use_int8 else quantize_array_int4(w)
             )
             out[name] = q
             out[name + SCALE_SUFFIX] = scale
