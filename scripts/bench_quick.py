@@ -35,6 +35,34 @@ def main() -> None:
     from docqa_tpu.index.store import VectorStore
 
     print("backend:", jax.default_backend(), flush=True)
+
+    if "--7b" in sys.argv:
+        # decode-only 7B int8 vs int4 (the question a short tunnel window
+        # should answer first: does grouped int4 double tok/s or did the
+        # compiler materialize the dequant?)
+        from docqa_tpu.models.quant import init_quantized_decoder_params
+
+        cfg7 = DecoderConfig.mistral_7b()
+        for bits in (8, 4):
+            params = init_quantized_decoder_params(
+                jax.random.PRNGKey(0), cfg7, host_init=True, bits=bits
+            )
+            eng = GenerateEngine(
+                cfg7,
+                GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
+                params=params,
+            )
+            eng.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                eng.generate_ids([[5, 9, 11]], max_new_tokens=64)
+            dt = (time.perf_counter() - t0) / 3
+            print(f"7B int{bits}: {64/dt:.1f} tok/s", flush=True)
+            del eng, params
+            import gc
+
+            gc.collect()
+        return
     dec_cfg = DecoderConfig(
         vocab_size=32000, hidden_dim=2048, num_layers=16, num_heads=16,
         num_kv_heads=8, head_dim=128, mlp_dim=5632, max_seq_len=4096,
