@@ -261,6 +261,18 @@ def main() -> None:
         tiered.search(one, k=10)  # compile batch-1 shapes
         t_tier1, _ = timed(lambda: tiered.search(one, k=10), n=5)
         t_exact1, _ = timed(lambda: store.search(one, k=10), n=5)
+        # the ONE-dispatch text->tiered program serving uses when
+        # serving_index="tiered" (encode + IVF probe + tail in one XLA
+        # program) — measured against the fused-exact number in
+        # DETAILS["retrieval"] so the serving-policy crossover table in
+        # docs/PERF.md §4 can be filled from one artifact
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+
+        ft = FusedTieredRetriever(encoder, tiered)
+        ft.search_texts([q_texts[0]], k=10)  # compile
+        t_ftier, _ = timed(
+            lambda: ft.search_texts([q_texts[1]], k=10), n=5
+        )
         DETAILS["ivf"] = {
             "recall_at_10": round(hits / max(total, 1), 4),
             "build_s": round(t_build, 1),
@@ -268,7 +280,9 @@ def main() -> None:
             "exact_batch20_ms": round(t_exact20 * 1e3, 2),
             "tiered_batch1_ms": round(t_tier1 * 1e3, 2),
             "exact_batch1_ms": round(t_exact1 * 1e3, 2),
+            "fused_tiered_query_ms": round(t_ftier * 1e3, 2),
         }
+        del ft
         log(
             f"ivf: recall@10 {hits/max(total,1):.3f}, build {t_build:.1f}s, "
             f"batch-20 tiered {t_tier*1e3:.1f}ms vs exact "
