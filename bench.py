@@ -116,7 +116,34 @@ def _device_backend_alive(timeout_s: float = 150.0) -> bool:
         return False
 
 
+def _bench_lock(max_wait_s: float = 3600.0) -> None:
+    """Cooperative single-runner lock: two benches sharing one chip OOM
+    each other into false negatives.  If another live bench holds the
+    lock, wait for it (finishing late beats colliding); a stale lock
+    (dead pid) is ignored."""
+    path = "/tmp/docqa_bench.lock"
+    deadline = time.time() + max_wait_s
+    while True:
+        try:
+            holder = int(open(path).read().strip())
+            os.kill(holder, 0)  # raises if dead
+            if time.time() > deadline:
+                log(f"bench lock held by {holder} past wait budget; proceeding")
+                break
+            log(f"bench lock held by live pid {holder}; waiting")
+            time.sleep(30)
+            continue
+        except (FileNotFoundError, ValueError, ProcessLookupError, PermissionError):
+            break
+    try:
+        with open(path, "w") as f:
+            f.write(str(os.getpid()))
+    except Exception:
+        pass
+
+
 def main() -> None:
+    _bench_lock()
     if not _device_backend_alive():
         # degrade honestly: a CPU smoke run labeled as such beats a hang
         log(
