@@ -40,6 +40,7 @@ tensor — a quantize-after-full-init would need bf16 + int8 simultaneously
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -48,6 +49,9 @@ import jax.numpy as jnp
 from docqa_tpu.config import DecoderConfig
 
 Params = Dict[str, jax.Array]
+
+_log = logging.getLogger(__name__)
+_WARNED_DEGRADED_DIMS: set = set()
 
 SCALE_SUFFIX = "__scale"
 
@@ -63,6 +67,21 @@ def _int4_group(in_dim: int, group: Optional[int] = None) -> int:
     g = min(group or GROUP_SIZE, in_dim)
     while in_dim % g:
         g -= 1
+    if g < 16 and in_dim >= 16 and in_dim not in _WARNED_DEGRADED_DIMS:
+        # e.g. in_dim=298 degrades to g=2: the f32 scale tensor then costs
+        # 2 bytes per 0.5-byte weight, so "int4" quietly lands larger than
+        # int8 with only 15 quant levels — defeats the mode.  Warn once per
+        # distinct in_dim: quantize_decoder_params hits this helper for
+        # every quantized tensor (7 keys x layers).
+        _WARNED_DEGRADED_DIMS.add(in_dim)
+        _log.warning(
+            "int4 group size degraded to %d for in_dim=%d (no divisor <= %d "
+            ">= 16); scale overhead now exceeds int8 — prefer quant_bits=8 "
+            "for this shape",
+            g,
+            in_dim,
+            GROUP_SIZE,
+        )
     return g
 
 

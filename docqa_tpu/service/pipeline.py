@@ -85,7 +85,7 @@ class DocumentPipeline:
                 self._deid_handler,
                 batch=cfg.broker.prefetch,
                 name="deid-worker",
-                on_dead=lambda body: self.registry.set_status(
+                on_dead=lambda body: self.registry.set_status_unless_deleted(
                     body["doc_id"], reg.ERROR_DEID
                 ),
             ),
@@ -95,7 +95,7 @@ class DocumentPipeline:
                 self._index_handler,
                 batch=cfg.broker.prefetch,
                 name="index-worker",
-                on_dead=lambda body: self.registry.set_status(
+                on_dead=lambda body: self.registry.set_status_unless_deleted(
                     body["doc_id"], reg.ERROR_INDEXING
                 ),
             ),
@@ -186,21 +186,31 @@ class DocumentPipeline:
                 with self._suppress_lock:
                     suppressed = body["doc_id"] in self._suppressed_doc_ids
                     if not suppressed:
-                        record = self.registry.get(body["doc_id"])
-                        suppressed = (
-                            record is not None
-                            and record.status == reg.DELETED
-                        )
-                    if not suppressed:
-                        # status BEFORE publish (and inside the lock, so a
-                        # concurrent DELETE either lands before this check
+                        # status BEFORE publish (and inside the lock, so an
+                        # in-process DELETE either lands before this check
                         # or writes DELETED after us): once the message is
                         # on the clean queue the index worker may race us
                         # to INDEXED, which must not be overwritten by a
-                        # late DEIDENTIFIED
-                        self.registry.set_status(
+                        # late DEIDENTIFIED.  The conditional write also
+                        # refuses atomically if a FOREIGN process committed
+                        # DELETED — a read-then-write pair would leave a
+                        # resurrection window between the two statements.
+                        if not self.registry.set_status_unless_deleted(
                             body["doc_id"], reg.DEIDENTIFIED
-                        )
+                        ):
+                            # rowcount 0 is ambiguous: DELETED row, or no
+                            # row at all (registry restored from an older
+                            # snapshot / out-of-band enqueue).  Only a
+                            # DELETED row suppresses; an absent row keeps
+                            # the message flowing (prior behavior).
+                            record = self.registry.get(body["doc_id"])
+                            suppressed = record is not None
+                            if record is None:
+                                log.warning(
+                                    "doc %s not in registry; processing "
+                                    "anyway",
+                                    body["doc_id"],
+                                )
                 if suppressed:
                     log.info(
                         "dropping deleted doc %s at deid stage", body["doc_id"]
@@ -218,7 +228,9 @@ class DocumentPipeline:
             except Exception:
                 log.exception("clean-queue publish failed for %s", body["doc_id"])
                 try:
-                    self.registry.set_status(body["doc_id"], reg.ERROR_DEID)
+                    self.registry.set_status_unless_deleted(
+                        body["doc_id"], reg.ERROR_DEID
+                    )
                 except Exception:
                     log.exception("status write failed for %s", body["doc_id"])
 
@@ -314,10 +326,22 @@ class DocumentPipeline:
                 with self._suppress_lock:
                     # a DELETE between store.add and here already wrote (or
                     # is about to write) DELETED; an INDEXED overwrite would
-                    # advertise a doc whose vectors are tombstoned
+                    # advertise a doc whose vectors are tombstoned.  The
+                    # in-process suppression set only sees DELETEs handled by
+                    # THIS process — in multi-process registry mode (Postgres)
+                    # another service process writes DELETED straight to the
+                    # shared registry, so the write is conditional AT the
+                    # database (UPDATE ... WHERE status != DELETED): atomic
+                    # even against a foreign DELETE committing mid-loop.
+                    # (Cross-process deletes still cannot drop this process's
+                    # in-flight vectors; those rows stay tombstone-filtered at
+                    # query time once the deleter's delete_docs reaches the
+                    # store snapshot — see docs/OPERATIONS.md.)
                     if doc_id in self._suppressed_doc_ids:
                         continue
-                    self.registry.set_status(doc_id, reg.INDEXED, n_chunks=n)
+                    self.registry.set_status_unless_deleted(
+                        doc_id, reg.INDEXED, n_chunks=n
+                    )
             except Exception:
                 log.exception("status write failed for %s", doc_id)
         for doc_id in replayed:
@@ -330,10 +354,7 @@ class DocumentPipeline:
                 with self._suppress_lock:
                     if doc_id in self._suppressed_doc_ids:
                         continue
-                    record = self.registry.get(doc_id)
-                    if record is not None and record.status == reg.DELETED:
-                        continue
-                    self.registry.set_status(doc_id, reg.INDEXED)
+                    self.registry.set_status_unless_deleted(doc_id, reg.INDEXED)
             except Exception:
                 log.exception("status write failed for %s", doc_id)
 

@@ -128,7 +128,24 @@ class DocumentRegistry:
 
     def _exec(self, sql: str, args: tuple = ()):
         """Run one statement through a cursor, translating the SQL's ``?``
-        placeholders to the backend's paramstyle (psycopg2 uses ``%s``)."""
+        placeholders to the backend's paramstyle (psycopg2 uses ``%s``).
+
+        CONSTRAINT for query authors: statements here must not contain a
+        literal ``?`` inside a string constant — the guard below catches it
+        on every backend (not just Postgres, where the blanket replace would
+        silently corrupt the literal)."""
+        if "'" in sql and "?" in sql.split("--")[0]:
+            # cheap conservative check: a quoted section containing '?' is
+            # the only corruption case; none of our queries mix the two
+            in_quote = False
+            for ch in sql:
+                if ch == "'":
+                    in_quote = not in_quote
+                elif ch == "?" and in_quote:
+                    raise ValueError(
+                        "registry SQL must not contain '?' inside a string "
+                        "literal (breaks paramstyle translation): " + sql
+                    )
         if self._param != "?":
             sql = sql.replace("?", self._param)
         cur = self._conn.cursor()
@@ -183,6 +200,32 @@ class DocumentRegistry:
                     (status, n_chunks, doc_id),
                 )
             self._conn.commit()
+
+    def set_status_unless_deleted(
+        self, doc_id: str, status: str, n_chunks: Optional[int] = None
+    ) -> bool:
+        """Atomic conditional status write: never overwrites DELETED.
+
+        A read-then-write guard at the call site leaves a window in
+        multi-process (Postgres) mode — a foreign DELETE committing between
+        the ``get`` and the ``set_status`` would still be resurrected.  One
+        conditional UPDATE closes it at the database.  Returns True when the
+        row was updated (i.e. it existed and was not DELETED)."""
+        with self._lock:
+            if n_chunks is None:
+                cur = self._exec(
+                    "UPDATE documents SET status=? "
+                    "WHERE doc_id=? AND status != ?",
+                    (status, doc_id, DELETED),
+                )
+            else:
+                cur = self._exec(
+                    "UPDATE documents SET status=?, n_chunks=? "
+                    "WHERE doc_id=? AND status != ?",
+                    (status, n_chunks, doc_id, DELETED),
+                )
+            self._conn.commit()
+            return cur.rowcount > 0
 
     def _row_to_record(self, row) -> DocumentRecord:
         return DocumentRecord(*row)

@@ -254,6 +254,34 @@ class TestDeletionRaces:
         finally:
             rt.stop()
 
+    def test_cross_process_delete_cannot_resurrect(self):
+        """Multi-process registry mode (Postgres): a DELETE handled by a
+        DIFFERENT service process writes DELETED straight to the shared
+        registry and can never populate this process's in-memory
+        suppression set.  The per-doc INDEXED write must therefore consult
+        the registry record too — without that check the in-flight batch
+        here would flip DELETED back to INDEXED (ADVICE r3, medium)."""
+        from docqa_tpu.service import registry as reg
+
+        rt = self._runtime()
+        try:
+            rec = rt.pipeline.ingest_document(
+                "x.txt", b"Atorvastatin 40mg nightly.", patient_id="p9"
+            )
+            body = {
+                "doc_id": rec.doc_id,
+                "original_text_masked": "Atorvastatin 40mg nightly.",
+                "metadata": {"patient_id": "p9", "filename": "x.txt"},
+                "processed_at": 0.0,
+            }
+            # the foreign process's delete: registry-only, no suppression
+            rt.registry.set_status(rec.doc_id, reg.DELETED)
+            assert rec.doc_id not in rt.pipeline._suppressed_doc_ids
+            rt.pipeline._index_handler([body])
+            assert rt.registry.get(rec.doc_id).status == reg.DELETED
+        finally:
+            rt.stop()
+
     def test_erasure_survives_restart_replay(self):
         """The in-memory suppressed set dies with the process; the registry
         DELETED row is the durable record.  A message replayed after a

@@ -35,6 +35,10 @@ class _FakePgCursor:
     def fetchall(self):
         return self._cur.fetchall()
 
+    @property
+    def rowcount(self):
+        return self._cur.rowcount
+
 
 class _FakePgConnection:
     def __init__(self, dsn):
@@ -115,6 +119,20 @@ class TestPostgresRegistry:
         ]
         assert len(r.list_documents(limit=2)) == 2
         assert r.get(b.doc_id).patient_id == "p2"
+        r.close()
+
+    def test_conditional_write_never_resurrects(self):
+        """set_status_unless_deleted is the multi-process resurrection
+        guard: one conditional UPDATE, no read-then-write window."""
+        r, _ = self._registry()
+        rec = r.create("a.txt")
+        assert r.set_status_unless_deleted(rec.doc_id, reg.DEIDENTIFIED)
+        r.set_status(rec.doc_id, reg.DELETED)  # the foreign process's write
+        assert not r.set_status_unless_deleted(
+            rec.doc_id, reg.INDEXED, n_chunks=3
+        )
+        assert r.get(rec.doc_id).status == reg.DELETED
+        assert not r.set_status_unless_deleted("missing", reg.INDEXED)
         r.close()
 
     def test_postgres_gated_without_driver(self):
