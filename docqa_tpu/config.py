@@ -54,6 +54,9 @@ class EncoderConfig:
     embed_dim: int = 384  # pooled output dim
     dtype: str = "bfloat16"
     normalize: bool = True  # cosine == L2 on normalized vectors (SURVEY appendix)
+    # real-vocabulary file for imported checkpoints: vocab.txt (WordPiece,
+    # MiniLM/BERT) / tokenizer.json / tokenizer.model.  None → hash fallback.
+    tokenizer_path: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -114,6 +117,10 @@ class DecoderConfig:
     # ~3.6 GB at 7B — the q4 class the reference's Ollama runtime served)
     quantize_weights: bool = False
     quant_bits: int = 8
+    # real-vocabulary file for imported checkpoints: tokenizer.json
+    # (byte-level or metaspace BPE) or tokenizer.model (SentencePiece) —
+    # text/bpe.py.  None → hash fallback (zero-egress default).
+    tokenizer_path: Optional[str] = None
 
     @staticmethod
     def mistral_7b() -> "DecoderConfig":
@@ -180,6 +187,9 @@ class Seq2SeqConfig:
     length_penalty: float = 1.0
     min_length: int = 0  # EOS masked until this many tokens emitted
     no_repeat_ngram: int = 0  # 0 = off; n bans repeating any n-gram
+    # real-vocabulary file (tokenizer.json — bart-large-cnn ships byte-level
+    # BPE).  None → hash fallback.
+    tokenizer_path: Optional[str] = None
 
     @staticmethod
     def bart_large_cnn() -> "Seq2SeqConfig":

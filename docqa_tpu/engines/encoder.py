@@ -67,7 +67,9 @@ class EncoderEngine:
     ):
         self.cfg = cfg
         self.mesh = mesh
-        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+        self.tokenizer = tokenizer or default_tokenizer(
+            cfg.vocab_size, vocab_path=cfg.tokenizer_path
+        )
         if params is None:
             params = init_encoder_params(jax.random.PRNGKey(seed), cfg)
         if mesh is not None:

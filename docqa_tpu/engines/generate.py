@@ -63,7 +63,31 @@ class GenerateEngine:
         self.cfg = cfg
         self.gen = gen or GenerateConfig()
         self.mesh = mesh
-        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+        self.tokenizer = tokenizer or default_tokenizer(
+            cfg.vocab_size, vocab_path=cfg.tokenizer_path
+        )
+        # a real vocabulary (tokenizer.json / .model) carries the
+        # checkpoint's own special ids — the decode loop must stop on THAT
+        # eos, not the hash-fallback default of 2.  Only the DEFAULT ids
+        # are replaced: a caller who set a custom eos_id (e.g. a structured
+        # -output stop token) keeps it.
+        tok_eos = getattr(self.tokenizer, "eos_id", None)
+        tok_pad = getattr(self.tokenizer, "pad_id", None)
+        if (tokenizer is not None or cfg.tokenizer_path) and tok_eos is not None:
+            import dataclasses as _dc
+
+            defaults = GenerateConfig()
+            updates = {}
+            if self.gen.eos_id == defaults.eos_id and tok_eos != self.gen.eos_id:
+                updates["eos_id"] = int(tok_eos)
+            if (
+                self.gen.pad_id == defaults.pad_id
+                and tok_pad is not None
+                and tok_pad != self.gen.pad_id
+            ):
+                updates["pad_id"] = int(tok_pad)
+            if updates:
+                self.gen = _dc.replace(self.gen, **updates)
         if params is None:
             if cfg.quantize_weights:
                 from docqa_tpu.models.quant import (

@@ -39,13 +39,23 @@ class Seq2SeqEngine:
         params: Optional[Params] = None,
         tokenizer: Optional[Tokenizer] = None,
         seed: int = 0,
+        mesh=None,
     ) -> None:
+        """``mesh``: optional :class:`~docqa_tpu.runtime.mesh.MeshContext` —
+        weights replicate across the mesh and summarization batches shard
+        over the ``data`` axis (the encoder engine's DP pattern; beam state
+        stays per-example so it shards with the batch)."""
         self.cfg = cfg
-        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
+        self.mesh = mesh
+        self.tokenizer = tokenizer or default_tokenizer(
+            cfg.vocab_size, vocab_path=cfg.tokenizer_path
+        )
         if params is None:
             params = init_seq2seq_params(
                 jax.random.PRNGKey(seed), cfg, host_init=True
             )
+        if mesh is not None:
+            params = jax.device_put(params, mesh.replicated)
         self.params = params
         self._fns = {}
 
@@ -103,6 +113,8 @@ class Seq2SeqEngine:
             self.cfg.max_src_len,
         )
         b_pad = pick_bucket(b, BATCH_BUCKETS) if b <= BATCH_BUCKETS[-1] else b
+        if self.mesh is not None and self.mesh.n_data > 1:
+            b_pad = round_up(b_pad, self.mesh.n_data)
         ids = np.full((b_pad, bucket), self.cfg.pad_id, np.int32)
         lengths = np.ones((b_pad,), np.int32)
         for i, s in enumerate(src_ids):
@@ -110,10 +122,13 @@ class Seq2SeqEngine:
             ids[i, : len(s)] = s
             lengths[i] = max(len(s), 1)
         fn = self._get_fn(max_new)
+        ids_j, len_j = jnp.asarray(ids), jnp.asarray(lengths)
+        if self.mesh is not None and self.mesh.n_data > 1:
+            ids_j = jax.device_put(ids_j, self.mesh.batch_sharded)
+            len_j = jax.device_put(len_j, self.mesh.batch_sharded)
         with span("seq2seq_generate", DEFAULT_REGISTRY):
             out, n_emitted = fn(
-                self.params, src_ids=jnp.asarray(ids),
-                src_lengths=jnp.asarray(lengths),
+                self.params, src_ids=ids_j, src_lengths=len_j,
             )
         out = np.asarray(out)[:b]
         n_emitted = np.asarray(n_emitted)[:b]

@@ -1,3 +1,15 @@
+from docqa_tpu.text.bpe import (
+    BPETokenizer,
+    SentencePieceTokenizer,
+    load_tokenizer,
+)
 from docqa_tpu.text.tokenizer import HashTokenizer, Tokenizer, WordPieceTokenizer
 
-__all__ = ["Tokenizer", "WordPieceTokenizer", "HashTokenizer"]
+__all__ = [
+    "Tokenizer",
+    "WordPieceTokenizer",
+    "HashTokenizer",
+    "BPETokenizer",
+    "SentencePieceTokenizer",
+    "load_tokenizer",
+]

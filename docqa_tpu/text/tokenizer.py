@@ -212,7 +212,13 @@ class WordPieceTokenizer(Tokenizer):
 
 
 def default_tokenizer(vocab_size: int = 30522, vocab_path: Optional[str] = None):
-    """WordPiece if a vocab file is supplied/present, hash fallback otherwise."""
+    """Real-vocabulary tokenizer if a file is supplied, hash fallback
+    otherwise.  Dispatch: ``*.txt`` → WordPiece, ``tokenizer.json`` →
+    byte-level/metaspace BPE, ``*.model`` → SentencePiece (text/bpe.py)."""
     if vocab_path:
+        if vocab_path.endswith((".json", ".model")):
+            from docqa_tpu.text.bpe import load_tokenizer
+
+            return load_tokenizer(vocab_path)
         return WordPieceTokenizer.from_file(vocab_path)
     return HashTokenizer(vocab_size)
