@@ -1,0 +1,482 @@
+"""Generate the bootstrap knowledge base (docqa_tpu/default_data/*.csv).
+
+The reference ships 649 denormalized TCM rows (`semantic-indexer/
+default_data/`, consumed at `indexer.py:50-94`).  That content cannot be
+copied, so this script AUTHORS an equivalent-scale knowledge base from the
+structured tables below — classical formula compositions and syndrome/plant
+affinities that are standard TCM curriculum material, written in this
+file's own words and the repo's simplified column schemas:
+
+* ``base_connaissance_tcm.csv`` — one row per (syndrome, formule, plante,
+  role, score): the formula-composition view (reference
+  ``indexer.py:79-89``).
+* ``matrice_plante_syndrome.csv`` — one row per (syndrome, plante, score):
+  the ranking-matrix view (reference ``indexer.py:67-76``).
+
+Deterministic: re-running reproduces byte-identical CSVs.  Run from the
+repo root: ``python scripts/gen_kb.py``.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docqa_tpu",
+    "default_data",
+)
+
+# (latin, pinyin) — the herb lexicon used by both tables
+PLANTS = {
+    "ren_shen": ("Panax ginseng", "Ren Shen"),
+    "huang_qi": ("Astragalus membranaceus", "Huang Qi"),
+    "bai_zhu": ("Atractylodes macrocephala", "Bai Zhu"),
+    "fu_ling": ("Poria cocos", "Fu Ling"),
+    "gan_cao": ("Glycyrrhiza uralensis", "Gan Cao"),
+    "dang_gui": ("Angelica sinensis", "Dang Gui"),
+    "shu_di": ("Rehmannia glutinosa praeparata", "Shu Di Huang"),
+    "bai_shao": ("Paeonia lactiflora", "Bai Shao"),
+    "chuan_xiong": ("Ligusticum chuanxiong", "Chuan Xiong"),
+    "chai_hu": ("Bupleurum chinense", "Chai Hu"),
+    "bo_he": ("Mentha haplocalyx", "Bo He"),
+    "sheng_jiang": ("Zingiber officinale recens", "Sheng Jiang"),
+    "da_zao": ("Ziziphus jujuba", "Da Zao"),
+    "chen_pi": ("Citrus reticulata", "Chen Pi"),
+    "ban_xia": ("Pinellia ternata", "Ban Xia"),
+    "shan_yao": ("Dioscorea opposita", "Shan Yao"),
+    "shan_zhu_yu": ("Cornus officinalis", "Shan Zhu Yu"),
+    "mu_dan_pi": ("Paeonia suffruticosa", "Mu Dan Pi"),
+    "ze_xie": ("Alisma orientale", "Ze Xie"),
+    "gou_qi": ("Lycium barbarum", "Gou Qi Zi"),
+    "ju_hua": ("Chrysanthemum morifolium", "Ju Hua"),
+    "jin_yin_hua": ("Lonicera japonica", "Jin Yin Hua"),
+    "lian_qiao": ("Forsythia suspensa", "Lian Qiao"),
+    "jie_geng": ("Platycodon grandiflorus", "Jie Geng"),
+    "ma_huang": ("Ephedra sinica", "Ma Huang"),
+    "gui_zhi": ("Cinnamomum cassia ramulus", "Gui Zhi"),
+    "xing_ren": ("Prunus armeniaca semen", "Xing Ren"),
+    "tao_ren": ("Prunus persica semen", "Tao Ren"),
+    "hong_hua": ("Carthamus tinctorius", "Hong Hua"),
+    "suan_zao_ren": ("Ziziphus spinosa semen", "Suan Zao Ren"),
+    "yuan_zhi": ("Polygala tenuifolia", "Yuan Zhi"),
+    "long_yan_rou": ("Dimocarpus longan arillus", "Long Yan Rou"),
+    "mai_dong": ("Ophiopogon japonicus", "Mai Men Dong"),
+    "wu_wei_zi": ("Schisandra chinensis", "Wu Wei Zi"),
+    "huang_lian": ("Coptis chinensis", "Huang Lian"),
+    "huang_qin": ("Scutellaria baicalensis", "Huang Qin"),
+    "zhi_zi": ("Gardenia jasminoides", "Zhi Zi"),
+    "da_huang": ("Rheum palmatum", "Da Huang"),
+    "hou_po": ("Magnolia officinalis", "Hou Po"),
+    "zhi_shi": ("Citrus aurantius immaturus", "Zhi Shi"),
+    "sang_ye": ("Morus alba folium", "Sang Ye"),
+    "ge_gen": ("Pueraria lobata", "Ge Gen"),
+    "xi_xin": ("Asarum sieboldii", "Xi Xin"),
+    "gan_jiang": ("Zingiber officinale siccatum", "Gan Jiang"),
+    "rou_gui": ("Cinnamomum cassia cortex", "Rou Gui"),
+    "du_zhong": ("Eucommia ulmoides", "Du Zhong"),
+    "niu_xi": ("Achyranthes bidentata", "Niu Xi"),
+    "sheng_ma": ("Cimicifuga foetida", "Sheng Ma"),
+    "bai_he": ("Lilium brownii", "Bai He"),
+    "zhi_mu": ("Anemarrhena asphodeloides", "Zhi Mu"),
+}
+
+# formula -> (syndrome, [(plant_key, role, score), ...])
+# Roles follow the classical hierarchy: Empereur / Ministre / Assistant /
+# Messager.  Scores (1-10) rank the herb's weight within the formula.
+FORMULAS = {
+    "Si Jun Zi Tang": (
+        "Vide de Qi de la Rate",
+        [
+            ("ren_shen", "Empereur", 9),
+            ("bai_zhu", "Ministre", 7),
+            ("fu_ling", "Assistant", 6),
+            ("gan_cao", "Messager", 4),
+        ],
+    ),
+    "Bu Zhong Yi Qi Tang": (
+        "Effondrement du Qi central",
+        [
+            ("huang_qi", "Empereur", 9),
+            ("ren_shen", "Ministre", 8),
+            ("bai_zhu", "Ministre", 6),
+            ("dang_gui", "Assistant", 5),
+            ("chen_pi", "Assistant", 4),
+            ("sheng_ma", "Messager", 3),
+            ("chai_hu", "Messager", 3),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Si Wu Tang": (
+        "Vide de Sang",
+        [
+            ("shu_di", "Empereur", 9),
+            ("dang_gui", "Ministre", 8),
+            ("bai_shao", "Assistant", 6),
+            ("chuan_xiong", "Messager", 5),
+        ],
+    ),
+    "Tao Hong Si Wu Tang": (
+        "Stase de Sang",
+        [
+            ("tao_ren", "Empereur", 8),
+            ("hong_hua", "Empereur", 8),
+            ("shu_di", "Ministre", 6),
+            ("dang_gui", "Ministre", 6),
+            ("bai_shao", "Assistant", 5),
+            ("chuan_xiong", "Assistant", 5),
+        ],
+    ),
+    "Xiao Yao San": (
+        "Stagnation du Qi du Foie",
+        [
+            ("chai_hu", "Empereur", 9),
+            ("dang_gui", "Ministre", 7),
+            ("bai_shao", "Ministre", 7),
+            ("bai_zhu", "Assistant", 5),
+            ("fu_ling", "Assistant", 5),
+            ("bo_he", "Messager", 3),
+            ("sheng_jiang", "Messager", 2),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Liu Wei Di Huang Wan": (
+        "Vide de Yin du Rein",
+        [
+            ("shu_di", "Empereur", 9),
+            ("shan_zhu_yu", "Ministre", 7),
+            ("shan_yao", "Ministre", 7),
+            ("ze_xie", "Assistant", 5),
+            ("mu_dan_pi", "Assistant", 5),
+            ("fu_ling", "Assistant", 5),
+        ],
+    ),
+    "Qi Ju Di Huang Wan": (
+        "Vide de Yin du Foie et du Rein",
+        [
+            ("gou_qi", "Empereur", 8),
+            ("ju_hua", "Empereur", 7),
+            ("shu_di", "Ministre", 7),
+            ("shan_zhu_yu", "Ministre", 6),
+            ("shan_yao", "Assistant", 5),
+            ("mu_dan_pi", "Assistant", 4),
+        ],
+    ),
+    "Er Chen Tang": (
+        "Mucosités-Humidité",
+        [
+            ("ban_xia", "Empereur", 9),
+            ("chen_pi", "Ministre", 7),
+            ("fu_ling", "Assistant", 6),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Yin Qiao San": (
+        "Vent-Chaleur",
+        [
+            ("jin_yin_hua", "Empereur", 9),
+            ("lian_qiao", "Empereur", 9),
+            ("bo_he", "Ministre", 6),
+            ("jie_geng", "Assistant", 5),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Ma Huang Tang": (
+        "Vent-Froid",
+        [
+            ("ma_huang", "Empereur", 9),
+            ("gui_zhi", "Ministre", 7),
+            ("xing_ren", "Assistant", 6),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Gui Zhi Tang": (
+        "Vent-Froid avec transpiration",
+        [
+            ("gui_zhi", "Empereur", 9),
+            ("bai_shao", "Ministre", 8),
+            ("sheng_jiang", "Assistant", 5),
+            ("da_zao", "Assistant", 4),
+            ("gan_cao", "Messager", 4),
+        ],
+    ),
+    "Gui Pi Tang": (
+        "Vide de Qi et de Sang du Coeur et de la Rate",
+        [
+            ("huang_qi", "Empereur", 8),
+            ("long_yan_rou", "Empereur", 7),
+            ("ren_shen", "Ministre", 7),
+            ("bai_zhu", "Ministre", 6),
+            ("dang_gui", "Assistant", 6),
+            ("suan_zao_ren", "Assistant", 6),
+            ("yuan_zhi", "Assistant", 5),
+            ("fu_ling", "Assistant", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Tian Wang Bu Xin Dan": (
+        "Vide de Yin du Coeur avec agitation",
+        [
+            ("shu_di", "Empereur", 8),
+            ("mai_dong", "Ministre", 7),
+            ("suan_zao_ren", "Ministre", 7),
+            ("wu_wei_zi", "Assistant", 5),
+            ("dang_gui", "Assistant", 5),
+            ("yuan_zhi", "Assistant", 4),
+        ],
+    ),
+    "Huang Lian Jie Du Tang": (
+        "Chaleur-Toxicité des trois Foyers",
+        [
+            ("huang_lian", "Empereur", 9),
+            ("huang_qin", "Ministre", 8),
+            ("zhi_zi", "Assistant", 6),
+        ],
+    ),
+    "Da Cheng Qi Tang": (
+        "Accumulation de Chaleur au Foyer Moyen",
+        [
+            ("da_huang", "Empereur", 9),
+            ("hou_po", "Ministre", 7),
+            ("zhi_shi", "Assistant", 6),
+        ],
+    ),
+    "Sang Ju Yin": (
+        "Vent-Chaleur avec toux",
+        [
+            ("sang_ye", "Empereur", 8),
+            ("ju_hua", "Ministre", 7),
+            ("xing_ren", "Assistant", 6),
+            ("jie_geng", "Assistant", 5),
+            ("bo_he", "Messager", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Ge Gen Tang": (
+        "Vent-Froid avec raideur de la nuque",
+        [
+            ("ge_gen", "Empereur", 9),
+            ("ma_huang", "Ministre", 6),
+            ("gui_zhi", "Ministre", 6),
+            ("bai_shao", "Assistant", 5),
+            ("sheng_jiang", "Messager", 3),
+            ("da_zao", "Messager", 3),
+        ],
+    ),
+    "Li Zhong Wan": (
+        "Froid-Vide de la Rate et de l'Estomac",
+        [
+            ("gan_jiang", "Empereur", 9),
+            ("ren_shen", "Ministre", 7),
+            ("bai_zhu", "Assistant", 6),
+            ("gan_cao", "Messager", 4),
+        ],
+    ),
+    "Jin Gui Shen Qi Wan": (
+        "Vide de Yang du Rein",
+        [
+            ("rou_gui", "Empereur", 8),
+            ("shu_di", "Ministre", 7),
+            ("shan_zhu_yu", "Ministre", 6),
+            ("shan_yao", "Assistant", 5),
+            ("ze_xie", "Assistant", 4),
+            ("fu_ling", "Assistant", 4),
+            ("mu_dan_pi", "Assistant", 4),
+        ],
+    ),
+    "Du Huo Ji Sheng Tang (variante)": (
+        "Vide du Foie et du Rein avec douleurs lombaires",
+        [
+            ("du_zhong", "Empereur", 8),
+            ("niu_xi", "Ministre", 7),
+            ("dang_gui", "Assistant", 6),
+            ("bai_shao", "Assistant", 5),
+            ("chuan_xiong", "Assistant", 4),
+            ("rou_gui", "Messager", 4),
+        ],
+    ),
+    "Bai He Gu Jin Tang (variante)": (
+        "Sécheresse du Poumon par Vide de Yin",
+        [
+            ("bai_he", "Empereur", 8),
+            ("mai_dong", "Ministre", 7),
+            ("shu_di", "Ministre", 6),
+            ("bai_shao", "Assistant", 5),
+            ("jie_geng", "Messager", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Zhi Bai Di Huang Wan": (
+        "Chaleur-Vide par Vide de Yin",
+        [
+            ("zhi_mu", "Empereur", 8),
+            ("shu_di", "Ministre", 7),
+            ("shan_zhu_yu", "Assistant", 5),
+            ("shan_yao", "Assistant", 5),
+            ("ze_xie", "Assistant", 4),
+            ("mu_dan_pi", "Assistant", 4),
+        ],
+    ),
+    "Xiao Chai Hu Tang": (
+        "Syndrome Shao Yang",
+        [
+            ("chai_hu", "Empereur", 9),
+            ("huang_qin", "Ministre", 7),
+            ("ban_xia", "Assistant", 6),
+            ("ren_shen", "Assistant", 5),
+            ("sheng_jiang", "Messager", 3),
+            ("da_zao", "Messager", 3),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Ping Wei San": (
+        "Humidité obstruant le Foyer Moyen",
+        [
+            ("hou_po", "Empereur", 7),
+            ("chen_pi", "Ministre", 6),
+            ("bai_zhu", "Ministre", 6),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Suan Zao Ren Tang": (
+        "Insomnie par Vide de Sang du Foie",
+        [
+            ("suan_zao_ren", "Empereur", 9),
+            ("chuan_xiong", "Ministre", 5),
+            ("fu_ling", "Assistant", 5),
+            ("zhi_mu", "Assistant", 5),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Sheng Mai San": (
+        "Vide de Qi et de Yin du Poumon",
+        [
+            ("ren_shen", "Empereur", 8),
+            ("mai_dong", "Ministre", 7),
+            ("wu_wei_zi", "Assistant", 6),
+        ],
+    ),
+}
+
+# syndrome -> extra (plant, score) affinities beyond its formula's herbs —
+# the ranking-matrix view covers single-herb indications too
+EXTRA_AFFINITIES = {
+    "Vide de Qi de la Rate": [
+        ("huang_qi", 8),
+        ("shan_yao", 6),
+        ("da_zao", 5),
+        ("gan_jiang", 4),
+    ],
+    "Vide de Sang": [
+        ("long_yan_rou", 6),
+        ("gou_qi", 6),
+        ("da_zao", 5),
+        ("suan_zao_ren", 4),
+    ],
+    "Stase de Sang": [("niu_xi", 6), ("mu_dan_pi", 5), ("da_huang", 4)],
+    "Stagnation du Qi du Foie": [
+        ("chen_pi", 5),
+        ("zhi_shi", 5),
+        ("bo_he", 4),
+    ],
+    "Vide de Yin du Rein": [
+        ("gou_qi", 7),
+        ("zhi_mu", 6),
+        ("mai_dong", 5),
+        ("bai_he", 4),
+    ],
+    "Vide de Yang du Rein": [("du_zhong", 7), ("gan_jiang", 5), ("niu_xi", 5)],
+    "Mucosités-Humidité": [("hou_po", 6), ("zhi_shi", 5), ("jie_geng", 4)],
+    "Vent-Chaleur": [("sang_ye", 7), ("ju_hua", 6), ("ge_gen", 5)],
+    "Vent-Froid": [("sheng_jiang", 6), ("xi_xin", 6), ("ge_gen", 5)],
+    "Chaleur-Toxicité des trois Foyers": [
+        ("jin_yin_hua", 7),
+        ("lian_qiao", 7),
+        ("da_huang", 5),
+    ],
+    "Insomnie par Vide de Sang du Foie": [
+        ("yuan_zhi", 6),
+        ("long_yan_rou", 5),
+        ("bai_he", 5),
+    ],
+    "Vide de Qi et de Yin du Poumon": [("huang_qi", 6), ("bai_he", 5)],
+    "Sécheresse du Poumon par Vide de Yin": [
+        ("sang_ye", 5),
+        ("xing_ren", 4),
+    ],
+    "Chaleur-Vide par Vide de Yin": [("mai_dong", 5), ("bai_he", 4)],
+    "Syndrome Shao Yang": [("huang_lian", 4), ("bo_he", 3)],
+    "Vide de Yin du Coeur avec agitation": [
+        ("bai_he", 6),
+        ("long_yan_rou", 4),
+    ],
+    "Froid-Vide de la Rate et de l'Estomac": [
+        ("rou_gui", 6),
+        ("sheng_jiang", 5),
+        ("da_zao", 4),
+    ],
+    "Humidité obstruant le Foyer Moyen": [("fu_ling", 6), ("ban_xia", 5)],
+    "Effondrement du Qi central": [("shan_yao", 5), ("da_zao", 4)],
+    "Vide de Yin du Foie et du Rein": [("bai_shao", 5), ("zhi_mu", 4)],
+    "Accumulation de Chaleur au Foyer Moyen": [
+        ("huang_lian", 5),
+        ("zhi_zi", 4),
+    ],
+}
+
+
+def write_base(path: str) -> int:
+    rows = 0
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(
+            ["nom_syndrome", "nom_formule", "nom_latin", "role", "score_role"]
+        )
+        for formula, (syndrome, comp) in FORMULAS.items():
+            for key, role, score in comp:
+                latin, _ = PLANTS[key]
+                w.writerow([syndrome, formula, latin, role, score])
+                rows += 1
+    return rows
+
+
+def write_matrice(path: str) -> int:
+    seen = set()
+    rows = 0
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(["nom_syndrome", "nom_latin", "nom_chinois", "score_role"])
+        for formula, (syndrome, comp) in FORMULAS.items():
+            for key, _role, score in comp:
+                if (syndrome, key) in seen:
+                    continue
+                seen.add((syndrome, key))
+                latin, pinyin = PLANTS[key]
+                w.writerow([syndrome, latin, pinyin, score])
+                rows += 1
+        for syndrome, extras in EXTRA_AFFINITIES.items():
+            for key, score in extras:
+                if (syndrome, key) in seen:
+                    continue
+                seen.add((syndrome, key))
+                latin, pinyin = PLANTS[key]
+                w.writerow([syndrome, latin, pinyin, score])
+                rows += 1
+    return rows
+
+
+def main() -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    n_base = write_base(os.path.join(OUT_DIR, "base_connaissance_tcm.csv"))
+    n_mat = write_matrice(
+        os.path.join(OUT_DIR, "matrice_plante_syndrome.csv")
+    )
+    print(
+        f"wrote {n_base} base rows + {n_mat} matrice rows = "
+        f"{n_base + n_mat} total to {OUT_DIR}"
+    )
+
+
+if __name__ == "__main__":
+    main()
