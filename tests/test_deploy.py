@@ -1,0 +1,100 @@
+"""Deployment artifacts (VERDICT round-3 item 7).
+
+Docker itself is not available in this image, so these validate the
+artifacts' CONTRACTS: the compose file parses, its env vars name real
+config fields (a typo'd ``DOCQA_...`` overlay would be silently ignored at
+boot), the Dockerfile copies everything the entrypoint imports, and the
+entrypoint/healthcheck reference real files and routes.
+
+Reference parity surface: ``docker-compose.yml:5-51`` +
+``synthese-comparative/Dockerfile``.
+"""
+
+import os
+import re
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMPOSE = os.path.join(REPO, "deploy", "docker-compose.yml")
+DOCKERFILE = os.path.join(REPO, "deploy", "Dockerfile")
+
+
+def _compose():
+    with open(COMPOSE) as f:
+        return yaml.safe_load(f)
+
+
+def _env_overlay_resolves(name: str) -> bool:
+    """True iff ``DOCQA_SECTION__FIELD`` names a real config field."""
+    from docqa_tpu.config import Config
+
+    m = re.fullmatch(r"DOCQA_([A-Z_]+?)__([A-Z_]+)", name)
+    if not m:
+        return False
+    section, field_name = m.group(1).lower(), m.group(2).lower()
+    cfg = Config()
+    if not hasattr(cfg, section):
+        return False
+    return hasattr(getattr(cfg, section), field_name)
+
+
+class TestCompose:
+    def test_parses_and_has_expected_services(self):
+        blob = _compose()
+        services = blob["services"]
+        assert {"docqa", "docqa-multihost", "postgres", "rabbitmq"} <= set(
+            services
+        )
+        # single-host service carries no multihost profile (up by default)
+        assert "profiles" not in services["docqa"]
+        for svc in ("docqa-multihost", "postgres", "rabbitmq"):
+            assert services[svc].get("profiles") == ["multihost"]
+
+    def test_env_overlays_name_real_config_fields(self):
+        services = _compose()["services"]
+        checked = 0
+        for svc in services.values():
+            for key in svc.get("environment", {}):
+                if key.startswith("DOCQA_"):
+                    assert _env_overlay_resolves(key), key
+                    checked += 1
+        assert checked >= 5  # work_dir x2, registry url, broker x3
+
+    def test_multihost_wires_postgres_and_amqp(self):
+        env = _compose()["services"]["docqa-multihost"]["environment"]
+        assert env["DOCQA_REGISTRY__URL"].startswith("postgresql://")
+        assert env["DOCQA_BROKER__BACKEND"] == "amqp"
+        assert env["DOCQA_BROKER__AMQP_HOST"] == "rabbitmq"
+        deps = _compose()["services"]["docqa-multihost"]["depends_on"]
+        assert deps["postgres"]["condition"] == "service_healthy"
+        assert deps["rabbitmq"]["condition"] == "service_healthy"
+
+    def test_external_services_have_healthchecks(self):
+        services = _compose()["services"]
+        for svc in ("postgres", "rabbitmq"):
+            assert "healthcheck" in services[svc]
+
+
+class TestDockerfile:
+    def test_copies_cover_the_entrypoint_imports(self):
+        src = open(DOCKERFILE).read()
+        copied = set(re.findall(r"^COPY\s+(\S+)\s", src, re.M))
+        assert {"docqa_tpu", "scripts", "native"} <= copied
+        # entrypoint script exists in the repo at the copied path
+        cmd = re.search(r'CMD \["python", "([^"]+)"', src)
+        assert cmd and os.path.exists(os.path.join(REPO, cmd.group(1)))
+
+    def test_healthcheck_hits_a_real_route(self):
+        src = open(DOCKERFILE).read()
+        assert "/health" in src  # app.py exposes GET /health
+        from docqa_tpu.service import app as app_mod
+
+        assert "/health" in open(app_mod.__file__).read()
+
+    def test_default_env_overlays_resolve(self):
+        src = open(DOCKERFILE).read()
+        for name in re.findall(r"(DOCQA_[A-Z_]+?__[A-Z_]+)=", src):
+            assert _env_overlay_resolves(name), name
