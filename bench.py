@@ -116,35 +116,61 @@ def _device_backend_alive(timeout_s: float = 150.0) -> bool:
         return False
 
 
+def _device_backend_alive_retrying(
+    attempts: int = 4, probe_timeout_s: float = 150.0, backoff_s: float = 60.0
+) -> bool:
+    """Bounded retry/backoff around the probe: a transient tunnel outage at
+    bench start must not forfeit the whole round to a CPU smoke run (it did,
+    twice).  Budget: ~4 probes over ~13 min — small next to the bench window,
+    large next to a tunnel blip."""
+    for i in range(attempts):
+        if _device_backend_alive(probe_timeout_s):
+            if i:
+                log(f"accelerator answered on probe attempt {i + 1}")
+            return True
+        if i + 1 < attempts:
+            log(
+                f"accelerator probe {i + 1}/{attempts} failed; "
+                f"retrying in {backoff_s:.0f}s"
+            )
+            time.sleep(backoff_s)
+    return False
+
+
 def _bench_lock(max_wait_s: float = 3600.0) -> None:
     """Cooperative single-runner lock: two benches sharing one chip OOM
     each other into false negatives.  If another live bench holds the
     lock, wait for it (finishing late beats colliding); a stale lock
     (dead pid) is ignored."""
-    path = "/tmp/docqa_bench.lock"
+    # flock, not a pid file: acquisition is atomic in the kernel, release is
+    # automatic on process death (no stale-pid detection, no TOCTOU between
+    # judging a lock stale and unlinking it), and the file itself is never
+    # removed so every bench locks the same inode.
+    import fcntl
+
+    try:
+        fd = os.open("/tmp/docqa_bench.lock", os.O_CREAT | os.O_WRONLY, 0o666)
+    except Exception:
+        return  # lock is cooperative; never let it kill the bench
     deadline = time.time() + max_wait_s
     while True:
         try:
-            holder = int(open(path).read().strip())
-            os.kill(holder, 0)  # raises if dead
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            # keep fd open for the process lifetime: closing it would drop
+            # the lock (module global, intentionally never closed)
+            globals()["_bench_lock_fd"] = fd
+            return
+        except OSError:
             if time.time() > deadline:
-                log(f"bench lock held by {holder} past wait budget; proceeding")
-                break
-            log(f"bench lock held by live pid {holder}; waiting")
+                log("bench lock held past wait budget; proceeding")
+                return
+            log("bench lock held by another bench; waiting")
             time.sleep(30)
-            continue
-        except (FileNotFoundError, ValueError, ProcessLookupError, PermissionError):
-            break
-    try:
-        with open(path, "w") as f:
-            f.write(str(os.getpid()))
-    except Exception:
-        pass
 
 
 def main() -> None:
     _bench_lock()
-    if not _device_backend_alive():
+    if not _device_backend_alive_retrying():
         # degrade honestly: a CPU smoke run labeled as such beats a hang
         log(
             "accelerator backend unreachable (tunnel down?) — "
@@ -453,17 +479,24 @@ def main() -> None:
             gc.collect()
         return n_req / wall, wall, lat_ms
 
-    def sweep_load(engine, n_req, cache_len, extra_combos):
-        """Measure (16, 32), then — if short of BASELINE config 5's QPS 16
-        target — sweep extra (n_slots, chunk) combos: slots and chunk trade
-        per-request latency for aggregate throughput, and the served config
-        should be the measured winner, not a guess.  Returns the rag_load
-        DETAILS dict."""
+    def sweep_load(engine, n_req, cache_len, grid):
+        """A REAL knob grid (VERDICT r3 item 2): measure every (n_slots,
+        chunk) combo in ``grid`` — slots and chunk trade per-request latency
+        for aggregate throughput, and the served config should be the
+        measured winner, not a guess.  Stops early only once the target is
+        comfortably beaten (QPS ≥ 20: past that the remaining bench budget
+        buys more than another grid point does).  Returns the rag_load
+        DETAILS dict; the speculative_k stage runs at the winner after."""
         attempts = []
-        qps, wall, lat = run_load(engine, 16, 32, n_req, cache_len)
-        attempts.append({"n_slots": 16, "chunk": 32, "qps": round(qps, 2)})
-        if not small and qps < 16:
-            for ns, ch in extra_combos:
+        qps, wall, lat = run_load(engine, *grid[0], n_req, cache_len)
+        attempts.append(
+            {"n_slots": grid[0][0], "chunk": grid[0][1], "qps": round(qps, 2)}
+        )
+        if not small:
+            for ns, ch in grid[1:]:
+                if qps >= 20:
+                    attempts.append({"skipped_past": f"({ns},{ch})"})
+                    break
                 try:
                     q2, w2, l2 = run_load(engine, ns, ch, n_req, cache_len)
                 except Exception as e:
@@ -474,7 +507,9 @@ def main() -> None:
                 )
                 if q2 > qps:
                     qps, wall, lat = q2, w2, l2
-        best = max(attempts, key=lambda a: a["qps"])
+        best = max(
+            (a for a in attempts if "qps" in a), key=lambda a: a["qps"]
+        )
         return {
             "requests": n_req,
             "wall_s": round(wall, 2),
@@ -491,41 +526,52 @@ def main() -> None:
     try:
         n_req = 64 if not small else 8
         cache_len = 1024 if not small else 256
+        # stage 1 of the grid: n_slots x chunk (16,32) first — the prior
+        # rounds' serving default — then the rest in rising-cost order
         DETAILS["rag_load"] = sweep_load(
-            gen, n_req, cache_len, ((32, 32), (16, 64), (32, 64))
+            gen,
+            n_req,
+            cache_len,
+            ((16, 32), (32, 32), (16, 64), (32, 64), (16, 16), (32, 16)),
         )
-        if not small and DETAILS["rag_load"]["sustained_qps"] < 16:
-            # last knob (VERDICT r2 item 2): speculation — each batcher
-            # chunk verifies spec_k draft tokens per slot in one weight
-            # read, raising aggregate tokens/read.  Own try: a failure
-            # here must not wipe the measured sweep above.
+        if not small and DETAILS["rag_load"]["sustained_qps"] < 20:
+            # stage 2 of the grid (VERDICT r2 item 2 / r3 item 2):
+            # speculative_k at the stage-1 winner — each batcher chunk
+            # verifies spec_k draft tokens per slot in one weight read,
+            # raising aggregate tokens/read.  Own try: a failure here must
+            # not wipe the measured sweep above.
             try:
                 bk = DETAILS["rag_load"]["best_knobs"]
-                gen_spec = GenerateEngine(
-                    dataclasses.replace(dec_cfg, quantize_weights=True),
-                    GenerateConfig(speculative_k=4),
-                    mesh=mesh,
-                    params=gen.params,
-                )
-                try:
-                    qs, ws, ls = run_load(
-                        gen_spec, bk["n_slots"], bk["chunk"], n_req,
-                        cache_len,
+                for spec_k in (4, 8):
+                    gen_spec = GenerateEngine(
+                        dataclasses.replace(dec_cfg, quantize_weights=True),
+                        GenerateConfig(speculative_k=spec_k),
+                        mesh=mesh,
+                        params=gen.params,
                     )
-                finally:
-                    del gen_spec
-                    gc.collect()
-                DETAILS["rag_load"]["attempts"].append(
-                    {**bk, "speculative_k": 4, "qps": round(qs, 2)}
-                )
-                if qs > DETAILS["rag_load"]["sustained_qps"]:
-                    DETAILS["rag_load"].update(
-                        sustained_qps=round(qs, 2),
-                        wall_s=round(ws, 2),
-                        request_p50_ms=round(float(np.percentile(ls, 50)), 1),
-                        request_p95_ms=round(float(np.percentile(ls, 95)), 1),
-                        best_knobs={**bk, "speculative_k": 4},
+                    try:
+                        qs, ws, ls = run_load(
+                            gen_spec, bk["n_slots"], bk["chunk"], n_req,
+                            cache_len,
+                        )
+                    finally:
+                        del gen_spec
+                        gc.collect()
+                    DETAILS["rag_load"]["attempts"].append(
+                        {**bk, "speculative_k": spec_k, "qps": round(qs, 2)}
                     )
+                    if qs > DETAILS["rag_load"]["sustained_qps"]:
+                        DETAILS["rag_load"].update(
+                            sustained_qps=round(qs, 2),
+                            wall_s=round(ws, 2),
+                            request_p50_ms=round(
+                                float(np.percentile(ls, 50)), 1
+                            ),
+                            request_p95_ms=round(
+                                float(np.percentile(ls, 95)), 1
+                            ),
+                            best_knobs={**bk, "speculative_k": spec_k},
+                        )
             except Exception as e:
                 log(f"config5 speculation attempt failed: {e!r}")
                 DETAILS["rag_load"]["speculation_error"] = repr(e)[:200]
