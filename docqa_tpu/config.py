@@ -85,7 +85,12 @@ class NERConfig:
     # generator (training/ner.py) and cache.  train_steps=0 keeps random-init
     # weights — pipeline-plumbing mode only, never masks contextual PHI.
     params_path: Optional[str] = None
-    train_steps: int = 500
+    train_steps: int = 1500
+    # cross-entropy weight on entity (non-O) labels: O is ~82 % of
+    # supervised positions and a fresh tagger otherwise sits in the
+    # all-O collapse for hundreds of steps (observed: 500 steps of the
+    # unweighted loss served all-O)
+    entity_loss_weight: float = 4.0
 
     @property
     def num_labels(self) -> int:

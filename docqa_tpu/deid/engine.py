@@ -70,25 +70,64 @@ _PERSON_TITLE_RE = re.compile(
     r"((?:[A-Z][\w'-]+)(?:\s+[A-Z][\w'-]+){0,2})"
 )
 
+# Context-cue recognizers (gazetteer-style, VERDICT r3 item 4): a clinical
+# note names a place/affiliation after a small set of cue phrases.  The NER
+# tagger usually FINDS these spans but — trained on synthetic data — can
+# mistype them (PERSON is its majority class); an explicit cue pins the
+# type.  Cues only, never a fixed name list: unseen cities/groups must
+# still resolve (the same reason Presidio pairs patterns WITH its NER,
+# ``deid-service/anonymizer.py:29-35``).
+_CAPSPAN = r"((?:[A-Z][\w'’-]+)(?:\s+[A-Z][\w'’-]+){0,2})"
+_LOC_CUE_RE = re.compile(
+    r"\b(?i:lives?\s+in|resides?\s+in|residence\s*:|home\s+in|clinic\s+in|"
+    r"hospital\s+in|facility\s+in|pharmacist\s+in|transferr?ed\s+from|"
+    r"transfer\s+from|moved\s+(?:to|from)|travell?ed\s+(?:to|from)|"
+    r"arrived\s+(?:by\s+\w+\s+)?from|drove\s+(?:\w+\s+){0,2}from|"
+    r"joined\s+from|discharged\s+to(?:\s+\w+){0,4}\s+in|"
+    r"address\s*:|habite|originaire\s+de)\s+" + _CAPSPAN
+)
+_NRP_CUE_RE = re.compile(
+    # "member of the <X>" alone would mask staff/org phrases ("member of
+    # the ICU Team"); it only signals NRP when a congregation-class noun
+    # follows the captured span
+    r"\b(?i:practicing|practising|devout|observant|identifies\s+as|"
+    r"identify\s+as|faith\s+is\s+recorded\s+as|d'origine)\s+" + _CAPSPAN
+    + r"|\b(?i:member\s+of\s+the(?:\s+local)?)\s+" + _CAPSPAN
+    + r"(?=\s+(?i:congregation|community|church|temple|mosque|parish|faith))"
+)
+
 _MIN_PHONE_DIGITS = 7
 
 
 def _pattern_results(text: str) -> List[RecognizerResult]:
+    # Structural patterns outscore the NER model on overlap (resolution is
+    # highest-score-wins, anonymize_text): a date/email/phone match is
+    # anchored on digits/format, while a softmax can be confidently wrong —
+    # e.g. a tagger typing "April 12, 2026" PERSON at 0.99 must not strip
+    # the DATE_TIME mask.
     out: List[RecognizerResult] = []
     for m in _EMAIL_RE.finditer(text):
-        out.append(RecognizerResult("EMAIL_ADDRESS", m.start(), m.end(), 1.0))
+        out.append(RecognizerResult("EMAIL_ADDRESS", m.start(), m.end(), 1.2))
     for m in _DATE_RE.finditer(text):
-        out.append(RecognizerResult("DATE_TIME", m.start(), m.end(), 0.85))
+        out.append(RecognizerResult("DATE_TIME", m.start(), m.end(), 1.1))
     for m in _PHONE_RE.finditer(text):
         digits = sum(c.isdigit() for c in m.group())
         if digits >= _MIN_PHONE_DIGITS:
             out.append(
-                RecognizerResult("PHONE_NUMBER", m.start(), m.end(), 0.8)
+                RecognizerResult("PHONE_NUMBER", m.start(), m.end(), 1.05)
             )
     for m in _PERSON_TITLE_RE.finditer(text):
         out.append(
             RecognizerResult("PERSON", m.start(1), m.end(1), 0.75)
         )
+    # cue recognizers outrank ANY NER softmax (<= 1.0) on overlap — an
+    # explicit textual cue beats a model guess — but lose to the structural
+    # digit/format patterns above
+    for m in _LOC_CUE_RE.finditer(text):
+        out.append(RecognizerResult("LOCATION", m.start(1), m.end(1), 1.02))
+    for m in _NRP_CUE_RE.finditer(text):
+        g = 1 if m.group(1) is not None else 2
+        out.append(RecognizerResult("NRP", m.start(g), m.end(g), 1.02))
     return out
 
 

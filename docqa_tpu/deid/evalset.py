@@ -154,7 +154,14 @@ def evaluate_deid(engine, examples=None) -> Dict[str, object]:
     """
     examples = examples if examples is not None else EXAMPLES
     texts = [t for t, _ in examples]
-    preds = engine.analyze_batch(texts)
+    from docqa_tpu.deid.engine import _resolve_overlaps
+
+    # Score the spans the system actually MASKS: anonymize_text resolves
+    # overlapping recognizer results (highest score wins) before replacing,
+    # so raw analyze output would double-count e.g. a DATE_TIME and a
+    # PHONE_NUMBER pattern firing on the same digits as a typed FP the
+    # product never emits.
+    preds = [_resolve_overlaps(rs) for rs in engine.analyze_batch(texts)]
 
     c_tp = c_fp = c_fn = 0
     gold_total = gold_hit = 0

@@ -45,13 +45,27 @@ def ner_loss(
     logits = ner_forward(params, cfg, ids, lengths)  # [b, s, L] f32
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    # entity positions are ~18 % of the supervision; weighting them keeps
+    # the optimizer out of the all-O collapse (NERConfig docstring)
+    w = jnp.where(labels > 0, cfg.entity_loss_weight, 1.0) * mask
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def default_ner_optimizer(lr: float = 1e-3) -> optax.GradientTransformation:
+def default_ner_optimizer(
+    lr: float = 1e-3, steps: Optional[int] = None, warmup: int = 100
+) -> optax.GradientTransformation:
+    """AdamW with global-norm clipping; when ``steps`` is given the lr
+    follows linear-warmup + cosine-decay (constant lr measured unstable:
+    single-batch loss oscillated 0.37→0.73 over 500 steps)."""
+    if steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, lr, min(warmup, max(steps // 10, 1)), steps, lr * 0.05
+        )
+    else:
+        schedule = lr
     return optax.chain(
         optax.clip_by_global_norm(1.0),
-        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=0.01),
     )
 
 
@@ -86,7 +100,7 @@ def make_ner_train_step(
 def train_ner(
     cfg: NERConfig,
     *,
-    steps: int = 500,
+    steps: Optional[int] = None,
     batch_size: int = 32,
     seq: int = 128,
     lr: float = 2e-3,
@@ -102,6 +116,8 @@ def train_ner(
     """
     from docqa_tpu.deid.datagen import ner_tokenizer, sample_batch
 
+    if steps is None:
+        steps = cfg.train_steps
     if steps < 1:
         raise ValueError(
             f"train_ner needs steps >= 1, got {steps}; a 0-step 'trained' "
@@ -112,7 +128,7 @@ def train_ner(
     if mesh is not None and batch_size % mesh.n_data:
         batch_size += mesh.n_data - batch_size % mesh.n_data
     params = init_ner_params(jax.random.PRNGKey(seed), cfg)
-    optimizer = default_ner_optimizer(lr)
+    optimizer = default_ner_optimizer(lr, steps=steps)
     opt_state = optimizer.init(params)
     step_fn = make_ner_train_step(cfg, optimizer, mesh=mesh)
     rng = np.random.default_rng(seed)
@@ -142,7 +158,13 @@ def evaluate_ner(
     threshold: float = 0.5,
 ) -> Dict[str, float]:
     """Exact-span precision / recall / F1 against gold spans of synthetic
-    notes filled from EVAL_LEXICONS (disjoint from training)."""
+    notes filled from EVAL_LEXICONS (disjoint from training).
+
+    Scores the TAGGER ALONE (``engine._ner_results``, not the merged
+    analyze output): the cue regexes in ``deid/engine.py`` literally match
+    several datagen templates, so including them would credit a collapsed
+    all-O model with their hits — this metric gates the training recipe
+    and must not be maskable by patterns."""
     from docqa_tpu.deid.datagen import (
         EVAL_LEXICONS,
         generate_example,
@@ -163,7 +185,7 @@ def evaluate_ner(
         text, spans = generate_example(rng, EVAL_LEXICONS, gibberish_frac=0.0)
         texts.append(text)
         golds.append({(a, b, e) for a, b, e in spans})
-    results = engine.analyze_batch(texts)
+    results = engine._ner_results(texts)
     tp = fp = fn = 0
     for rs, gold in zip(results, golds):
         pred = {
@@ -186,10 +208,19 @@ def evaluate_ner(
 # ---------------------------------------------------------------------------
 
 def save_ner_params(
-    path: str, params: Params, cfg: NERConfig, train_seq: int = 128
+    path: str,
+    params: Params,
+    cfg: NERConfig,
+    train_seq: int = 128,
+    train_steps: Optional[int] = None,
 ) -> None:
+    """``train_steps`` must be the steps ACTUALLY trained (a smoke run
+    saving a 2-step tagger under a 1500-step fingerprint would later be
+    served silently — the exact leak the fingerprint exists to stop)."""
     arrays = {k: np.asarray(v) for k, v in params.items()}
-    arrays["__fingerprint__"] = np.asarray(_fingerprint(cfg))
+    arrays["__fingerprint__"] = np.asarray(
+        _fingerprint(cfg, train_steps if train_steps is not None else cfg.train_steps)
+    )
     # serving must window at the trained length — longer positions have
     # untrained position embeddings (see train_ner docstring)
     arrays["__train_seq__"] = np.asarray(train_seq)
@@ -200,15 +231,20 @@ def save_ner_params(
     os.replace(tmp, path)
 
 
-def load_ner_params(path: str, cfg: NERConfig) -> Optional[Params]:
-    """None if missing or trained under a different architecture."""
+def load_ner_params(
+    path: str, cfg: NERConfig, steps: Optional[int] = None
+) -> Optional[Params]:
+    """None if missing or trained under a different architecture/recipe.
+    ``steps``: the steps the CALLER would train with (defaults to
+    ``cfg.train_steps``) — a cache trained with fewer is not a match."""
     if not os.path.exists(path):
         return None
     with np.load(path) as z:
         arrays = {k: z[k] for k in z.files}
     fp = arrays.pop("__fingerprint__", None)
     arrays.pop("__train_seq__", None)
-    if fp is None or fp.tolist() != _fingerprint(cfg):
+    want = _fingerprint(cfg, steps if steps is not None else cfg.train_steps)
+    if fp is None or fp.tolist() != want:
         log.warning("ner params at %s do not match config; retraining", path)
         return None
     return {k: jnp.asarray(v) for k, v in arrays.items()}
@@ -223,10 +259,14 @@ def load_ner_train_seq(path: str) -> Optional[int]:
         return int(z["__train_seq__"])
 
 
-def _fingerprint(cfg: NERConfig) -> list:
+def _fingerprint(cfg: NERConfig, steps: int) -> list:
     return [
         cfg.vocab_size, cfg.hidden_dim, cfg.num_layers, cfg.num_heads,
         cfg.mlp_dim, cfg.max_seq_len, cfg.num_labels,
+        # training-recipe fields: a cache trained under the collapsed
+        # unweighted-loss recipe (or with fewer steps) must invalidate,
+        # not serve an under-fit tagger
+        steps, int(cfg.entity_loss_weight * 100),
     ]
 
 
@@ -236,14 +276,17 @@ def load_or_train(
     **train_kw,
 ) -> Tuple[Params, int]:
     """(params, train_seq).  ``train_seq`` is the serving window bound."""
+    steps = train_kw.get("steps")
+    if steps is None:
+        steps = cfg.train_steps
     if path:
-        params = load_ner_params(path, cfg)
+        params = load_ner_params(path, cfg, steps=steps)
         if params is not None:
             log.info("loaded ner params from %s", path)
             return params, load_ner_train_seq(path) or 128
     seq = min(train_kw.get("seq", 128), cfg.max_seq_len)
     params = train_ner(cfg, **train_kw)
     if path:
-        save_ner_params(path, params, cfg, train_seq=seq)
+        save_ner_params(path, params, cfg, train_seq=seq, train_steps=steps)
         log.info("saved ner params to %s", path)
     return params, seq

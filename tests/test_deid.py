@@ -46,6 +46,68 @@ class TestPatternRecognizers:
         assert text[person.start:person.end] == "Marie Dupont"
 
 
+class TestCueRecognizers:
+    """Gazetteer-style CONTEXT cues (no fixed name lists): an explicit cue
+    phrase pins the type the synthetic-trained tagger most often flips."""
+
+    def _spans(self, text, etype):
+        return [
+            text[r.start : r.end]
+            for r in _pattern_results(text)
+            if r.entity_type == etype
+        ]
+
+    def test_location_cues(self):
+        cases = {
+            "He moved from Portland last winter.": "Portland",
+            "Transfer from Mount Auburn pending bed.": "Mount Auburn",
+            "Her pharmacist in Quincy will supervise dosing.": "Quincy",
+            "Patient joined from Fall River and verified identity.": "Fall River",
+            "Residence: New Bedford.": "New Bedford",
+            "She was discharged to her home in Worcester yesterday.": "Worcester",
+        }
+        for text, want in cases.items():
+            assert want in self._spans(text, "LOCATION"), text
+
+    def test_nrp_cues(self):
+        cases = {
+            "The patient is a practicing Buddhist and requests a diet.": "Buddhist",
+            "As an observant Muslim patient he fasts.": "Muslim",
+            "Family identifies as Jehovah's Witnesses; blood declined.": "Jehovah's Witnesses",
+            "She is an active member of the local Methodist congregation.": "Methodist",
+        }
+        for text, want in cases.items():
+            assert want in self._spans(text, "NRP"), text
+
+    def test_cues_need_capitalized_span(self):
+        # cue + lowercase continuation must NOT fire (no PHI present)
+        for text in (
+            "He lives in comfortable surroundings now.",
+            "She is a practicing physician at the clinic.",
+            "Patient was transferred from another facility overnight.",
+        ):
+            rs = _pattern_results(text)
+            assert not any(
+                r.entity_type in ("LOCATION", "NRP") for r in rs
+            ), text
+
+    def test_cue_outranks_mistyped_ner_on_overlap(self):
+        from docqa_tpu.deid.engine import (
+            RecognizerResult,
+            _resolve_overlaps,
+        )
+
+        text = "Transfer from Mount Auburn pending bed."
+        cue = next(
+            r
+            for r in _pattern_results(text)
+            if r.entity_type == "LOCATION"
+        )
+        ner_wrong = RecognizerResult("PERSON", cue.start, cue.end, 0.9)
+        picked = _resolve_overlaps([ner_wrong, cue])
+        assert [r.entity_type for r in picked] == ["LOCATION"]
+
+
 class TestOverlapAndAnonymize:
     def test_overlap_highest_score_wins(self):
         rs = [
