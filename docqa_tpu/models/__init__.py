@@ -3,5 +3,15 @@ from docqa_tpu.models.encoder import (
     encoder_forward,
     init_encoder_params,
 )
+from docqa_tpu.models.hf_checkpoint import (
+    generate_engine_from_dir,
+    load_checkpoint_dir,
+)
 
-__all__ = ["init_encoder_params", "encoder_forward", "encode_batch"]
+__all__ = [
+    "init_encoder_params",
+    "encoder_forward",
+    "encode_batch",
+    "load_checkpoint_dir",
+    "generate_engine_from_dir",
+]
