@@ -57,6 +57,12 @@ class EncoderConfig:
     # real-vocabulary file for imported checkpoints: vocab.txt (WordPiece,
     # MiniLM/BERT) / tokenizer.json / tokenizer.model.  None → hash fallback.
     tokenizer_path: Optional[str] = None
+    # HF checkpoint DIRECTORY (config.json + safetensors + tokenizer) for
+    # the serving runtime — the ergonomic the reference gets from a model
+    # name (``indexer.py:21``: all-MiniLM-L6-v2).  When set, DocQARuntime
+    # loads architecture + weights + vocabulary from here and this
+    # config's architecture fields are ignored (models/hf_checkpoint.py).
+    checkpoint_dir: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -126,6 +132,13 @@ class DecoderConfig:
     # (byte-level or metaspace BPE) or tokenizer.model (SentencePiece) —
     # text/bpe.py.  None → hash fallback (zero-egress default).
     tokenizer_path: Optional[str] = None
+    # HF checkpoint DIRECTORY for the serving runtime — the ergonomic the
+    # reference gets from ``ChatOllama(model="mistral")``
+    # (``llm-qa/main.py:66-69``).  When set, DocQARuntime loads
+    # architecture + weights + vocabulary from here; this config's
+    # architecture fields are ignored but quantize_weights/quant_bits
+    # still govern the serving precision (quantize-on-load).
+    checkpoint_dir: Optional[str] = None
 
     @staticmethod
     def mistral_7b() -> "DecoderConfig":
@@ -183,18 +196,26 @@ class Seq2SeqConfig:
     forced_bos_id: Optional[int] = None
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
-    # beam search; 1 = greedy.  (Of bart-large-cnn's shipped generation
-    # config this implements num_beams / length_penalty /
-    # forced_bos_token_id / min_length / no_repeat_ngram_size;
-    # early_stopping is not — the loop runs to EOS-or-horizon, which can
-    # only find better hypotheses than stopping early.)
-    num_beams: int = 1
-    length_penalty: float = 1.0
-    min_length: int = 0  # EOS masked until this many tokens emitted
-    no_repeat_ngram: int = 0  # 0 = off; n bans repeating any n-gram
+    # Generation policy; None = UNSET (engine decodes greedy/unconstrained,
+    # and a checkpoint_dir's shipped policy is free to take effect) — a
+    # set value always wins, including explicitly setting the engine
+    # default (num_beams=1 forces greedy over a checkpoint that ships 4).
+    # (Of bart-large-cnn's shipped generation config this implements
+    # num_beams / length_penalty / forced_bos_token_id / min_length /
+    # no_repeat_ngram_size; early_stopping is not — the loop runs to
+    # EOS-or-horizon, which can only find better hypotheses than stopping
+    # early.)
+    num_beams: Optional[int] = None  # effective default 1 (greedy)
+    length_penalty: Optional[float] = None  # effective default 1.0
+    min_length: Optional[int] = None  # EOS masked below this; default 0
+    no_repeat_ngram: Optional[int] = None  # n bans repeat n-grams; default 0
     # real-vocabulary file (tokenizer.json — bart-large-cnn ships byte-level
     # BPE).  None → hash fallback.
     tokenizer_path: Optional[str] = None
+    # HF checkpoint DIRECTORY (bart-large-cnn layout) for the serving
+    # runtime; when set, DocQARuntime's seq2seq summarizer loads
+    # architecture + weights + vocabulary from here.
+    checkpoint_dir: Optional[str] = None
 
     @staticmethod
     def bart_large_cnn() -> "Seq2SeqConfig":
@@ -435,7 +456,11 @@ def load_config(
         if field_name not in by_name:
             continue
         current = getattr(section, field_name)
-        target_type = type(current) if current is not None else str
+        # None-default (Optional) fields carry no type to coerce to: use
+        # the generic fallback (int → float → none/bool → raw string) so
+        # DOCQA_SEQ2SEQ__NUM_BEAMS=4 arrives as 4, not "4" (str would
+        # silently break every numeric Optional knob)
+        target_type = type(current) if current is not None else object
         sections[section_name] = dataclasses.replace(
             section, **{field_name: _coerce(raw, target_type)}
         )

@@ -63,23 +63,29 @@ class Seq2SeqEngine:
         fn = self._fns.get(max_new)
         if fn is None:
             cfg = self.cfg
+            # policy knobs are Optional on the config (None = unset so a
+            # checkpoint's shipped policy can apply); effective values here
+            n_beams = cfg.num_beams if cfg.num_beams is not None else 1
+            min_len = cfg.min_length if cfg.min_length is not None else 0
+            ngram = (
+                cfg.no_repeat_ngram if cfg.no_repeat_ngram is not None else 0
+            )
+            lp = (
+                cfg.length_penalty if cfg.length_penalty is not None else 1.0
+            )
             # min_length / no_repeat_ngram are implemented in the beam
             # program; with them set, n_beams=1 routes through it too
             # (beam-1 is exactly greedy plus the constraints)
-            if (
-                cfg.num_beams > 1
-                or cfg.min_length > 0
-                or cfg.no_repeat_ngram >= 1
-            ):
+            if n_beams > 1 or min_len > 0 or ngram >= 1:
                 fn = jax.jit(
                     functools.partial(
                         beam_summarize_fn,
                         cfg=cfg,
                         max_new=max_new,
-                        n_beams=cfg.num_beams,
-                        length_penalty=cfg.length_penalty,
-                        min_length=cfg.min_length,
-                        no_repeat_ngram=cfg.no_repeat_ngram,
+                        n_beams=n_beams,
+                        length_penalty=lp,
+                        min_length=min_len,
+                        no_repeat_ngram=ngram,
                     )
                 )
             else:

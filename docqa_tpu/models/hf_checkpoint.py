@@ -74,6 +74,14 @@ def _seq2seq_config(hf: Dict[str, Any], tokenizer_path) -> Seq2SeqConfig:
         eos_id=hf.get("eos_token_id", 2),
         decoder_start_id=hf.get("decoder_start_token_id", 2),
         forced_bos_id=hf.get("forced_bos_token_id"),
+        # the checkpoint's SHIPPED generation policy (bart-large-cnn puts
+        # num_beams=4 / length_penalty=2.0 / min_length=56 /
+        # no_repeat_ngram_size=3 right in config.json) — serving a real
+        # summarizer greedy/unconstrained would silently degrade it
+        num_beams=int(hf.get("num_beams", 1)),
+        length_penalty=float(hf.get("length_penalty", 1.0)),
+        min_length=int(hf.get("min_length", 0)),
+        no_repeat_ngram=int(hf.get("no_repeat_ngram_size", 0)),
         tokenizer_path=tokenizer_path,
     )
 
@@ -91,18 +99,52 @@ def _encoder_config(hf: Dict[str, Any], tokenizer_path) -> EncoderConfig:
     )
 
 
-_DECODER_TYPES = ("llama", "mistral", "qwen2", "gemma")
+# llama/mistral ONLY: qwen2 ships attention biases and gemma changes
+# RMSNorm/embedding-scale/GeGLU — the Llama mapper would load either
+# without error and serve numerically wrong text with no diagnostic
+_DECODER_TYPES = ("llama", "mistral")
 _SEQ2SEQ_TYPES = ("bart", "mbart")
-_ENCODER_TYPES = ("bert", "roberta", "distilbert")
+# bert ONLY: distilbert renames every config key (dim/n_layers/n_heads)
+# and roberta prefixes weights "roberta." — either would crash with a raw
+# KeyError deep in the mapper, the non-actionable failure this module
+# exists to prevent
+_ENCODER_TYPES = ("bert",)
+
+_FAMILY_TYPES = {
+    DecoderConfig: _DECODER_TYPES,
+    Seq2SeqConfig: _SEQ2SEQ_TYPES,
+    EncoderConfig: _ENCODER_TYPES,
+}
+_FAMILY_NAMES = {
+    DecoderConfig: "a Llama/Mistral-family decoder",
+    Seq2SeqConfig: "a BART-family seq2seq",
+    EncoderConfig: "a BERT-family encoder",
+}
 
 
-def load_checkpoint_dir(path: str) -> Tuple[Any, Any, Optional[str]]:
+def load_checkpoint_dir(
+    path: str,
+    *,
+    expect: Optional[type] = None,
+    keep: Optional[Dict[str, Any]] = None,
+    tokenizer_fallback: Optional[str] = None,
+) -> Tuple[Any, Any, Optional[str]]:
     """(framework_config, params, tokenizer_path) from an HF directory.
 
     Dispatches on ``config.json``'s ``model_type``: Llama/Mistral-family →
     (:class:`DecoderConfig`, decoder params), BART → (:class:`Seq2SeqConfig`,
     seq2seq params), BERT-family → (:class:`EncoderConfig`, encoder params).
-    """
+
+    ``expect`` (a config class) rejects a wrong-family directory from
+    ``config.json`` alone — BEFORE the weight shards are read, so pointing
+    the encoder at a 7B decoder dir costs a config read, not a 14 GB load.
+    ``keep`` fields override the loaded config (serving-policy knobs the
+    operator keeps control of).  ``tokenizer_fallback`` is used when the
+    directory ships no tokenizer file; a real-weights checkpoint with NO
+    vocabulary at all is an error — silently hash-tokenizing real
+    embeddings would serve pure gibberish."""
+    import dataclasses
+
     with open(os.path.join(path, "config.json"), encoding="utf-8") as f:
         hf = json.load(f)
     model_type = hf.get("model_type", "")
@@ -114,12 +156,26 @@ def load_checkpoint_dir(path: str) -> Tuple[Any, Any, Optional[str]]:
             f"(decoder: {_DECODER_TYPES}, seq2seq: {_SEQ2SEQ_TYPES}, "
             f"encoder: {_ENCODER_TYPES})"
         )
-    tok = _find_tokenizer(path)
+    if expect is not None and model_type not in _FAMILY_TYPES[expect]:
+        raise ValueError(
+            f"{path} has model_type {model_type!r} — not "
+            f"{_FAMILY_NAMES[expect]} checkpoint"
+        )
+    tok = _find_tokenizer(path) or tokenizer_fallback
+    if tok is None:
+        raise ValueError(
+            f"no tokenizer.json / tokenizer.model / vocab.txt in {path} "
+            "and no tokenizer_path configured — real weights with a "
+            "hash-fallback vocabulary would serve gibberish; ship the "
+            "tokenizer file or set <section>.tokenizer_path"
+        )
     shards = _find_weights(path)
     if model_type in _DECODER_TYPES:
         from docqa_tpu.models.decoder import load_hf_llama_weights
 
         cfg = _decoder_config(hf, tok)
+        if keep:
+            cfg = dataclasses.replace(cfg, **keep)
         return cfg, load_hf_llama_weights(shards, cfg), tok
     if len(shards) > 1:
         # the bart/bert mappers take one file; their real checkpoints
@@ -134,10 +190,14 @@ def load_checkpoint_dir(path: str) -> Tuple[Any, Any, Optional[str]]:
         from docqa_tpu.models.seq2seq import load_hf_bart_weights
 
         cfg = _seq2seq_config(hf, tok)
+        if keep:
+            cfg = dataclasses.replace(cfg, **keep)
         return cfg, load_hf_bart_weights(shards[0], cfg), tok
     from docqa_tpu.models.encoder import load_hf_bert_weights
 
     cfg = _encoder_config(hf, tok)
+    if keep:
+        cfg = dataclasses.replace(cfg, **keep)
     return cfg, load_hf_bert_weights(shards[0], cfg), tok
 
 
@@ -147,19 +207,24 @@ def generate_engine_from_dir(
     quant_bits: Optional[int] = None,
     mesh=None,
     gen=None,
+    tokenizer_path: Optional[str] = None,
 ):
     """A ready :class:`~docqa_tpu.engines.generate.GenerateEngine` from an
     HF Llama/Mistral checkpoint directory.  ``quant_bits`` 8/4 quantizes
-    the float tree on load (the 16 GB-chip serving path)."""
-    import dataclasses
-
+    the float tree on load (the 16 GB-chip serving path).
+    ``tokenizer_path`` supplies the vocabulary for a weights-only
+    directory (tokenizer shipped separately)."""
     from docqa_tpu.engines.generate import GenerateEngine
 
-    cfg, params, _tok = load_checkpoint_dir(path)
-    if not isinstance(cfg, DecoderConfig):
-        raise ValueError(f"{path} is not a decoder checkpoint ({type(cfg)})")
-    if quant_bits:
-        cfg = dataclasses.replace(
-            cfg, quantize_weights=True, quant_bits=quant_bits
-        )
+    keep = (
+        {"quantize_weights": True, "quant_bits": quant_bits}
+        if quant_bits
+        else None
+    )
+    cfg, params, _tok = load_checkpoint_dir(
+        path,
+        expect=DecoderConfig,
+        keep=keep,
+        tokenizer_fallback=tokenizer_path,
+    )
     return GenerateEngine(cfg, gen=gen, params=params, mesh=mesh)

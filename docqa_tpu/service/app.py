@@ -71,7 +71,37 @@ class DocQARuntime:
         self.mesh = make_mesh(self.cfg.mesh) if jax.device_count() > 1 else None
 
         if self.cfg.flags.use_fake_encoder:
+            if self.cfg.encoder.checkpoint_dir:
+                # surface the conflict: the operator configured a real
+                # checkpoint but the fake flag wins — silent hash
+                # embeddings "from" a real model is the trap
+                log.warning(
+                    "flags.use_fake_encoder=true shadows "
+                    "encoder.checkpoint_dir=%s — serving HASH embeddings",
+                    self.cfg.encoder.checkpoint_dir,
+                )
             self.encoder = HashEncoder(self.cfg.encoder)
+        elif self.cfg.encoder.checkpoint_dir:
+            # real-checkpoint serving: the ergonomic the reference gets
+            # from SentenceTransformer("all-MiniLM-L6-v2") (indexer.py:21)
+            from docqa_tpu.config import EncoderConfig
+            from docqa_tpu.models.hf_checkpoint import load_checkpoint_dir
+
+            enc_cfg, enc_params, _ = load_checkpoint_dir(
+                self.cfg.encoder.checkpoint_dir,
+                expect=EncoderConfig,
+                tokenizer_fallback=self.cfg.encoder.tokenizer_path,
+            )
+            if enc_cfg.embed_dim != self.cfg.store.dim:
+                raise ValueError(
+                    f"encoder checkpoint embeds {enc_cfg.embed_dim}-d but "
+                    f"store.dim is {self.cfg.store.dim} — set "
+                    f"DOCQA_STORE__DIM={enc_cfg.embed_dim} (an existing "
+                    "index snapshot of the old dim cannot be reused)"
+                )
+            self.encoder = EncoderEngine(
+                enc_cfg, mesh=self.mesh, params=enc_params
+            )
         else:
             self.encoder = EncoderEngine(self.cfg.encoder, mesh=self.mesh)
 
@@ -137,9 +167,52 @@ class DocQARuntime:
             )
         else:  # plumbing mode (tests): random-init tagger
             self.deid = DeidEngine(self.cfg.ner)
-        self.generator = GenerateEngine(
-            self.cfg.decoder, gen=self.cfg.generate, mesh=self.mesh
-        )
+        if self.cfg.decoder.checkpoint_dir and self.cfg.flags.use_fake_llm:
+            # the fake path never decodes — don't pay a multi-GB weight
+            # load for a generator nothing will invoke, but say so
+            log.warning(
+                "flags.use_fake_llm=true: decoder.checkpoint_dir=%s is NOT "
+                "loaded (fake answers are served)",
+                self.cfg.decoder.checkpoint_dir,
+            )
+        if self.cfg.decoder.checkpoint_dir and not self.cfg.flags.use_fake_llm:
+            # real-checkpoint serving: the ergonomic the reference gets
+            # from ChatOllama(model="mistral") (llm-qa/main.py:66-69).
+            # Architecture + weights + vocabulary come from the directory;
+            # the configured quantize_weights/quant_bits still govern the
+            # serving precision (quantize-on-load in GenerateEngine).
+            import dataclasses as _dc
+
+            from docqa_tpu.config import DecoderConfig
+            from docqa_tpu.models.hf_checkpoint import load_checkpoint_dir
+
+            dec_cfg, dec_params, _ = load_checkpoint_dir(
+                self.cfg.decoder.checkpoint_dir,
+                expect=DecoderConfig,
+                keep={
+                    "quantize_weights": self.cfg.decoder.quantize_weights,
+                    "quant_bits": self.cfg.decoder.quant_bits,
+                },
+                tokenizer_fallback=self.cfg.decoder.tokenizer_path,
+            )
+            # cap the context window at the CONFIGURED max_seq_len: the
+            # batcher sizes its KV cache from cfg.max_seq_len x n_slots,
+            # and a real checkpoint's max_position_embeddings (32k for
+            # Mistral, 128k for Llama-3.1) would OOM the 16 GB chip
+            dec_cfg = _dc.replace(
+                dec_cfg,
+                max_seq_len=min(
+                    dec_cfg.max_seq_len, self.cfg.decoder.max_seq_len
+                ),
+            )
+            self.generator = GenerateEngine(
+                dec_cfg, gen=self.cfg.generate, params=dec_params,
+                mesh=self.mesh,
+            )
+        else:
+            self.generator = GenerateEngine(
+                self.cfg.decoder, gen=self.cfg.generate, mesh=self.mesh
+            )
         # Continuous batcher: the serving path for ALL generation (BASELINE
         # config 5, QPS 16) — concurrent requests share decode-slot lanes of
         # one jit program instead of serializing whole requests.
@@ -165,13 +238,43 @@ class DocQARuntime:
 
             from docqa_tpu.engines.seq2seq import Seq2SeqEngine
 
-            summarizer_model = Seq2SeqEngine(self.cfg.seq2seq)
+            if self.cfg.seq2seq.checkpoint_dir:
+                # bart-large-cnn-layout directory: architecture + weights
+                # + vocabulary + SHIPPED generation policy come from the
+                # checkpoint's config.json; a policy knob the operator SET
+                # (non-None — the knobs are Optional exactly for this)
+                # overrides it, including setting the engine default
+                # (num_beams=1 forces greedy over a checkpoint's 4)
+                from docqa_tpu.config import Seq2SeqConfig
+                from docqa_tpu.models.hf_checkpoint import (
+                    load_checkpoint_dir,
+                )
+
+                _policy_knobs = (
+                    "num_beams", "length_penalty", "min_length",
+                    "no_repeat_ngram",
+                )
+                keep = {
+                    k: getattr(self.cfg.seq2seq, k)
+                    for k in _policy_knobs
+                    if getattr(self.cfg.seq2seq, k) is not None
+                }
+                s2s_cfg, s2s_params, _ = load_checkpoint_dir(
+                    self.cfg.seq2seq.checkpoint_dir,
+                    expect=Seq2SeqConfig,
+                    keep=keep,
+                    tokenizer_fallback=self.cfg.seq2seq.tokenizer_path,
+                )
+                summarizer_model = Seq2SeqEngine(s2s_cfg, params=s2s_params)
+            else:
+                s2s_cfg = self.cfg.seq2seq
+                summarizer_model = Seq2SeqEngine(s2s_cfg)
             summarizer_batcher = None
             summarizer_cfg = _dc.replace(
                 summarizer_cfg,
                 max_input_tokens=min(
                     summarizer_cfg.max_input_tokens,
-                    self.cfg.seq2seq.max_src_len,
+                    s2s_cfg.max_src_len,
                 ),
             )
             instruction_prompts = False  # BART summarizes raw source text

@@ -87,9 +87,11 @@ def llama_dir(tmp_path_factory):
     ] * 30
     tok.train_from_iterator(corpus, trainer)
     tok.save(str(d / "tokenizer.json"))
-    n_vocab = tok.get_vocab_size()
     # trainer may stop short of the requested size on a tiny corpus — keep
-    # config.json honest so embed shapes match
+    # config.json honest so embed shapes match.  Pad to a multiple of 64
+    # the way real checkpoints do (embed rows past the tokenizer's last id
+    # are legal and keep TP shardings divisible).
+    n_vocab = ((tok.get_vocab_size() + 63) // 64) * 64
     cfg_json = dict(HF_CONFIG, vocab_size=n_vocab)
     json.dump(cfg_json, open(d / "config.json", "w"))
 
@@ -152,3 +154,138 @@ class TestCheckpointDir:
         json.dump({"model_type": "t5"}, open(tmp_path / "config.json", "w"))
         with pytest.raises(ValueError, match="t5"):
             load_checkpoint_dir(str(tmp_path))
+
+    def test_unmapped_decoder_families_rejected(self, tmp_path):
+        # qwen2 ships attention biases the Llama mapper would silently
+        # drop — loading it must be an error, not garbage text
+        from docqa_tpu.models.hf_checkpoint import load_checkpoint_dir
+
+        json.dump({"model_type": "qwen2"}, open(tmp_path / "config.json", "w"))
+        with pytest.raises(ValueError, match="qwen2"):
+            load_checkpoint_dir(str(tmp_path))
+
+    def test_wrong_family_rejected_before_weights(self, tmp_path):
+        # expect= rejects from config.json ALONE: no safetensors exist in
+        # this dir, and the error must still be the family mismatch (not
+        # "no model*.safetensors")
+        from docqa_tpu.config import EncoderConfig
+        from docqa_tpu.models.hf_checkpoint import load_checkpoint_dir
+
+        json.dump(
+            {"model_type": "mistral"}, open(tmp_path / "config.json", "w")
+        )
+        with pytest.raises(ValueError, match="not a BERT-family"):
+            load_checkpoint_dir(str(tmp_path), expect=EncoderConfig)
+
+    def test_missing_tokenizer_is_an_error(self, llama_dir, tmp_path):
+        # weights-only directory: hash-tokenizing real embeddings would
+        # serve gibberish — must raise, unless a fallback path is given
+        import shutil
+
+        from docqa_tpu.models.hf_checkpoint import load_checkpoint_dir
+
+        d = tmp_path / "weights_only"
+        d.mkdir()
+        shutil.copy(f"{llama_dir}/config.json", d / "config.json")
+        shutil.copy(f"{llama_dir}/model.safetensors", d / "model.safetensors")
+        with pytest.raises(ValueError, match="no tokenizer"):
+            load_checkpoint_dir(str(d))
+        cfg, _params, tok = load_checkpoint_dir(
+            str(d), tokenizer_fallback=f"{llama_dir}/tokenizer.json"
+        )
+        assert tok == f"{llama_dir}/tokenizer.json"
+        assert cfg.tokenizer_path == tok
+
+    def test_keep_overrides_serving_knobs(self, llama_dir):
+        from docqa_tpu.config import DecoderConfig
+        from docqa_tpu.models.hf_checkpoint import load_checkpoint_dir
+
+        cfg, _params, _ = load_checkpoint_dir(
+            llama_dir, expect=DecoderConfig, keep={"max_seq_len": 64}
+        )
+        assert cfg.max_seq_len == 64
+
+    def test_seq2seq_config_adopts_shipped_generation_policy(self):
+        # bart-large-cnn ships its decode policy in config.json — the
+        # loaded framework config must carry it (not framework defaults)
+        from docqa_tpu.models.hf_checkpoint import _seq2seq_config
+
+        hf = {
+            "vocab_size": 50264, "d_model": 64, "encoder_layers": 1,
+            "decoder_layers": 1, "encoder_attention_heads": 4,
+            "encoder_ffn_dim": 128, "max_position_embeddings": 128,
+            "num_beams": 4, "length_penalty": 2.0, "min_length": 56,
+            "no_repeat_ngram_size": 3, "forced_bos_token_id": 0,
+        }
+        cfg = _seq2seq_config(hf, "tok.json")
+        assert cfg.num_beams == 4 and cfg.length_penalty == 2.0
+        assert cfg.min_length == 56 and cfg.no_repeat_ngram == 3
+        assert cfg.forced_bos_id == 0
+
+
+class TestRuntimeCheckpointDir:
+    """Service-level wiring: ``decoder.checkpoint_dir`` makes the whole
+    runtime (batcher, /ask path) serve the imported checkpoint — the
+    operator-facing equivalent of the reference pointing its QA service at
+    an Ollama model name (``llm-qa/main.py:66-69``)."""
+
+    def test_runtime_serves_checkpoint(self, llama_dir):
+        from docqa_tpu.config import load_config
+        from docqa_tpu.service.app import DocQARuntime
+        from docqa_tpu.text.bpe import BPETokenizer
+
+        cfg = load_config(
+            env={},
+            overrides={
+                # DP4 x TP2 over the 8 virtual devices: the checkpoint's
+                # kv_heads=2 divides the model axis, slots ride data
+                "mesh.data_parallel": 4,
+                "mesh.model_parallel": 2,
+                "decoder.checkpoint_dir": llama_dir,
+                "encoder.hidden_dim": 64,
+                "encoder.num_layers": 1,
+                "encoder.num_heads": 4,
+                "encoder.mlp_dim": 128,
+                "encoder.embed_dim": 64,
+                "store.dim": 64,
+                "store.shard_capacity": 256,
+                "ner.hidden_dim": 32,
+                "ner.num_layers": 1,
+                "ner.num_heads": 2,
+                "ner.mlp_dim": 64,
+                "ner.train_steps": 0,
+                "generate.max_new_tokens": 8,
+                "generate.max_concurrent": 2,
+                "generate.prefill_buckets": (128,),
+            },
+        )
+        rt = DocQARuntime(cfg).start()
+        try:
+            # the generator (and the batcher serving /ask) speak the
+            # checkpoint's real vocabulary, not the hash fallback
+            assert isinstance(rt.generator.tokenizer, BPETokenizer)
+            assert rt.generator.cfg.num_kv_heads == 2  # from config.json
+            # context window = min(checkpoint, configured cap)
+            assert rt.generator.cfg.max_seq_len == 128
+            rec = rt.pipeline.ingest_document(
+                "note.txt",
+                b"the patient was admitted with chest pain",
+                patient_id="p1",
+            )
+            assert rt.pipeline.wait_indexed(rec.doc_id, timeout=60)
+            res = rt.qa.ask("what happened to the patient?")
+            assert isinstance(res["answer"], str)
+            assert res["sources"]
+        finally:
+            rt.stop()
+
+    def test_runtime_rejects_wrong_family(self, llama_dir):
+        from docqa_tpu.config import load_config
+        from docqa_tpu.service.app import DocQARuntime
+
+        cfg = load_config(
+            env={}, overrides={"encoder.checkpoint_dir": llama_dir,
+                               "ner.train_steps": 0}
+        )
+        with pytest.raises(ValueError, match="not a BERT-family"):
+            DocQARuntime(cfg)

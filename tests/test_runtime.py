@@ -31,6 +31,23 @@ class TestConfig:
         assert cfg.flags.use_fake_llm is True
         assert cfg.broker.backend == "amqp"
 
+    def test_env_overlay_optional_numeric_knob(self):
+        # Optional (None-default) knobs have no current-value type to
+        # coerce to; the generic fallback must still deliver NUMBERS —
+        # "4" (str) would silently break every numeric Optional knob
+        cfg = load_config(
+            env={
+                "DOCQA_SEQ2SEQ__NUM_BEAMS": "4",
+                "DOCQA_SEQ2SEQ__LENGTH_PENALTY": "2.0",
+                "DOCQA_DECODER__CHECKPOINT_DIR": "/ckpt/mistral",
+            }
+        )
+        assert cfg.seq2seq.num_beams == 4
+        assert cfg.seq2seq.length_penalty == 2.0
+        assert cfg.decoder.checkpoint_dir == "/ckpt/mistral"  # str stays str
+        # unset policy knobs stay None (= checkpoint policy may apply)
+        assert cfg.seq2seq.min_length is None
+
     def test_overrides_beat_env(self):
         cfg = load_config(
             env={"DOCQA_STORE__DIM": "128"},
