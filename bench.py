@@ -137,6 +137,127 @@ def _device_backend_alive_retrying(
     return False
 
 
+def _start_stall_watchdog(stall_min: float = 30.0) -> None:
+    """Abort (exit 3) if NO section lands a measurement for ``stall_min``
+    minutes.
+
+    The start-of-run probe retry cannot help once the run is under way: a
+    tunnel outage mid-run leaves the axon client sleeping in an internal
+    retry loop forever — observed live: a bench 25+ minutes into "one real
+    chip" with zero log output, zero IO, and a main thread parked in
+    ``clock_nanosleep``.  Progress is defined as DETAILS changing (every
+    section writes there, and the corpus loop writes per-block
+    breadcrumbs); on stall the watchdog flushes what was measured and
+    exits 3 so the outer wrapper (``_run_with_fallback``) can still get
+    the driver its one JSON line from a CPU smoke rerun."""
+    import threading
+
+    def snap() -> str:
+        # dict(DETAILS) snapshots atomically under the GIL; dumping the
+        # copy cannot race the main thread's inserts.  The bare fallback
+        # must be infallible — an exception here would kill the daemon
+        # thread silently and un-watch the rest of the run.
+        try:
+            return json.dumps(dict(DETAILS), sort_keys=True, default=str)
+        except Exception:
+            return f"len={len(DETAILS)}"
+
+    state = {"snap": snap(), "t": time.time()}
+
+    def run() -> None:
+        while True:
+            time.sleep(60)
+            try:
+                cur = snap()
+                if cur != state["snap"]:
+                    state["snap"], state["t"] = cur, time.time()
+                elif time.time() - state["t"] > stall_min * 60:
+                    log(
+                        f"WATCHDOG: no measurement progress in "
+                        f"{stall_min:.0f} min — device backend likely hung "
+                        "mid-run; aborting (exit 3) so the smoke fallback "
+                        "can run"
+                    )
+                    DETAILS["watchdog_abort"] = True
+                    flush_details()
+                    os._exit(3)
+            except Exception as e:  # the watchdog must outlive anything
+                log(f"watchdog iteration error (ignored): {e!r}")
+
+    threading.Thread(target=run, daemon=True, name="bench-watchdog").start()
+
+
+def _run_with_fallback() -> int:
+    """Outer wrapper: run the real bench as a child process; if it exits
+    without having printed the headline JSON line (watchdog abort, crash,
+    or outer-budget timeout), rerun in the forced-CPU smoke configuration
+    so the driver ALWAYS receives its one line.  The inner run is selected
+    with ``DOCQA_BENCH_INNER=1``."""
+    import subprocess
+    import threading
+
+    def run_child(extra_env: dict, budget_s: float) -> bool:
+        env = dict(os.environ, DOCQA_BENCH_INNER="1", **extra_env)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=subprocess.PIPE,
+            text=True,
+            bufsize=1,
+        )
+        got_json = [False]
+
+        def forward() -> None:
+            for line in p.stdout:
+                sys.stdout.write(line)
+                sys.stdout.flush()
+                s = line.strip()
+                if s.startswith("{"):
+                    try:
+                        json.loads(s)
+                        got_json[0] = True
+                    except ValueError:
+                        pass
+
+        t = threading.Thread(target=forward, daemon=True)
+        t.start()
+        deadline = time.time() + budget_s
+        while p.poll() is None:
+            if time.time() > deadline and not got_json[0]:
+                log(
+                    f"outer budget ({budget_s:.0f}s) exhausted with no "
+                    "headline line — killing the bench child"
+                )
+                p.kill()
+                break
+            time.sleep(5)
+        p.wait()
+        t.join(timeout=30)
+        return got_json[0]
+
+    budget = float(os.environ.get("DOCQA_BENCH_OUTER_BUDGET_S", "5400"))
+    if run_child({}, budget):
+        return 0
+    log("bench run produced no headline — rerunning as forced-CPU smoke")
+    # preserve the aborted real run's partial measurements (the watchdog
+    # flushed them) — the smoke child writes the same bench_details.json
+    details = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_details.json"
+    )
+    if os.path.exists(details):
+        try:
+            os.replace(details, details + ".partial")
+            log(f"partial real-run details saved to {details}.partial")
+        except OSError as e:
+            log(f"could not preserve partial details: {e!r}")
+    if run_child(
+        {"DOCQA_BENCH_FORCE_CPU": "1", "DOCQA_BENCH_SMALL": "1"}, 1800.0
+    ):
+        return 0
+    log("smoke fallback also failed to produce a headline")
+    return 1
+
+
 def _bench_lock(max_wait_s: float = 3600.0) -> None:
     """Cooperative single-runner lock: two benches sharing one chip OOM
     each other into false negatives.  If another live bench holds the
@@ -170,10 +291,14 @@ def _bench_lock(max_wait_s: float = 3600.0) -> None:
 
 def main() -> None:
     _bench_lock()
-    if not _device_backend_alive_retrying():
+    _start_stall_watchdog()
+    force_cpu = os.environ.get("DOCQA_BENCH_FORCE_CPU") == "1"
+    if force_cpu or not _device_backend_alive_retrying():
         # degrade honestly: a CPU smoke run labeled as such beats a hang
         log(
-            "accelerator backend unreachable (tunnel down?) — "
+            "forced-CPU smoke rerun"
+            if force_cpu
+            else "accelerator backend unreachable (tunnel down?) — "
             "falling back to the CPU smoke configuration"
         )
         os.environ["DOCQA_BENCH_SMALL"] = "1"
@@ -247,6 +372,8 @@ def main() -> None:
                 for i in range(start, start + n)
             ],
         )
+        # watchdog breadcrumb: each ~200 MB block transfer is progress
+        DETAILS["ingest_rows"] = start + n
     log(f"corpus: {n_chunks} chunks ingested in {time.perf_counter()-t0:.1f}s")
 
     gen = GenerateEngine(dec_cfg, mesh=mesh)
@@ -1141,4 +1268,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("DOCQA_BENCH_INNER") == "1":
+        main()
+    else:
+        sys.exit(_run_with_fallback())
