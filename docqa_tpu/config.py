@@ -139,6 +139,14 @@ class DecoderConfig:
     # architecture fields are ignored but quantize_weights/quant_bits
     # still govern the serving precision (quantize-on-load).
     checkpoint_dir: Optional[str] = None
+    # Instruction-format wrapper for text prompts (the reference's Ollama
+    # applied Mistral's chat template internally, so its /ask prompts were
+    # instruct-formatted).  A named alias ("mistral-inst") or any format
+    # string containing "{prompt}".  None = raw prompts (base models, the
+    # zero-egress default).  Applied by GenerateEngine.format_prompt on
+    # every TEXT entry point (generate_texts, batcher submit_text) — id
+    # entry points are never wrapped.
+    chat_template: Optional[str] = None
 
     @staticmethod
     def mistral_7b() -> "DecoderConfig":

@@ -42,6 +42,13 @@ from docqa_tpu.utils import pick_bucket, round_up
 
 BATCH_BUCKETS = (1, 2, 4, 8, 16)
 
+# Named chat-template aliases (cfg.chat_template).  Kept to formats that
+# are plain text in the target vocabularies; a checkpoint with a bespoke
+# format passes the format string itself.
+CHAT_TEMPLATES = {
+    "mistral-inst": "[INST] {prompt} [/INST]",
+}
+
 
 class GenerateEngine:
     def __init__(
@@ -88,6 +95,22 @@ class GenerateEngine:
                 updates["pad_id"] = int(tok_pad)
             if updates:
                 self.gen = _dc.replace(self.gen, **updates)
+        # resolve + VALIDATE the chat template at construction: an unknown
+        # alias (typo) or a format string without {prompt} would otherwise
+        # silently replace every request with the template text itself
+        if cfg.chat_template:
+            resolved = CHAT_TEMPLATES.get(
+                cfg.chat_template, cfg.chat_template
+            )
+            if "{prompt}" not in resolved:
+                raise ValueError(
+                    f"chat_template {cfg.chat_template!r} is neither a "
+                    f"known alias ({sorted(CHAT_TEMPLATES)}) nor a format "
+                    "string containing '{prompt}'"
+                )
+            self._chat_template: Optional[str] = resolved
+        else:
+            self._chat_template = None
         if params is None:
             if cfg.quantize_weights:
                 from docqa_tpu.models.quant import (
@@ -470,6 +493,39 @@ class GenerateEngine:
             for row, count in zip(out, n_emitted)
         ]
 
+    def format_prompt(self, prompt: str) -> str:
+        """Apply the configured instruction template (``cfg.chat_template``)
+        to a text prompt.  The reference's Ollama runtime did this
+        internally for Mistral (``llm-qa/main.py:66-69``); serving a real
+        instruct checkpoint without its format silently degrades answers.
+        ``str.replace`` (not ``str.format``) so braces in clinical text
+        can never raise."""
+        if self._chat_template is None:
+            return prompt
+        return self._chat_template.replace("{prompt}", prompt)
+
+    def encode_prompt(self, prompt: str, budget: int) -> List[int]:
+        """Tokenize with the chat template applied, TRUNCATION-SAFE.
+
+        Naive wrap-then-tail-truncate would cut the template's opening
+        tokens ('[INST]') off a long RAG prompt while keeping the closing
+        ones — malformed instruct input in exactly the long-context case
+        the template exists for.  Here the RAW prompt is tail-trimmed
+        (the question sits at the tail of a RAG prompt) to what the
+        budget leaves after the template's own tokens, then wrapped."""
+        if self._chat_template is None:
+            return self.tokenizer.encode(prompt)
+        pre, _, post = self._chat_template.partition("{prompt}")
+        pre_ids = list(self.tokenizer.encode(pre))  # carries BOS etc.
+        post_ids = (
+            list(self.tokenizer.encode(post, add_specials=False))
+            if post
+            else []
+        )
+        room = max(1, budget - len(pre_ids) - len(post_ids))
+        raw = list(self.tokenizer.encode(prompt, add_specials=False))[-room:]
+        return pre_ids + raw + post_ids
+
     def generate_texts(
         self,
         prompts: Sequence[str],
@@ -484,8 +540,11 @@ class GenerateEngine:
         opaque ``w<id>`` wordpieces — the service contract and the device
         program are identical either way.
         """
-        # no truncation here: generate_ids keeps the prompt *tail* (where the
-        # question sits in a RAG prompt) when it exceeds the bucket
-        prompt_ids = [self.tokenizer.encode(p) for p in prompts]
+        # untemplated prompts: generate_ids keeps the prompt *tail* (where
+        # the question sits in a RAG prompt) when it exceeds the bucket;
+        # templated prompts truncate template-aware in encode_prompt so the
+        # instruct framing survives
+        budget = self.gen.prefill_buckets[-1]
+        prompt_ids = [self.encode_prompt(p, budget) for p in prompts]
         outs = self.generate_ids(prompt_ids, max_new_tokens, temperature, seed)
         return [self.tokenizer.decode_ids(ids) for ids in outs]

@@ -420,8 +420,13 @@ class ContinuousBatcher:
     def submit_text(
         self, prompt: str, max_new_tokens: Optional[int] = None
     ) -> Handle:
+        # same text entry contract as GenerateEngine.generate_texts: the
+        # configured chat template wraps here too (template-aware
+        # truncation against THIS batcher's cache budget), so /ask answers
+        # from a batcher match solo-engine answers token-for-token
+        usable = self.cache_len - 2 - self.spec_k
         return self.submit_ids(
-            self.engine.tokenizer.encode(prompt), max_new_tokens
+            self.engine.encode_prompt(prompt, usable), max_new_tokens
         )
 
     def generate_texts(

@@ -99,6 +99,70 @@ class TestGenerateEngine:
         eng = GenerateEngine(SMALL)
         assert eng.generate_ids([]) == []
 
+    def test_chat_template_wraps_text_entry_points(self):
+        """cfg.chat_template formats every TEXT prompt (the reference's
+        Ollama applied Mistral's template internally); id entry points
+        stay raw.  Alias and literal format strings both work."""
+        import dataclasses
+
+        eng = GenerateEngine(
+            dataclasses.replace(SMALL, chat_template="mistral-inst"),
+            GenerateConfig(max_new_tokens=4),
+        )
+        assert eng.format_prompt("hi {x}") == "[INST] hi {x} [/INST]"
+        raw = GenerateEngine(SMALL, GenerateConfig(max_new_tokens=4))
+        assert raw.format_prompt("hi") == "hi"
+        lit = GenerateEngine(
+            dataclasses.replace(SMALL, chat_template="Q: {prompt}\nA:"),
+            GenerateConfig(max_new_tokens=4),
+        )
+        assert lit.format_prompt("why?") == "Q: why?\nA:"
+        # the engine text path and the batcher text path tokenize the SAME
+        # wrapped prompt — batcher answers match solo answers
+        wrapped_ids = eng.encode_prompt("a question", 10_000)
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        b = ContinuousBatcher(eng, n_slots=2, chunk=4, cache_len=128)
+        try:
+            via_batcher = b.submit_text("a question", max_new_tokens=4)
+            via_engine = eng.generate_ids([wrapped_ids], max_new_tokens=4)[0]
+            assert via_batcher.result(timeout=120) == via_engine
+        finally:
+            b.stop()
+
+    def test_chat_template_truncation_keeps_framing(self):
+        """A long RAG prompt tail-trims the RAW text, not the wrapped one:
+        the template's opening tokens must survive (an instruct model
+        seeing an unopened [/INST] is malformed input)."""
+        import dataclasses
+
+        eng = GenerateEngine(
+            dataclasses.replace(SMALL, chat_template="mistral-inst"),
+            GenerateConfig(max_new_tokens=4),
+        )
+        tok = eng.tokenizer
+        pre_ids = list(tok.encode("[INST] "))
+        post_ids = list(tok.encode(" [/INST]", add_specials=False))
+        long_prompt = "word " * 500 + "the actual question"
+        budget = 64
+        ids = eng.encode_prompt(long_prompt, budget)
+        assert len(ids) <= budget
+        assert ids[: len(pre_ids)] == pre_ids  # head survives
+        assert ids[-len(post_ids):] == post_ids  # tail survives
+        # the kept raw tokens are the PROMPT TAIL (where the question is)
+        tail = list(tok.encode("the actual question", add_specials=False))
+        assert ids[-len(post_ids) - len(tail): -len(post_ids)] == tail
+
+    def test_chat_template_validated_at_init(self):
+        import dataclasses
+
+        import pytest
+
+        with pytest.raises(ValueError, match="mistral_inst"):
+            GenerateEngine(
+                dataclasses.replace(SMALL, chat_template="mistral_inst")
+            )
+
     def test_long_prompt_keeps_tail(self):
         eng = GenerateEngine(SMALL, GenerateConfig(max_new_tokens=4))
         long_prompt = list(np.random.default_rng(0).integers(1, 128, 300))
