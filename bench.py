@@ -858,6 +858,22 @@ def main() -> None:
                     t0 = time.perf_counter()
                     deid_trained = DeidEngine.trained(NERConfig())
                     ev = evaluate_deid(deid_trained)
+                    # the softmax acceptance threshold is a no-retrain
+                    # precision/recall lever; each eval is sub-second with
+                    # the tagger in memory, so sweep it and report the
+                    # operating curve alongside the served default
+                    th_sweep = {}
+                    served_th = deid_trained.ner_threshold
+                    try:
+                        for th in (0.3, 0.5, 0.65, 0.8, 0.9):
+                            deid_trained.ner_threshold = th
+                            e = evaluate_deid(deid_trained)
+                            th_sweep[str(th)] = {
+                                "entity_f1": e["entity_f1"],
+                                "char_f1": e["char_f1"],
+                            }
+                    finally:
+                        deid_trained.ner_threshold = served_th
                     DETAILS["deid"].update(
                         {
                             "train_s": round(time.perf_counter() - t0, 1),
@@ -865,6 +881,7 @@ def main() -> None:
                             "char_f1": ev["char_f1"],
                             "span_recall_any": ev["span_recall_any"],
                             "eval": ev,
+                            "threshold_sweep": th_sweep,
                         }
                     )
                     log(
