@@ -858,6 +858,18 @@ def main() -> None:
                     t0 = time.perf_counter()
                     deid_trained = DeidEngine.trained(NERConfig())
                     ev = evaluate_deid(deid_trained)
+                    # record the headline quality numbers BEFORE the sweep:
+                    # a sweep failure must not discard minutes of training
+                    # plus a successful base eval
+                    DETAILS["deid"].update(
+                        {
+                            "train_s": round(time.perf_counter() - t0, 1),
+                            "f1": ev["entity_f1"],
+                            "char_f1": ev["char_f1"],
+                            "span_recall_any": ev["span_recall_any"],
+                            "eval": ev,
+                        }
+                    )
                     # the softmax acceptance threshold is a no-retrain
                     # precision/recall lever; each eval is sub-second with
                     # the tagger in memory, so sweep it and report the
@@ -872,18 +884,11 @@ def main() -> None:
                                 "entity_f1": e["entity_f1"],
                                 "char_f1": e["char_f1"],
                             }
+                    except Exception as e:  # keep the base metrics
+                        th_sweep["error"] = repr(e)[:200]
                     finally:
                         deid_trained.ner_threshold = served_th
-                    DETAILS["deid"].update(
-                        {
-                            "train_s": round(time.perf_counter() - t0, 1),
-                            "f1": ev["entity_f1"],
-                            "char_f1": ev["char_f1"],
-                            "span_recall_any": ev["span_recall_any"],
-                            "eval": ev,
-                            "threshold_sweep": th_sweep,
-                        }
-                    )
+                    DETAILS["deid"]["threshold_sweep"] = th_sweep
                     log(
                         f"config2 deid quality (handwritten eval): entity "
                         f"F1 {ev['entity_f1']}, char F1 {ev['char_f1']}, "

@@ -15,7 +15,22 @@ program:
   hard part (b)); finished lanes go inactive inside the chunk;
 * retirement: a slot frees as soon as its lane hits EOS or its token budget,
   and the next queued request takes it — throughput tracks the number of
-  *live* requests, not the slowest member of a static batch.
+  *live* requests, not the slowest member of a static batch;
+* pipelining: the worker keeps ONE decode chunk in flight past the host —
+  chunk N+1 is dispatched on chunk N's device-side output state (a pure
+  data dependency, no host sync) *before* chunk N's packed results are
+  fetched, so the device→host fetch and all host-side token bookkeeping
+  overlap the next chunk's device execution.  On a tunneled single chip the
+  fetch round-trip alone was ~60 % of a measured chunk round
+  (``docs/PERF.md`` §1); locally it hides the ~26 ms fetch + host work.
+  Correctness rests on one invariant: admission never runs between a
+  chunk's dispatch and its processing (the worker drains the pipeline
+  first), so every in-flight chunk's slot→request mapping is the current
+  one; a snapshot guard drops tokens for any slot whose occupant changed
+  anyway.  Slots that retire on budget mid-pipeline decode one extra chunk
+  whose tokens are discarded — wasted compute, never wrong output — and an
+  in-program cache-bound guard deactivates any lane before its K/V write
+  could clamp, so the overshoot cannot corrupt cache rows.
 
 The KV cache is donated through both programs (prefill scatter and decode
 chunk), so slot state stays HBM-resident across the whole serving session.
@@ -280,6 +295,13 @@ class ContinuousBatcher:
             valid = valid.at[:, t].set(active & ~is_eos)
             lengths = lengths + active.astype(jnp.int32)
             active = active & ~is_eos
+            # cache-bound guard: the next step writes row ``lengths``; a
+            # lane at the last row stops here.  Admission budgets already
+            # keep lengths in bounds solo, but a pipelined chunk can run
+            # one chunk past the host-enforced budget (tokens discarded)
+            # — without this guard that overshoot would clamp its K/V
+            # write onto row cache_len-1.
+            active = active & (lengths < self.cache_len)
             tok = jnp.where(active, nxt, tok)
             return cache, tok, lengths, active, out, valid, rng
 
@@ -353,6 +375,11 @@ class ContinuousBatcher:
             table = self.engine.confirm_bigrams(table, tok, g, emit_valid)
             lengths = lengths + jnp.where(active, n_valid, 0)
             active = active & ~saw_eos
+            # cache-bound guard (see _decode_program): a verify writes the
+            # K-row window [lengths, lengths+K) — stop the lane while that
+            # window still fits, so a pipelined overshoot chunk cannot
+            # clamp K/V writes onto confirmed rows.
+            active = active & (lengths < self.cache_len - K)
             tok = jnp.where(active & (n_valid > 0), last_tok, tok)
             return cache, table, tok, lengths, active, out, n_out
 
@@ -547,51 +574,66 @@ class ContinuousBatcher:
                     jnp.asarray(slots_arr),
                     self._next_rng(),
                 )
-        for slot, req, _ids in good:
+        # Slot state updates ride the device (the sampled first tokens are
+        # already there) — alive = (first != eos) & (budget >= 2) needs no
+        # host fetch, so the decode chunk that follows this admission can
+        # dispatch immediately; the host-side fetch of first tokens
+        # (_finalize_admissions) then overlaps that chunk's execution.
+        G = len(good)
+        slots_np = np.empty((G,), np.int32)
+        lens_np = np.empty((G,), np.int32)
+        budget_ok = np.empty((G,), bool)
+        for i, (slot, req, ids) in enumerate(good):
+            n_ids = len(ids)
+            budget = min(req.max_new, self.cache_len - n_ids - 1 - self.spec_k)
             self._slot_req[slot] = req
+            self._slot_budget[slot] = budget
+            slots_np[i] = slot
+            lens_np[i] = n_ids
+            budget_ok[i] = budget >= 2
+        idx = jnp.asarray(slots_np)
+        first_toks = toks[:G]
+        alive_dev = (first_toks != self.gen.eos_id) & jnp.asarray(budget_ok)
+        self._tok = self._tok.at[idx].set(first_toks)
+        self._lengths = self._lengths.at[idx].set(jnp.asarray(lens_np))
+        self._active = self._active.at[idx].set(alive_dev)
         meta = [(slot, req, len(ids)) for slot, req, ids in good]
         return meta, toks
 
-    def _finalize_admissions(self, admitted) -> None:
-        """One device fetch for every first token of the admission round,
-        then batch the slot-state updates into three device ops."""
+    def _finalize_admissions(self, admitted) -> bool:
+        """Host-side bookkeeping for an admission round: ONE device fetch
+        of the round's first tokens, then per-request delivery/retirement.
+
+        Device-side slot state (tok/lengths/active + budgets) was already
+        written by ``_admit_round`` without a fetch, so the worker calls
+        this AFTER dispatching the next decode chunk — the fetch round-trip
+        overlaps that chunk's execution.  The budget math mirrors
+        ``_admit_round``: the prefill token counts as one, and speculation
+        reserves ``spec_k`` rows of K/V headroom (a verify writes K rows
+        from the current length, and dynamic_update_slice CLAMPS an
+        out-of-range window downward onto confirmed rows).
+
+        Returns False when the fetch itself failed (prefill died on
+        device) — the caller must treat the whole pipeline as poisoned."""
         meta, round_toks = admitted
-        firsts = np.asarray(round_toks)[: len(meta)]
-        slots: List[int] = []
-        toks: List[int] = []
-        lens: List[int] = []
-        alive_flags: List[bool] = []
-        for (slot, req, n_ids), first in zip(meta, firsts):
+        try:
+            firsts = np.asarray(round_toks)[: len(meta)]
+        except Exception as e:
+            log.exception("admission fetch failed; resetting")
+            self._fail_active(e)
+            return False
+        for (slot, req, _n_ids), first in zip(meta, firsts):
             first = int(first)
-            # remaining decode budget; the prefill token counts as one.
-            # Speculation reserves spec_k rows of headroom: a verify writes
-            # K rows from the current length, and dynamic_update_slice
-            # CLAMPS an out-of-range window downward — which would silently
-            # overwrite confirmed K/V rows while in-budget tokens still
-            # depend on them.
-            budget = min(
-                req.max_new, self.cache_len - n_ids - 1 - self.spec_k
-            )
-            self._slot_budget[slot] = budget
-            alive = True
+            budget = self._slot_budget[slot]
             if first == self.gen.eos_id or budget <= 0:
-                alive = False
                 self._retire(slot)
             else:
                 req.tokens.append(first)
                 with req.cv:  # the first streamed token
                     req.cv.notify_all()
                 if len(req.tokens) >= budget:
-                    alive = False
                     self._retire(slot)
-            slots.append(slot)
-            toks.append(first)
-            lens.append(n_ids)
-            alive_flags.append(alive)
-        idx = jnp.asarray(slots, jnp.int32)
-        self._tok = self._tok.at[idx].set(jnp.asarray(toks, jnp.int32))
-        self._lengths = self._lengths.at[idx].set(jnp.asarray(lens, jnp.int32))
-        self._active = self._active.at[idx].set(jnp.asarray(alive_flags))
+        return True
 
     def _fail_active(self, err: BaseException) -> None:
         """Fail all in-flight requests and rebuild clean device state."""
@@ -622,7 +664,98 @@ class ContinuousBatcher:
             _finish(req)
             DEFAULT_REGISTRY.counter("serve_completed").inc()
 
+    def _process_chunk(
+        self, packed_dev, snap: List[Optional[_Request]]
+    ) -> bool:
+        """Fetch one decode chunk's packed results and deliver its tokens.
+
+        ``snap`` is the slot→request mapping at the chunk's DISPATCH time;
+        tokens are delivered only to a slot whose occupant is still that
+        request (a slot retired while the chunk was in flight decoded one
+        discarded chunk — wasted compute, never misdelivered tokens).
+        Returns False when the fetch failed: the device state chained from
+        this chunk is poisoned and ``_fail_active`` has reset it."""
+        try:
+            # the span blocks until the chunk's device execution completes,
+            # so serve_decode_chunk_ms keeps measuring real chunk rounds
+            # (minus whatever host work the pipeline already overlapped) —
+            # the dispatch itself is an async enqueue and times ~0
+            with span("serve_decode_chunk", DEFAULT_REGISTRY):
+                packed_h = np.asarray(packed_dev)  # ONE fetch per chunk
+        except Exception as e:
+            # the cache was donated into a failed dispatch — fail every
+            # in-flight request, reset device state, and keep serving
+            # (a dead daemon thread would strand all current AND future
+            # requests with no error)
+            log.exception("decode chunk failed; resetting slot state")
+            self._fail_active(e)
+            return False
+        if self.spec_k:
+            width = self.chunk + 2 * self.spec_k
+            out_h = packed_h[:, :width]
+            counts_h = packed_h[:, width]
+            active_h = packed_h[:, width + 1].astype(bool)
+            # every emitted token is real (EOS excluded in-program)
+            valid_h = np.arange(width)[None, :] < counts_h[:, None]
+            n_cols = width
+        else:
+            out_h = packed_h[:, : self.chunk]
+            valid_h = packed_h[:, self.chunk : 2 * self.chunk].astype(bool)
+            active_h = packed_h[:, -1].astype(bool)
+            n_cols = self.chunk
+        deactivate = []
+        n_appended = 0
+        for slot in range(self.n_slots):
+            req = snap[slot]
+            if req is None or self._slot_req[slot] is not req:
+                continue
+            before = len(req.tokens)
+            for t in range(n_cols):
+                if not valid_h[slot, t]:
+                    continue
+                if len(req.tokens) >= self._slot_budget[slot]:
+                    break
+                req.tokens.append(int(out_h[slot, t]))
+                n_appended += 1
+            if len(req.tokens) > before:  # wake streamers per chunk
+                with req.cv:
+                    req.cv.notify_all()
+            if (
+                not active_h[slot]
+                or len(req.tokens) >= self._slot_budget[slot]
+            ):
+                deactivate.append(slot)
+                self._retire(slot)
+        # tokens delivered per dispatch: with speculation this exceeds
+        # chunk x live-slots when drafts accept — the acceptance signal
+        # an operator watches on /metrics
+        DEFAULT_REGISTRY.histogram("serve_tokens_per_chunk").observe(
+            float(n_appended)
+        )
+        if deactivate:
+            idx = jnp.asarray(deactivate, jnp.int32)
+            self._active = self._active.at[idx].set(False)
+        return True
+
+    def _pop_free_slots(
+        self, pairs: List[Tuple[int, "_Request"]]
+    ) -> None:
+        """Fill every free slot from the queue into ``pairs`` (the ONE
+        admission-selection policy; caller holds ``self._cv``)."""
+        taken = {s for s, _ in pairs}
+        for slot in range(self.n_slots):
+            if not self._queue:
+                break
+            if self._slot_req[slot] is None and slot not in taken:
+                pairs.append((slot, self._queue.popleft()))
+
     def _run(self) -> None:
+        # The one dispatched-but-unprocessed decode chunk: (packed device
+        # array, dispatch-time slot→request snapshot).  Invariant: no
+        # admission happens between that chunk's dispatch and its
+        # processing — the loop drains it before every admission round —
+        # so the snapshot's live entries are always current occupants.
+        pending: Optional[Tuple[jax.Array, List[Optional[_Request]]]] = None
         while True:
             pairs: List[Tuple[int, _Request]] = []
             with self._cv:
@@ -636,16 +769,24 @@ class ContinuousBatcher:
                     return
                 # admission: fill every free slot from the queue; the whole
                 # round prefills in one batched dispatch below
-                for slot in range(self.n_slots):
-                    if not self._queue:
-                        break
-                    if self._slot_req[slot] is None:
-                        pairs.append((slot, self._queue.popleft()))
+                self._pop_free_slots(pairs)
+            if pairs and pending is not None:
+                # drain the pipeline before admitting: the invariant above,
+                # plus processing may retire slots this round can refill
+                drained_ok = self._process_chunk(*pending)
+                pending = None
+                if drained_ok:
+                    with self._cv:  # top-up from slots freed by the drain
+                        self._pop_free_slots(pairs)
+                # on drain failure the device state was reset; the popped
+                # requests were never slot-resident, so admit them into
+                # the fresh state below
+            admitted = None
             if pairs:
                 try:
                     admitted = self._admit_round(pairs)
-                    if admitted[0]:
-                        self._finalize_admissions(admitted)
+                    if not admitted[0]:
+                        admitted = None
                 except Exception as e:
                     # the round's dispatch died; the cache was donated
                     # through it — fail in-flight and reset
@@ -655,13 +796,16 @@ class ContinuousBatcher:
                             req.error = RuntimeError(f"prefill failed: {e!r}")
                             _finish(req)
                     self._fail_active(e)
+                    pending = None
                     continue
             if not any(self._slot_req):
                 continue
-            # one decode chunk for every live slot
+            # one decode chunk for every live slot, dispatched BEFORE the
+            # previous chunk's results are fetched — fetch + host work
+            # below overlap this chunk's device execution
             fn = self._get_decode_fn()
             try:
-                with span("serve_decode_chunk", DEFAULT_REGISTRY):
+                with span("serve_decode_dispatch", DEFAULT_REGISTRY):
                     if self.spec_k:
                         (
                             self._cache,
@@ -693,59 +837,17 @@ class ContinuousBatcher:
                             self._active,
                             self._next_rng(),
                         )
-                    packed_h = np.asarray(packed)  # ONE fetch per chunk
             except Exception as e:
-                # the cache was donated into a failed dispatch — fail every
-                # in-flight request, reset device state, and keep serving
-                # (a dead daemon thread would strand all current AND future
-                # requests with no error)
-                log.exception("decode chunk failed; resetting slot state")
+                log.exception("decode dispatch failed; resetting slot state")
                 self._fail_active(e)
+                pending = None
                 continue
-            if self.spec_k:
-                width = self.chunk + 2 * self.spec_k
-                out_h = packed_h[:, :width]
-                counts_h = packed_h[:, width]
-                active_h = packed_h[:, width + 1].astype(bool)
-                # every emitted token is real (EOS excluded in-program)
-                valid_h = (
-                    np.arange(width)[None, :] < counts_h[:, None]
-                )
-                n_cols = width
-            else:
-                out_h = packed_h[:, : self.chunk]
-                valid_h = packed_h[:, self.chunk : 2 * self.chunk].astype(bool)
-                active_h = packed_h[:, -1].astype(bool)
-                n_cols = self.chunk
-            deactivate = []
-            n_appended = 0
-            for slot in range(self.n_slots):
-                req = self._slot_req[slot]
-                if req is None:
-                    continue
-                before = len(req.tokens)
-                for t in range(n_cols):
-                    if not valid_h[slot, t]:
-                        continue
-                    if len(req.tokens) >= self._slot_budget[slot]:
-                        break
-                    req.tokens.append(int(out_h[slot, t]))
-                    n_appended += 1
-                if len(req.tokens) > before:  # wake streamers per chunk
-                    with req.cv:
-                        req.cv.notify_all()
-                if (
-                    not active_h[slot]
-                    or len(req.tokens) >= self._slot_budget[slot]
-                ):
-                    deactivate.append(slot)
-                    self._retire(slot)
-            # tokens delivered per dispatch: with speculation this exceeds
-            # chunk x live-slots when drafts accept — the acceptance signal
-            # an operator watches on /metrics
-            DEFAULT_REGISTRY.histogram("serve_tokens_per_chunk").observe(
-                float(n_appended)
-            )
-            if deactivate:
-                idx = jnp.asarray(deactivate, jnp.int32)
-                self._active = self._active.at[idx].set(False)
+            ok = True
+            if admitted is not None:
+                # overlaps the chunk: prefill output is already complete
+                ok = self._finalize_admissions(admitted)
+            if ok and pending is not None:
+                ok = self._process_chunk(*pending)
+            # snapshot AFTER finalize/processing: slots they retired are
+            # None here, so the overshoot chunk's tokens for them drop
+            pending = (packed, list(self._slot_req)) if ok else None

@@ -121,3 +121,55 @@ def test_stop_fails_pending(engine):
         h.result(timeout=5)
     except RuntimeError:
         pass  # stopped before completion is a legal outcome
+
+
+def test_pipelined_staggered_budgets(engine, batcher):
+    """Wildly different budgets retire slots at different chunks, forcing
+    the pipelined loop through overshoot chunks (a retired slot decodes one
+    extra in-flight chunk whose tokens must be discarded) and snapshot-
+    guarded re-admission.  Output must still be exactly solo-greedy."""
+    prompts = _prompts(8)
+    budgets = [1, 2, 17, 5, 30, 3, 11, 7]
+    solo = [
+        engine.generate_ids([p], max_new_tokens=m)[0]
+        for p, m in zip(prompts, budgets)
+    ]
+    handles = [
+        batcher.submit_ids(p, max_new_tokens=m)
+        for p, m in zip(prompts, budgets)
+    ]
+    got = [h.result(timeout=240) for h in handles]
+    assert got == solo
+
+
+def test_pipelined_trickle_arrivals(engine, batcher):
+    """Arrivals land while decode chunks are in flight: every admission
+    must drain the pipeline first (the loop invariant), so late tokens
+    can never be delivered to a slot's new occupant."""
+    prompts = _prompts(6)
+    solo = [engine.generate_ids([p], max_new_tokens=9)[0] for p in prompts]
+    handles = []
+    for p in prompts:
+        handles.append(batcher.submit_ids(p, max_new_tokens=9))
+        time.sleep(0.03)  # mid-flight arrival
+    got = [h.result(timeout=240) for h in handles]
+    assert got == solo
+
+
+def test_pipelined_cache_edge_budget(engine):
+    """Prompts near the cache boundary clamp the budget small; the
+    pipelined overshoot chunk then pushes lengths toward cache_len and the
+    in-program cache-bound guard (not the host budget) must stop the lane
+    before its K/V write clamps."""
+    b = ContinuousBatcher(engine, n_slots=2, chunk=8, cache_len=128)
+    try:
+        long_p = [3 + (i % 90) for i in range(122)]  # budget = 128-122-1 = 5
+        short_p = [3, 5, 9]
+        solo_long = engine.generate_ids([long_p], max_new_tokens=5)[0]
+        solo_short = engine.generate_ids([short_p], max_new_tokens=40)[0]
+        h1 = b.submit_ids(long_p, max_new_tokens=99)
+        h2 = b.submit_ids(short_p, max_new_tokens=40)
+        assert h1.result(timeout=120) == solo_long
+        assert h2.result(timeout=120) == solo_short
+    finally:
+        b.stop()
