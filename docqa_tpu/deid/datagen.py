@@ -41,6 +41,13 @@ def ner_tokenizer(cfg: NERConfig) -> ShapeHashTokenizer:
     return ShapeHashTokenizer(cfg.vocab_size)
 
 
+# Bump on any change to the templates/lexicons below: the npz cache
+# fingerprint includes it (training/ner.py:_fingerprint), so a tagger
+# trained on an older synthetic distribution invalidates instead of
+# serving silently.
+DATA_VERSION = 2
+
+
 # ---------------------------------------------------------------------------
 # Lexicons.  TRAIN_* feed the generator; EVAL_* are disjoint and only used
 # by evaluate_ner / tests to measure generalization to unseen surface forms.
@@ -106,6 +113,18 @@ _SCANS = "MRI CT ECG EEG X-ray".split()
 
 _LOCATION_PREFIXES = (
     "New Port Mount East West Saint Lake Fort North South"
+).split()
+
+# Sentence-initial discourse openers — capitalized O-words that must
+# co-occur WITH entities in training.  The round-4 disjoint eval showed the
+# tagger had learned "TITLE-shaped word in a PHI-bearing sentence ⇒
+# PERSON": pure no-PHI negatives taught it nothing about "On examination
+# <PERSON> ..." (every observed false positive was a sentence-initial or
+# header capital in a sentence that also contained a real entity).
+_OPENERS = (
+    "Today Tonight Overnight Currently Notably Meanwhile Subsequently "
+    "Thereafter Yesterday Accordingly Additionally Otherwise Regardless "
+    "Afterwards Initially"
 ).split()
 
 _SYLLABLES = (
@@ -232,6 +251,30 @@ _TEMPLATES: Tuple[str, ...] = (
     "Colonoscopy scheduled for next month; bowel preparation reviewed.",
     "Echocardiogram pending; telemetry without events overnight.",
     "Discharge instructions reviewed; follow-up arranged with cardiology.",
+    # capitalized O-words CO-OCCURRING with entities (see _OPENERS note):
+    # discourse openers, chart headers, and clinical nouns in PHI-bearing
+    # sentences — the composition the false positives came from
+    "{O}, {P} was reviewed by the team.",
+    "{O} {P} remains afebrile on the current regimen.",
+    "{O}, the team updated {P} at the bedside.",
+    "On examination, {P} appears comfortable and alert.",
+    "On arrival {P} was triaged promptly.",
+    "We evaluated {P} in the urgent care area.",
+    "We discussed goals of care with {P} at length.",
+    "Next of kin: {P}.",
+    "Next of kin: {P}. Residence: {L}.",
+    "Religion: {N}. Interpreter not required.",
+    "Night float note - {P} slept through rounds.",
+    "At 0700 rounds, pt {P} was alert and oriented.",
+    "Telemetry reviewed; {P} without ectopy overnight.",
+    "Echocardiogram reviewed with {P} at the bedside.",
+    "Labs pending; {P} tolerating a regular diet.",
+    "Plan discussed with {P}; questions answered.",
+    "Family of {P} updated by telephone this evening.",
+    "Review of systems otherwise negative for {P}.",
+    "Occupation: retired engineer; lives near {L}.",
+    "The {S} for {P} was rescheduled to Friday.",
+    "Continue {D}; {P} will recheck labs next week.",
 )
 
 
@@ -286,6 +329,8 @@ def _fill(
                 fill, ent = str(rng.choice(_CAP_NEGATIVES)), None
             elif slot == "S":
                 fill, ent = str(rng.choice(_SCANS)), None
+            elif slot == "O":
+                fill, ent = str(rng.choice(_OPENERS)), None
             else:  # pragma: no cover - template typo guard
                 raise ValueError(f"unknown slot {{{slot}}}")
             if ent is not None:

@@ -155,29 +155,35 @@ def evaluate_ner(
     *,
     n_examples: int = 64,
     seed: int = 1234,
-    threshold: float = 0.5,
+    threshold: Optional[float] = None,
 ) -> Dict[str, float]:
     """Exact-span precision / recall / F1 against gold spans of synthetic
     notes filled from EVAL_LEXICONS (disjoint from training).
 
-    Scores the TAGGER ALONE (``engine._ner_results``, not the merged
-    analyze output): the cue regexes in ``deid/engine.py`` literally match
-    several datagen templates, so including them would credit a collapsed
-    all-O model with their hits — this metric gates the training recipe
-    and must not be maskable by patterns."""
+    Scores the TAGGER ALONE (``engine._ner_results`` with the deny-list
+    veto off, not the merged analyze output): the cue regexes in
+    ``deid/engine.py`` literally match several datagen templates, and the
+    deny-list was built from past tagger false positives — including
+    either would credit a collapsed or regressed model — this metric
+    gates the training recipe and must not be maskable.  The threshold
+    defaults to the SERVED operating point (engine.DEFAULT_NER_THRESHOLD)
+    so the gate measures what production drops."""
     from docqa_tpu.deid.datagen import (
         EVAL_LEXICONS,
         generate_example,
         ner_tokenizer,
     )
-    from docqa_tpu.deid.engine import DeidEngine
+    from docqa_tpu.deid.engine import DEFAULT_NER_THRESHOLD, DeidEngine
 
     engine = DeidEngine(
         cfg,
         tokenizer=ner_tokenizer(cfg),
         params=params,
         use_ner_model=True,
-        ner_threshold=threshold,
+        ner_threshold=(
+            DEFAULT_NER_THRESHOLD if threshold is None else threshold
+        ),
+        ner_deny_list=False,
     )
     rng = np.random.default_rng(seed)
     texts, golds = [], []
@@ -260,13 +266,16 @@ def load_ner_train_seq(path: str) -> Optional[int]:
 
 
 def _fingerprint(cfg: NERConfig, steps: int) -> list:
+    from docqa_tpu.deid.datagen import DATA_VERSION
+
     return [
         cfg.vocab_size, cfg.hidden_dim, cfg.num_layers, cfg.num_heads,
         cfg.mlp_dim, cfg.max_seq_len, cfg.num_labels,
         # training-recipe fields: a cache trained under the collapsed
         # unweighted-loss recipe (or with fewer steps) must invalidate,
-        # not serve an under-fit tagger
-        steps, int(cfg.entity_loss_weight * 100),
+        # not serve an under-fit tagger — and a cache trained on an older
+        # synthetic-data distribution (DATA_VERSION) likewise
+        steps, int(cfg.entity_loss_weight * 100), DATA_VERSION,
     ]
 
 
