@@ -1089,13 +1089,42 @@ def main() -> None:
         gen4 = params4 = None
         try:
             cfg7 = DecoderConfig.mistral_7b()
+            # Capability gate FIRST (r04 post-mortem): on the tunneled
+            # axon backend, lowering an S4 program fails client-side, and
+            # the subsequent full-program compile attempt came back
+            # UNIMPLEMENTED and left the client in a state where EVERY
+            # later dispatch failed — killing config 3b, the beam bench,
+            # and the deid quality eval of that run.  A toy int4 program
+            # (device_put + jit matmul + fetch) reproduces the failure
+            # fast WITHOUT poisoning the client (verified in-session), so
+            # prove the dtype end-to-end before allocating a multi-GB
+            # tree or compiling anything int4-shaped.
+            import jax.numpy as _jnp
+
+            try:
+                _w4 = jax.device_put(
+                    _jnp.arange(256, dtype=_jnp.int8)
+                    .reshape(16, 16)
+                    .astype(_jnp.int4)
+                )
+                _x4 = _jnp.ones((4, 16), _jnp.bfloat16)
+                np.asarray(
+                    jax.jit(lambda x, w: x @ w.astype(_jnp.bfloat16))(
+                        _x4, _w4
+                    )
+                )
+                del _w4, _x4
+            except Exception as probe_err:
+                raise RuntimeError(
+                    "backend cannot execute int4 programs "
+                    f"(capability probe: {probe_err!r:.200})"
+                ) from None
             # fusion probe BEFORE allocating the tree: if the backend
             # materializes the dequantized bf16 weight instead of fusing
             # the grouped dequant into the dot, the temp allocation shows
             # it here (one mlp weight = 117 MB bf16) and the section's
             # tok/s will confirm — record both, never assume
             try:
-                import jax.numpy as _jnp
 
                 from docqa_tpu.models.decoder import _qmatmul
 
