@@ -1055,8 +1055,12 @@ def main() -> None:
                     else gen8
                 )
                 try:
+                    # (32, 32) first — the r04 full-bench winner (9.26 QPS
+                    # vs 9.13 at (32,16), docs/bench_r04_insession.json);
+                    # the two small-chunk points stay in the grid because
+                    # they trade within noise run-to-run
                     DETAILS["rag_load_7b_int8"] = sweep_load(
-                        load_engine, 32, 512, ((32, 32), (16, 64))
+                        load_engine, 32, 512, ((32, 32), (32, 16), (16, 64))
                     )
                 finally:
                     # release on the error path too: a leaked 7B engine
@@ -1094,31 +1098,21 @@ def main() -> None:
             # the subsequent full-program compile attempt came back
             # UNIMPLEMENTED and left the client in a state where EVERY
             # later dispatch failed — killing config 3b, the beam bench,
-            # and the deid quality eval of that run.  A toy int4 program
-            # (device_put + jit matmul + fetch) reproduces the failure
-            # fast WITHOUT poisoning the client (verified in-session), so
-            # prove the dtype end-to-end before allocating a multi-GB
-            # tree or compiling anything int4-shaped.
+            # and the deid quality eval of that run.  probe_int4_support
+            # proves the dtype end-to-end on a toy program (which fails
+            # fast WITHOUT poisoning the client — verified in-session)
+            # before anything allocates a multi-GB tree or compiles an
+            # int4-shaped program.
             import jax.numpy as _jnp
 
-            try:
-                _w4 = jax.device_put(
-                    _jnp.arange(256, dtype=_jnp.int8)
-                    .reshape(16, 16)
-                    .astype(_jnp.int4)
-                )
-                _x4 = _jnp.ones((4, 16), _jnp.bfloat16)
-                np.asarray(
-                    jax.jit(lambda x, w: x @ w.astype(_jnp.bfloat16))(
-                        _x4, _w4
-                    )
-                )
-                del _w4, _x4
-            except Exception as probe_err:
+            from docqa_tpu.models.quant import probe_int4_support
+
+            _int4_ok, _int4_why = probe_int4_support()
+            if not _int4_ok:
                 raise RuntimeError(
                     "backend cannot execute int4 programs "
-                    f"(capability probe: {probe_err!r:.200})"
-                ) from None
+                    f"(capability probe: {_int4_why})"
+                )
             # fusion probe BEFORE allocating the tree: if the backend
             # materializes the dequantized bf16 weight instead of fusing
             # the grouped dequant into the dot, the temp allocation shows

@@ -85,6 +85,30 @@ def _int4_group(in_dim: int, group: Optional[int] = None) -> int:
     return g
 
 
+def probe_int4_support() -> Tuple[bool, str]:
+    """Prove the backend can execute S4 (int4) programs end-to-end.
+
+    ``(True, "")`` when a toy device_put + jit matmul + fetch succeeds;
+    ``(False, reason)`` otherwise.  Callers MUST gate any real int4 work
+    on this: on a backend without S4 support (the tunneled axon client,
+    r04), a toy program fails fast client-side WITHOUT damaging the
+    client, but a full-program int4 compile attempt came back
+    UNIMPLEMENTED and poisoned every later dispatch of the process.
+    """
+    import numpy as np
+
+    try:
+        w4 = jax.device_put(
+            jnp.arange(256, dtype=jnp.int8).reshape(16, 16).astype(jnp.int4)
+        )
+        x4 = jnp.ones((4, 16), jnp.bfloat16)
+        np.asarray(jax.jit(lambda x, w: x @ w.astype(jnp.bfloat16))(x4, w4))
+        del w4, x4
+        return True, ""
+    except Exception as e:
+        return False, f"{e!r:.200}"
+
+
 def is_quantized(params: Params) -> bool:
     return any(k.endswith(SCALE_SUFFIX) for k in params)
 

@@ -40,10 +40,21 @@ def main() -> None:
         # decode-only 7B int8 vs int4 (the question a short tunnel window
         # should answer first: does grouped int4 double tok/s or did the
         # compiler materialize the dequant?)
-        from docqa_tpu.models.quant import init_quantized_decoder_params
+        from docqa_tpu.models.quant import (
+            init_quantized_decoder_params,
+            probe_int4_support,
+        )
 
         cfg7 = DecoderConfig.mistral_7b()
-        for bits in (8, 4):
+        # same capability gate as bench.py config 3d: a full-program int4
+        # compile on a backend without S4 support poisons the client (all
+        # later dispatches fail UNIMPLEMENTED) — prove the dtype on a toy
+        # program first and fall back to int8-only
+        int4_ok, int4_why = probe_int4_support()
+        if not int4_ok:
+            print(f"int4 unsupported by backend ({int4_why}); int8 only",
+                  flush=True)
+        for bits in (8, 4) if int4_ok else (8,):
             params = init_quantized_decoder_params(
                 jax.random.PRNGKey(0), cfg7, host_init=True, bits=bits
             )
