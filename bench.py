@@ -94,6 +94,35 @@ def clustered_vectors(rng, n, dim, centers):
     )
 
 
+def dispatch_health(tag: str) -> None:
+    """Record the dispatch+sync median under DETAILS["dispatch_ms"].
+
+    On the tunneled client the FIRST device→host fetch of the process
+    flips every later synchronization to a flat ~66 ms (async dispatch
+    chains stay free — docs/PERF.md §1); local backends read ~0.02 ms
+    throughout.  Recording the value at several milestones documents
+    which regime each section was measured in."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        f = jax.jit(lambda a, b: a @ b)
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        f(x, x).block_until_ready()
+        lat = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            f(x, x).block_until_ready()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        DETAILS.setdefault("dispatch_ms", {})[tag] = round(
+            statistics.median(lat), 3
+        )
+    except Exception as e:  # never let the probe cost a section
+        DETAILS.setdefault("dispatch_ms", {})[tag] = repr(e)[:80]
+
+
 def param_bytes(params) -> int:
     return int(sum(np.prod(p.shape) * p.dtype.itemsize for p in params.values()))
 
@@ -375,6 +404,7 @@ def main() -> None:
         # watchdog breadcrumb: each ~200 MB block transfer is progress
         DETAILS["ingest_rows"] = start + n
     log(f"corpus: {n_chunks} chunks ingested in {time.perf_counter()-t0:.1f}s")
+    dispatch_health("after_corpus")
 
     gen = GenerateEngine(dec_cfg, mesh=mesh)
 
@@ -546,6 +576,7 @@ def main() -> None:
     gen = GenerateEngine(
         dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
     )
+    dispatch_health("before_headline")
     p50, p95 = measure_e2e(gen, q_texts[2:], "headline (int8 serving)")
     DETAILS["qa_e2e"] = {
         "p50_ms": round(p50, 2),
@@ -827,9 +858,22 @@ def main() -> None:
     try:
         from docqa_tpu.deid.engine import DeidEngine
 
-        # random-init weights: identical FLOPs/memory to trained, and the
-        # tagger architecture is what config 2 measures
-        deid = DeidEngine(NERConfig(), use_ner_model=True)
+        _ner_cache = os.path.join(
+            os.path.expanduser("~"), ".cache", "docqa_tpu", "ner.npz"
+        )
+        if small:
+            # random-init weights: identical FLOPs/memory to trained, and
+            # the tagger architecture is what config 2 measures
+            deid = DeidEngine(NERConfig(), use_ner_model=True)
+        else:
+            # trained weights via the cache: realistic weights for the
+            # throughput number, reused by the late quality section and
+            # across bench reruns; load_or_train runs any needed training
+            # in a CHILD process so its minutes of step loops and sync
+            # churn never sit inside this process between the driver and
+            # the 7B headline
+            os.makedirs(os.path.dirname(_ner_cache), exist_ok=True)
+            deid = DeidEngine.trained(NERConfig(), params_path=_ner_cache)
         docs32 = [
             f"Patient {i} was admitted on 2024-03-{1 + i % 27:02d} with "
             "chest pain. " + "History reviewed with the care team. " * 20
@@ -856,7 +900,9 @@ def main() -> None:
                     from docqa_tpu.deid.evalset import evaluate_deid
 
                     t0 = time.perf_counter()
-                    deid_trained = DeidEngine.trained(NERConfig())
+                    deid_trained = DeidEngine.trained(
+                        NERConfig(), params_path=_ner_cache
+                    )
                     ev = evaluate_deid(deid_trained)
                     # record the headline quality numbers BEFORE the sweep:
                     # a sweep failure must not discard minutes of training
@@ -932,7 +978,7 @@ def main() -> None:
             # 7.2 GB tree — the decode-only bf16 attempt (config 3b, runs
             # last) keeps device init because nothing measured after it.
             params8 = init_quantized_decoder_params(
-                jax.random.PRNGKey(0), cfg7, host_init=True
+                jax.random.PRNGKey(0), cfg7, host_init=True, host_seed=0
             )
             pb8 = param_bytes(params8)
             gen8 = GenerateEngine(
@@ -1079,6 +1125,7 @@ def main() -> None:
             except Exception as e:
                 log(f"7B int8 load bench failed: {e!r}")
                 DETAILS["rag_load_7b_int8"] = {"error": repr(e)[:300]}
+            dispatch_health("after_7b_sections")
             del gen8, params8
             gc.collect()
         except Exception as e:
@@ -1152,7 +1199,8 @@ def main() -> None:
             except Exception as e:
                 log(f"int4 fusion probe failed: {e!r}")
             params4 = init_quantized_decoder_params(
-                jax.random.PRNGKey(0), cfg7, host_init=True, bits=4
+                jax.random.PRNGKey(0), cfg7, host_init=True, bits=4,
+                host_seed=0,
             )
             pb4 = param_bytes(params4)  # NOTE: host itemsize counts int4
             # as 1 byte; the packed on-device tree is half this

@@ -71,7 +71,12 @@ class EncoderEngine:
             cfg.vocab_size, vocab_path=cfg.tokenizer_path
         )
         if params is None:
-            params = init_encoder_params(jax.random.PRNGKey(seed), cfg)
+            # host init + explicit seed: the checkpoint transfer path,
+            # without the device path's ~112 eager RNG round-trips
+            # (models/decoder.py)
+            params = init_encoder_params(
+                jax.random.PRNGKey(seed), cfg, host_init=True, host_seed=seed
+            )
         if mesh is not None:
             params = jax.device_put(params, mesh.replicated)
         self.params = params

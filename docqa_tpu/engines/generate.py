@@ -122,17 +122,19 @@ class GenerateEngine:
                     cfg,
                     host_init=True,
                     bits=cfg.quant_bits,
+                    host_seed=seed,
                 )
             else:
-                # host_init: draw on host + device_put per tensor — the same
-                # transfer path real checkpoints take, and it avoids the
-                # tunneled-client degradation the device-side random-init
-                # sequence was measured to trigger (see init_decoder_params)
+                # host_init + host_seed: draw on host + device_put per
+                # tensor — the transfer path real checkpoints take, with
+                # the seed passed so init needs no key_data fetch (see
+                # init_decoder_params)
                 params = init_decoder_params(
                     jax.random.PRNGKey(seed),
                     cfg,
                     param_dtype=param_dtype or jnp.dtype(cfg.dtype),
                     host_init=True,
+                    host_seed=seed,
                 )
         else:
             from docqa_tpu.models.quant import (

@@ -52,7 +52,7 @@ class Seq2SeqEngine:
         )
         if params is None:
             params = init_seq2seq_params(
-                jax.random.PRNGKey(seed), cfg, host_init=True
+                jax.random.PRNGKey(seed), cfg, host_init=True, host_seed=seed
             )
         if mesh is not None:
             params = jax.device_put(params, mesh.replicated)

@@ -60,7 +60,7 @@ def decoder_param_schema(cfg: DecoderConfig):
 
 def init_decoder_params(
     rng: jax.Array, cfg: DecoderConfig, param_dtype=jnp.float32,
-    host_init: bool = False,
+    host_init: bool = False, host_seed: Optional[int] = None,
 ) -> Params:
     """``param_dtype``: float32 default (training master weights); bf16 for
     inference-only at target scale — a 7B f32 tree (29 GB) cannot even be
@@ -68,18 +68,22 @@ def init_decoder_params(
     never on a whole f32 tree.
 
     ``host_init``: draw on the host (numpy) and ``device_put`` per tensor —
-    the same transfer path real safetensors checkpoints take.  Exists
-    because on the tunneled single-chip runtime the device-side
-    ``jax.random`` init sequence was measured to leave the client in a
-    degraded mode where EVERY later dispatch pays a flat ~70 ms; host init
-    sidesteps it (and is what production weight-loading does anyway)."""
+    the same transfer path real safetensors checkpoints take, and far
+    fewer tunnel round-trips than the device path's ~136 eager RNG
+    programs.  Callers that know their integer seed should pass
+    ``host_seed``: the fallback derives it from ``rng`` via a
+    ``key_data`` fetch, and on the tunneled client the first fetch of
+    anything flips the process into its flat ~66 ms-per-sync mode
+    (docs/PERF.md §1) — serving flips at its first result fetch anyway,
+    but init should not be the trigger."""
     param_dtype = jnp.dtype(param_dtype)
     p: Params = {}
     if host_init:
         import numpy as _np
 
-        seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
-        host_rng = _np.random.default_rng(seed)
+        from docqa_tpu.utils import host_seed_from_rng
+
+        host_rng = _np.random.default_rng(host_seed_from_rng(rng, host_seed))
         for name, kind, shape, fan_in in decoder_param_schema(cfg):
             if kind == "ones":
                 p[name] = jax.device_put(_np.ones(shape, param_dtype))

@@ -31,12 +31,36 @@ from docqa_tpu.ops.norms import layer_norm
 Params = Dict[str, jax.Array]
 
 
-def init_encoder_params(rng: jax.Array, cfg: EncoderConfig) -> Params:
-    """Seeded random init with BERT-style scales (trunc-normal 0.02)."""
-    keys = iter(jax.random.split(rng, 16 + 16 * cfg.num_layers))
+def init_encoder_params(
+    rng: jax.Array,
+    cfg: EncoderConfig,
+    host_init: bool = False,
+    host_seed: Optional[int] = None,
+) -> Params:
+    """Seeded random init with BERT-style scales (trunc-normal 0.02).
 
-    def norm(shape, scale=0.02):
-        return (jax.random.normal(next(keys), shape, jnp.float32) * scale)
+    ``host_init`` draws on the host (numpy) and transfers — the path real
+    safetensors checkpoints take, and far fewer tunnel round-trips than
+    ~112 eager device RNG programs (see models/decoder.py).  The serving
+    engine defaults to it; the device path remains for training code
+    that wants params born sharded."""
+    if host_init:
+        import numpy as _np
+
+        from docqa_tpu.utils import host_seed_from_rng
+
+        host_rng = _np.random.default_rng(host_seed_from_rng(rng, host_seed))
+
+        def norm(shape, scale=0.02):
+            return jax.device_put(
+                (host_rng.standard_normal(shape) * scale).astype(_np.float32)
+            )
+
+    else:
+        keys = iter(jax.random.split(rng, 16 + 16 * cfg.num_layers))
+
+        def norm(shape, scale=0.02):
+            return jax.random.normal(next(keys), shape, jnp.float32) * scale
 
     p: Params = {
         "tok_emb": norm((cfg.vocab_size, cfg.hidden_dim)),

@@ -186,6 +186,7 @@ def init_quantized_decoder_params(
     cfg: DecoderConfig,
     host_init: bool = False,
     bits: int = 8,
+    host_seed: Optional[int] = None,
 ) -> Params:
     """Random-init directly into int8 — tensor-by-tensor, so a 7B tree
     peaks at ~7.2 GB + one float tensor instead of bf16+int8 together.
@@ -211,8 +212,9 @@ def init_quantized_decoder_params(
     if host_init:
         import ml_dtypes as _ml
 
-        seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
-        host_rng = _np.random.default_rng(seed)
+        from docqa_tpu.utils import host_seed_from_rng
+
+        host_rng = _np.random.default_rng(host_seed_from_rng(rng, host_seed))
         out: Params = {}
         for name, kind, shape, fan_in in decoder_param_schema(cfg):
             if kind == "ones":

@@ -71,20 +71,22 @@ def seq2seq_param_schema(cfg: Seq2SeqConfig):
 
 def init_seq2seq_params(
     rng: jax.Array, cfg: Seq2SeqConfig, param_dtype=None,
-    host_init: bool = False,
+    host_init: bool = False, host_seed: Optional[int] = None,
 ) -> Params:
     """``host_init``: draw on the host and ``device_put`` per tensor — the
-    transfer path real checkpoints take, and it avoids the tunneled-client
-    dispatch degradation the device-side random-init sequence triggers
-    (see models/decoder.py); serving engines default to it."""
+    transfer path real checkpoints take, with fewer tunnel round-trips
+    than the device path's eager RNG programs (see models/decoder.py);
+    serving engines default to it and pass ``host_seed`` so the seed is
+    not derived via a ``key_data`` fetch."""
     import numpy as _np
+
+    from docqa_tpu.utils import host_seed_from_rng
 
     param_dtype = jnp.dtype(param_dtype or cfg.dtype)
     schema = list(seq2seq_param_schema(cfg))
     p: Params = {}
     if host_init:
-        seed = int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
-        host_rng = _np.random.default_rng(seed)
+        host_rng = _np.random.default_rng(host_seed_from_rng(rng, host_seed))
         for name, kind, shape in schema:
             if kind == "ones":
                 p[name] = jax.device_put(_np.ones(shape, param_dtype))

@@ -279,12 +279,74 @@ def _fingerprint(cfg: NERConfig, steps: int) -> list:
     ]
 
 
+def _train_in_subprocess(
+    cfg: NERConfig, path: str, steps: int, seq: int, **train_kw
+) -> bool:
+    """Run train+save in a child process; True when the child saved the npz.
+
+    Why: a substantial train drags minutes of step loops, compile churn,
+    and hundreds of synchronization points through the calling process —
+    on the tunneled client each sync costs a flat ~66 ms once the process
+    has fetched anything (docs/PERF.md §1), and a serving or bench
+    process should spend its life serving, not training.  The child takes
+    all of that, exits, and the parent loads host-side arrays from the
+    npz (the same path a restart takes)."""
+    import dataclasses
+    import json
+    import subprocess
+    import sys
+
+    payload = json.dumps(
+        {"cfg": dataclasses.asdict(cfg), "path": path, "steps": steps,
+         "seq": seq, "train_kw": train_kw}
+    )  # train_kw holds only JSON-able scalars (caller strips mesh)
+    child = (
+        "import json, sys\n"
+        "spec = json.loads(sys.argv[1])\n"
+        "from docqa_tpu.config import NERConfig\n"
+        "from docqa_tpu.training.ner import save_ner_params, train_ner\n"
+        "cfg = NERConfig(**{k: tuple(v) if isinstance(v, list) else v\n"
+        "                   for k, v in spec['cfg'].items()})\n"
+        "p = train_ner(cfg, steps=spec['steps'], seq=spec['seq'],\n"
+        "              **spec['train_kw'])\n"
+        "save_ner_params(spec['path'], p, cfg, train_seq=spec['seq'],\n"
+        "                train_steps=spec['steps'])\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", child, payload],
+            capture_output=True,
+            text=True,
+            timeout=5400,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+    except Exception as e:  # timeout, spawn failure — parent falls back
+        log.warning("subprocess NER training failed to run: %r", e)
+        return False
+    if r.returncode != 0:
+        log.warning(
+            "subprocess NER training exited %d: %s",
+            r.returncode,
+            (r.stderr or r.stdout)[-400:],
+        )
+        return False
+    return True
+
+
 def load_or_train(
     cfg: NERConfig,
     path: Optional[str] = None,
+    train_in_subprocess: Optional[bool] = None,
     **train_kw,
 ) -> Tuple[Params, int]:
-    """(params, train_seq).  ``train_seq`` is the serving window bound."""
+    """(params, train_seq).  ``train_seq`` is the serving window bound.
+
+    ``train_in_subprocess``: None (default) auto-selects — substantial
+    trains (steps >= 500) with a cache path run in a child process so the
+    calling process is not the one paying the training time and sync
+    churn (see _train_in_subprocess); tiny test trains stay in-process
+    to skip the interpreter+backend startup."""
     steps = train_kw.get("steps")
     if steps is None:
         steps = cfg.train_steps
@@ -294,6 +356,37 @@ def load_or_train(
             log.info("loaded ner params from %s", path)
             return params, load_ner_train_seq(path) or 128
     seq = min(train_kw.get("seq", 128), cfg.max_seq_len)
+    if train_kw.get("mesh") is not None:
+        # a mesh cannot cross a process boundary; sharded trainers stay
+        # in-process regardless of the caller's preference
+        train_in_subprocess = False
+    elif train_in_subprocess is None:
+        # auto only off-CPU: the win (keeping the serving process's sync
+        # regime and wall-clock clean) is an accelerator property, while
+        # on a CPU box the child would re-pay backend startup and — under
+        # core contention — could eat the whole timeout and then fall
+        # back in-process anyway, doubling the cost.  Concurrent chip use
+        # is fine on a healthy tunnel (measured: a child trained in 58 s
+        # while the parent held ~1 GB and kept dispatching).
+        train_in_subprocess = (
+            bool(path) and steps >= 500 and jax.default_backend() != "cpu"
+        )
+    if path and train_in_subprocess:
+        # every remaining train_kw (seed, batch_size, lr, log_every) is a
+        # JSON-able scalar and is forwarded verbatim, so the child trains
+        # the caller's exact recipe — a child that silently trained with
+        # defaults would serve different weights than the in-process
+        # fallback under the same fingerprint
+        sub_kw = {
+            k: v for k, v in train_kw.items()
+            if k not in ("steps", "seq", "mesh")
+        }
+        if _train_in_subprocess(cfg, path, steps, seq, **sub_kw):
+            params = load_ner_params(path, cfg, steps=steps)
+            if params is not None:
+                log.info("loaded ner params from child train at %s", path)
+                return params, load_ner_train_seq(path) or seq
+        log.warning("falling back to in-process NER training")
     params = train_ner(cfg, **train_kw)
     if path:
         save_ner_params(path, params, cfg, train_seq=seq, train_steps=steps)

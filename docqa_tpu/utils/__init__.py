@@ -1,11 +1,31 @@
 """Small shared helpers."""
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 
 def round_up(n: int, quantum: int) -> int:
     """Smallest multiple of ``quantum`` >= n."""
     return -(-n // quantum) * quantum
+
+
+def host_seed_from_rng(rng, host_seed: Optional[int] = None) -> int:
+    """Numpy seed for a host-side param init.
+
+    Pass ``host_seed`` (the integer the caller built its PRNGKey from)
+    whenever it is known: the fallback reads ``jax.random.key_data(rng)``
+    — a device→host fetch, and on the tunneled axon client the FIRST
+    fetch of anything permanently flips the process into a mode where
+    every later synchronization costs a flat ~66 ms (async dispatch
+    chains stay free; docs/PERF.md §1).  Serving flips anyway at its
+    first result fetch, but init should not be the thing that flips it.
+    For a fresh ``PRNGKey(s)`` the two paths agree (threefry key data is
+    the seed packed into two uint32s), so passing the seed changes no
+    generated values — it only skips the early fetch."""
+    if host_seed is not None:
+        return int(host_seed) & 0x7FFFFFFF
+    import jax
+
+    return int(jax.random.key_data(rng).ravel()[-1]) & 0x7FFFFFFF
 
 
 def pick_bucket(value: int, buckets: Sequence[int]) -> int:

@@ -1,13 +1,16 @@
-"""A/B-verify the host-init dispatch theory on the real chip.
+"""A/B that RESOLVED the dispatch-degradation mystery (r04).
 
-docs/PERF.md §1 records the round-2 observation: after running a
-device-side ``jax.random``-based decoder init (~140 random programs), every
-subsequent dispatch in that process cost a flat ~70 ms — so serving engines
-host-init (``numpy`` draw + one transfer) while one-shot bench sections
-device-init.  The theory shaped all serving code but was never A/B
-confirmed.  This script runs both arms in FRESH subprocesses (the
-degradation, if real, is process-sticky) and prints the per-arm dispatch
-latencies.
+Round 2 theorized that device-side ``jax.random`` init degraded later
+dispatches to a flat ~70 ms; running this A/B (plus the bisection it
+prompted) showed the real mechanism: the process's FIRST device→host
+fetch — of anything — flips the tunneled client into a ~66 ms-per-
+synchronization mode (async chains stay free; docs/PERF.md §1).  The
+"host" arm here degrades because its seed derivation fetched
+``key_data``; the "device" arm stayed clean only because its measurement
+never fetched.  The script is kept as the regression check for that
+resolved model: expected output on the tunneled chip is host ≈ 66 ms
+degradation, device ≈ 0 — any OTHER pattern means the client's sync
+behavior changed and §1 needs re-deriving.
 
 Usage (on the tunneled chip — do NOT force cpu):
 
@@ -131,13 +134,14 @@ def main() -> None:
         d_host = results["host"]["degradation_ms"]
         d_dev = results["device"]["degradation_ms"]
         verdict = (
-            "CONFIRMED: device-side random init degrades subsequent "
-            f"dispatches by ~{d_dev:.0f} ms while host init does not "
-            f"({d_host:.1f} ms)"
-            if d_dev > 10 and d_host < 5
-            else "NOT CONFIRMED: dispatch deltas "
-            f"host={d_host:.1f}ms device={d_dev:.1f}ms — update "
-            "docs/PERF.md §1 accordingly"
+            "MATCHES resolved model (PERF.md §1): the host arm's key_data "
+            f"FETCH flipped its process to ~{d_host:.0f} ms/sync; the "
+            "device arm never fetched and stayed clean "
+            f"({d_dev:.1f} ms)"
+            if d_host > 10 and d_dev < 5
+            else "DOES NOT MATCH resolved model: sync deltas "
+            f"host={d_host:.1f}ms device={d_dev:.1f}ms — the client's "
+            "sync behavior changed; re-derive docs/PERF.md §1"
         )
         print(json.dumps({"verdict": verdict, **{
             f"{k}_degradation_ms": v["degradation_ms"]
