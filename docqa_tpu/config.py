@@ -284,6 +284,13 @@ class StoreConfig:
     # deletions trigger a compaction (tombstones cost a mask upload per
     # search and dilute IVF cells); 0 disables
     compact_threshold: float = 0.25
+    # Token sidecar: per-row generator-token ids kept in HBM alongside the
+    # vectors (shape [capacity, token_width] int32 + a length column).
+    # Enables the single-sync fused RAG path (engines/rag_fused.py): top-k
+    # -> gather chunk tokens -> assemble the prompt -> decode, all chained
+    # on device with no host round-trip between retrieval and generation.
+    # 0 disables (no HBM cost).  At 1M rows x 128 tokens: 512 MB.
+    token_width: int = 0
 
 
 @dataclass(frozen=True)

@@ -102,6 +102,11 @@ def _search_single(vectors, queries, count, filter_mask, k: int):
     return jax.lax.top_k(scores, k)
 
 
+def _append1_kernel(buf, vals, offset):
+    """1-D variant of ``_append_kernel`` for the token-length column."""
+    return jax.lax.dynamic_update_slice(buf, vals, (offset,))
+
+
 def _append_kernel(buf, rows, offset):
     return jax.lax.dynamic_update_slice_in_dim(buf, rows, offset, 0)
 
@@ -164,6 +169,24 @@ class VectorStore:
         # masked out of every search; ``compact_deleted`` erases for real
         self._deleted = np.zeros((0,), bool)
         self._n_deleted = 0
+        # Token sidecar (cfg.token_width > 0): per-row generator-token ids
+        # + true lengths, row-aligned with the vector buffer through every
+        # add/grow/compact/snapshot — the device-side prompt source for
+        # the fused RAG path (engines/rag_fused.py).  Unsharded: fusion is
+        # single-device only (FusedRetriever._fusable), and a sharded mesh
+        # keeps the classic two-step path.
+        W = cfg.token_width
+        if W:
+            self._tok_host = np.zeros((0, W), np.int32)
+            self._tok_len_host = np.zeros((0,), np.int32)
+            self._tok_dev = jnp.zeros((self._capacity, W), jnp.int32)
+            self._tok_len_dev = jnp.zeros((self._capacity,), jnp.int32)
+            self._tok_append_jit = jax.jit(
+                _append_kernel, donate_argnums=(0,)
+            )
+            self._tok_len_append_jit = jax.jit(
+                _append1_kernel, donate_argnums=(0,)
+            )
 
     def _intern(self, column: str, value: Optional[str]) -> int:
         if value is None:
@@ -232,6 +255,19 @@ class VectorStore:
         self._dev = jnp.asarray(buf, self._dtype)
         if self.mesh is not None:
             self._dev = jax.device_put(self._dev, self.mesh.row_sharded)
+        if self.cfg.token_width:
+            self._upload_tok_locked()
+
+    def _upload_tok_locked(self) -> None:
+        """Re-upload the sidecar device arrays at the current capacity from
+        the host master copy (capacity change or compaction)."""
+        W = self.cfg.token_width
+        tok = np.zeros((self._capacity, W), np.int32)
+        tok[: self._count] = self._tok_host[: self._count]
+        tl = np.zeros((self._capacity,), np.int32)
+        tl[: self._count] = self._tok_len_host[: self._count]
+        self._tok_dev = jnp.asarray(tok)
+        self._tok_len_dev = jnp.asarray(tl)
 
     # ---- public API ----------------------------------------------------------
 
@@ -254,12 +290,21 @@ class VectorStore:
         return self.cfg.dim
 
     def add(
-        self, vectors: np.ndarray, metadata: Sequence[Dict[str, Any]]
+        self,
+        vectors: np.ndarray,
+        metadata: Sequence[Dict[str, Any]],
+        token_rows: Optional[np.ndarray] = None,
+        token_lens: Optional[np.ndarray] = None,
     ) -> List[int]:
         """Append normalized vectors + metadata rows; returns global row ids.
 
         Visible to searches immediately (device-side append — the reference
         required a service restart, ``llm-qa/main.py:35``).
+
+        ``token_rows``/``token_lens``: per-row generator-token ids for the
+        sidecar (``cfg.token_width``); rows longer than the width are
+        truncated, absent rows stay empty (the fused RAG path then renders
+        that chunk as zero tokens).
         """
         vectors = np.asarray(vectors, np.float32)
         if vectors.ndim != 2 or vectors.shape[1] != self.cfg.dim:
@@ -289,11 +334,52 @@ class VectorStore:
             self._dev = self._append_jit(
                 self._dev, jnp.asarray(rows, self._dtype), start
             )
+            if self.cfg.token_width:
+                self._append_tokens_locked(
+                    start, n, n_pad, token_rows, token_lens
+                )
             self._meta.extend(dict(m) for m in metadata)
             self._append_columns(metadata)
             self._count = start + n
             self._version += 1
             return list(range(start, start + n))
+
+    def _append_tokens_locked(
+        self, start, n, n_pad, token_rows, token_lens
+    ) -> None:
+        W = self.cfg.token_width
+        block = np.zeros((n_pad, W), np.int32)
+        lens = np.zeros((n_pad,), np.int32)
+        if token_rows is not None:
+            token_rows = np.asarray(token_rows, np.int32)
+            w = min(W, token_rows.shape[1])
+            block[:n, :w] = token_rows[:, :w]
+            if token_lens is None:
+                token_lens = (token_rows != 0).sum(axis=1)
+            lens[:n] = np.minimum(np.asarray(token_lens, np.int32), W)
+        if self._tok_host.shape[0] < start + n:
+            grow = max(start + n, 2 * max(1, self._tok_host.shape[0]))
+            th = np.zeros((grow, W), np.int32)
+            th[: self._tok_host.shape[0]] = self._tok_host
+            tl = np.zeros((grow,), np.int32)
+            tl[: self._tok_len_host.shape[0]] = self._tok_len_host
+            self._tok_host, self._tok_len_host = th, tl
+        self._tok_host[start : start + n] = block[:n]
+        self._tok_len_host[start : start + n] = lens[:n]
+        self._tok_dev = self._tok_append_jit(
+            self._tok_dev, jnp.asarray(block), start
+        )
+        self._tok_len_dev = self._tok_len_append_jit(
+            self._tok_len_dev, jnp.asarray(lens), start
+        )
+
+    def token_sidecar(self):
+        """(tokens [capacity, W] int32, lengths [capacity] int32) device
+        arrays, or None when the sidecar is disabled.  Call under the same
+        locking discipline as search (the fused program reads them)."""
+        if not self.cfg.token_width:
+            return None
+        return self._tok_dev, self._tok_len_dev
 
     def _get_search_fn(self, q: int, k: int, masked: bool) -> Callable:
         key = (self._capacity, q, k, masked)
@@ -437,6 +523,9 @@ class VectorStore:
             keep = ~self._deleted[:count]
             removed = count - int(keep.sum())
             self._host = self._host[:count][keep].copy()
+            if self.cfg.token_width:
+                self._tok_host = self._tok_host[:count][keep].copy()
+                self._tok_len_host = self._tok_len_host[:count][keep].copy()
             self._meta = [
                 md for md, k in zip(self._meta, keep) if k
             ]
@@ -464,6 +553,8 @@ class VectorStore:
             self._dev = jnp.asarray(buf, self._dtype)
             if self.mesh is not None:
                 self._dev = jax.device_put(self._dev, self.mesh.row_sharded)
+            if self.cfg.token_width:
+                self._upload_tok_locked()
             if self._count == 0:  # keep a 1-row pad so slicing stays valid
                 self._host = np.zeros((1, self.cfg.dim), np.float32)
             self._version += 1
@@ -592,6 +683,10 @@ class VectorStore:
             count, version = self._count, self._version
             vectors = self._host[:count].copy()
             meta = list(self._meta)
+            tokens = token_lens = None
+            if self.cfg.token_width:
+                tokens = self._tok_host[:count].copy()
+                token_lens = self._tok_len_host[:count].copy()
         base = os.path.join(directory, f"index_v{version}")
         tmp = tempfile.mkdtemp(dir=directory)
         # checksummed native codec (C++ DNS1 shard, crc32-verified mmap read)
@@ -599,16 +694,19 @@ class VectorStore:
         vec_path = native.write_vectors(os.path.join(tmp, "vectors"), vectors)
         with open(os.path.join(tmp, "metadata.json"), "w") as f:
             json.dump(meta, f)
+        manifest = {
+            "version": version,
+            "count": count,
+            "dim": self.cfg.dim,
+            "vectors": os.path.basename(vec_path),
+        }
+        if tokens is not None:
+            np.save(os.path.join(tmp, "tokens.npy"), tokens)
+            np.save(os.path.join(tmp, "token_lens.npy"), token_lens)
+            manifest["tokens"] = "tokens.npy"
+            manifest["token_width"] = self.cfg.token_width
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(
-                {
-                    "version": version,
-                    "count": count,
-                    "dim": self.cfg.dim,
-                    "vectors": os.path.basename(vec_path),
-                },
-                f,
-            )
+            json.dump(manifest, f)
         import shutil
 
         if os.path.exists(base):
@@ -657,7 +755,11 @@ class VectorStore:
         with open(os.path.join(base, "metadata.json")) as f:
             meta = json.load(f)
         store = cls(cfg, mesh=mesh)
+        tokens = token_lens = None
+        if cfg.token_width and manifest.get("tokens"):
+            tokens = np.load(os.path.join(base, manifest["tokens"]))
+            token_lens = np.load(os.path.join(base, "token_lens.npy"))
         if len(vectors):
-            store.add(vectors, meta)
+            store.add(vectors, meta, token_rows=tokens, token_lens=token_lens)
         store._version = manifest["version"]
         return store

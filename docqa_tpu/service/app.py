@@ -36,7 +36,7 @@ from docqa_tpu.engines.serve import QueueFull
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
 from docqa_tpu.service.broker import make_broker
 from docqa_tpu.service.pipeline import DocumentPipeline
-from docqa_tpu.service.qa import QAService
+from docqa_tpu.service.qa import QA_TEMPLATE, QAService
 from docqa_tpu.service.registry import DocumentRegistry
 from docqa_tpu.service.schemas import (
     PatientComparisonRequest,
@@ -312,6 +312,13 @@ class DocQARuntime:
             self.encoder,
             self.store,
             on_indexed=self._on_indexed,
+            # generator tokens at index time feed the single-sync fused
+            # RAG path when the sidecar is enabled (engines/rag_fused.py)
+            prompt_tokenizer=(
+                self.generator.tokenizer
+                if self.cfg.store.token_width and self.generator is not None
+                else None
+            ),
         )
 
         # ---- registry ↔ index reconciliation: a crash between periodic
@@ -349,7 +356,14 @@ class DocQARuntime:
             from docqa_tpu.service.bootstrap import bootstrap_csv_dir
 
             n = bootstrap_csv_dir(
-                self.cfg.data.bootstrap_dir, self.encoder, self.store
+                self.cfg.data.bootstrap_dir,
+                self.encoder,
+                self.store,
+                prompt_tokenizer=(
+                    self.generator.tokenizer
+                    if self.cfg.store.token_width and self.generator is not None
+                    else None
+                ),
             )
             if n and self._index_dir:
                 self._snapshot()
@@ -370,6 +384,27 @@ class DocQARuntime:
                 retriever = FusedTieredRetriever(
                     self.encoder, self.search_index
                 )
+        fused_rag = None
+        if (
+            self.cfg.store.token_width
+            and not self.cfg.flags.use_fake_llm
+            and not self.cfg.flags.use_fake_encoder  # HashEncoder has no
+            # device params for the fused program
+            and self.cfg.store.serving_index == "exact"
+            and (self.mesh is None or self.mesh.n_devices == 1)
+        ):
+            # single-sync ask (engines/rag_fused.py): exact-serving,
+            # single-device only — a tiered policy or sharded store keeps
+            # the classic path, which respects both
+            from docqa_tpu.engines.rag_fused import FusedRAG
+
+            fused_rag = FusedRAG(
+                self.encoder,
+                self.store,
+                self.generator,
+                QA_TEMPLATE,
+                k=self.cfg.store.default_k,
+            )
         self.qa = QAService(
             self.encoder,
             self.search_index,
@@ -379,6 +414,7 @@ class DocQARuntime:
             use_fake_llm=self.cfg.flags.use_fake_llm,
             batcher=self.batcher,
             retriever=retriever,
+            fused_rag=fused_rag,
         )
         if self.cfg.flags.use_fake_retrieval:
             # standalone/dev parity with the reference's USE_FAKE_RETRIEVAL

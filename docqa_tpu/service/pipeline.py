@@ -50,6 +50,9 @@ class DocumentPipeline:
         store,  # VectorStore
         http_extractor=None,
         on_indexed=None,  # Callable[[int], None]: docs indexed per batch
+        prompt_tokenizer=None,  # generator tokenizer: fills the token
+        # sidecar (store.cfg.token_width) at index time for the
+        # single-sync fused RAG path (engines/rag_fused.py)
     ) -> None:
         self.cfg = cfg
         self.broker = broker
@@ -59,6 +62,7 @@ class DocumentPipeline:
         self.store = store
         self.http_extractor = http_extractor
         self.on_indexed = on_indexed
+        self.prompt_tokenizer = prompt_tokenizer
         # Replay idempotence: a crash between store snapshot and queue ack
         # redelivers an already-indexed message on restart (at-least-once);
         # seeding from the restored store and checking before store.add
@@ -287,6 +291,20 @@ class DocumentPipeline:
                 # append is all-or-nothing) leaves no partial state, so the
                 # Consumer's individual retry cannot duplicate vectors
                 embeddings = self.encoder.encode_texts(all_chunks)
+                tok_rows = tok_lens = None
+                if (
+                    self.prompt_tokenizer is not None
+                    and self.store.cfg.token_width
+                ):
+                    W = self.store.cfg.token_width
+                    tok_rows = np.zeros((len(all_chunks), W), np.int32)
+                    tok_lens = np.zeros((len(all_chunks),), np.int32)
+                    for i, ch_text in enumerate(all_chunks):
+                        ids = self.prompt_tokenizer.encode(
+                            ch_text, add_specials=False
+                        )[:W]
+                        tok_rows[i, : len(ids)] = ids
+                        tok_lens[i] = len(ids)
                 with self._suppress_lock:
                     # a DELETE may have landed during the (seconds-long)
                     # encode; drop those docs' rows now, while suppress_doc
@@ -303,6 +321,9 @@ class DocumentPipeline:
                         ]
                         embeddings = np.asarray(embeddings)[keep]
                         all_meta = [all_meta[i] for i in keep]
+                        if tok_rows is not None:
+                            tok_rows = tok_rows[keep]
+                            tok_lens = tok_lens[keep]
                         per_doc = [
                             (d, n) for d, n in per_doc if d not in late
                         ]
@@ -310,7 +331,12 @@ class DocumentPipeline:
                             "dropped %d doc(s) deleted mid-encode", len(late)
                         )
                     if all_meta:
-                        self.store.add(embeddings, all_meta)
+                        self.store.add(
+                            embeddings,
+                            all_meta,
+                            token_rows=tok_rows,
+                            token_lens=tok_lens,
+                        )
                     self._indexed_doc_ids.update(d for d, _n in per_doc)
         # vectors are committed past this point: never raise (a retry would
         # re-encode and re-append the whole batch)

@@ -1,0 +1,262 @@
+"""Single-sync fused RAG (engines/rag_fused.py): the device-assembled
+prompt must reproduce the text path's answer token-for-token (hash
+tokenizer: whitespace-pretokenized, so segment concatenation equals
+whole-string tokenization), and the token sidecar must survive the store
+lifecycle (grow, delete, compact, snapshot/restore)."""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import (
+    DecoderConfig,
+    EncoderConfig,
+    GenerateConfig,
+    StoreConfig,
+)
+from docqa_tpu.engines.encoder import EncoderEngine
+from docqa_tpu.engines.generate import GenerateEngine
+from docqa_tpu.engines.rag_fused import FusedRAG
+from docqa_tpu.index.store import VectorStore
+from docqa_tpu.service.qa import QA_TEMPLATE
+
+ENC_CFG = EncoderConfig(
+    vocab_size=512,
+    hidden_dim=32,
+    num_layers=1,
+    num_heads=2,
+    mlp_dim=64,
+    max_seq_len=128,
+    embed_dim=16,
+)
+DEC_CFG = DecoderConfig(
+    vocab_size=512,
+    hidden_dim=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    mlp_dim=128,
+    max_seq_len=1024,
+)
+GEN = GenerateConfig(
+    temperature=0.0,
+    eos_id=2,
+    prefill_buckets=(128, 256, 512),
+    max_new_tokens=12,
+)
+
+CHUNKS = [
+    "aspirin 81 mg daily reduces cardiac risk score 9",
+    "metformin controls glucose in diabetes score 7",
+    "lisinopril lowers blood pressure effectively score 8",
+    "warfarin requires inr monitoring weekly score 6",
+    "albuterol relieves acute bronchospasm quickly score 5",
+]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    enc = EncoderEngine(ENC_CFG, seed=3)
+    gen = GenerateEngine(DEC_CFG, GEN, seed=11)
+    store = VectorStore(StoreConfig(dim=16, shard_capacity=256, token_width=32))
+    tok = gen.tokenizer
+    vecs = np.asarray(enc.encode_texts(CHUNKS), np.float32)
+    W = 32
+    rows = np.zeros((len(CHUNKS), W), np.int32)
+    lens = np.zeros((len(CHUNKS),), np.int32)
+    for i, text in enumerate(CHUNKS):
+        ids = tok.encode(text, add_specials=False)[:W]
+        rows[i, : len(ids)] = ids
+        lens[i] = len(ids)
+    store.add(
+        vecs,
+        [
+            {"doc_id": f"d{i}", "source": f"chunk {i}", "text_content": t}
+            for i, t in enumerate(CHUNKS)
+        ],
+        token_rows=rows,
+        token_lens=lens,
+    )
+    return enc, store, gen
+
+
+def _text_path_answer(enc, store, gen, question, k=3):
+    emb = enc.encode_texts([question])
+    hits = store.search(emb, k=k)[0]
+    context = "\n\n".join(h.metadata["text_content"] for h in hits)
+    prompt = QA_TEMPLATE.format(context=context, question=question)
+    answer = gen.generate_texts([prompt], max_new_tokens=12)[0]
+    sources = [h.metadata["source"] for h in hits]
+    return answer, sources
+
+
+def test_fused_matches_text_path(stack):
+    enc, store, gen = stack
+    rag = FusedRAG(enc, store, gen, QA_TEMPLATE, k=3)
+    for question in (
+        "what reduces cardiac risk?",
+        "how is glucose controlled?",
+    ):
+        want_answer, want_sources = _text_path_answer(
+            enc, store, gen, question
+        )
+        got = rag.ask(question, max_new_tokens=12)
+        assert got["sources"] == want_sources
+        assert got["answer"] == want_answer
+
+
+def test_fused_skips_deleted_rows(stack):
+    enc, store, gen = stack
+    rag = FusedRAG(enc, store, gen, QA_TEMPLATE, k=3)
+    question = "what reduces cardiac risk?"
+    before = rag.ask(question)["sources"]
+    top_doc = before[0].split()[-1]  # "chunk <i>" -> row index
+    store.delete_docs([f"d{top_doc}"])
+    after = rag.ask(question)["sources"]
+    assert before[0] not in after
+    # restore for other tests? module fixture is shared — re-add the row
+    i = int(top_doc)
+    vec = np.asarray(enc.encode_texts([CHUNKS[i]]), np.float32)
+    ids = gen.tokenizer.encode(CHUNKS[i], add_specials=False)[:32]
+    rows = np.zeros((1, 32), np.int32)
+    rows[0, : len(ids)] = ids
+    store.add(
+        vec,
+        [{"doc_id": f"d{i}", "source": f"chunk {i}", "text_content": CHUNKS[i]}],
+        token_rows=rows,
+        token_lens=np.asarray([len(ids)]),
+    )
+
+
+def test_sidecar_survives_snapshot_restore(tmp_path, stack):
+    enc, store, gen = stack
+    store.snapshot(str(tmp_path))
+    restored = VectorStore.restore(
+        str(tmp_path), StoreConfig(dim=16, shard_capacity=256, token_width=32)
+    )
+    sc_a = store.token_sidecar()
+    sc_b = restored.token_sidecar()
+    n = store.count
+    assert np.array_equal(
+        np.asarray(sc_a[0])[:n], np.asarray(sc_b[0])[:n]
+    )
+    assert np.array_equal(
+        np.asarray(sc_a[1])[:n], np.asarray(sc_b[1])[:n]
+    )
+    rag = FusedRAG(enc, restored, gen, QA_TEMPLATE, k=3)
+    want_answer, want_sources = _text_path_answer(
+        enc, restored, gen, "what lowers blood pressure?"
+    )
+    got = rag.ask("what lowers blood pressure?", max_new_tokens=12)
+    assert got["answer"] == want_answer
+    assert got["sources"] == want_sources
+
+
+def test_sidecar_survives_compaction(stack):
+    enc, store, gen = stack
+    # fresh store so the shared fixture is untouched
+    local = VectorStore(StoreConfig(dim=16, shard_capacity=256, token_width=32))
+    tok = gen.tokenizer
+    vecs = np.asarray(enc.encode_texts(CHUNKS), np.float32)
+    rows = np.zeros((len(CHUNKS), 32), np.int32)
+    lens = np.zeros((len(CHUNKS),), np.int32)
+    for i, text in enumerate(CHUNKS):
+        ids = tok.encode(text, add_specials=False)[:32]
+        rows[i, : len(ids)] = ids
+        lens[i] = len(ids)
+    local.add(
+        vecs,
+        [
+            {"doc_id": f"d{i}", "source": f"chunk {i}", "text_content": t}
+            for i, t in enumerate(CHUNKS)
+        ],
+        token_rows=rows,
+        token_lens=lens,
+    )
+    local.delete_docs(["d0", "d3"])
+    local.compact_deleted()
+    # rows renumbered; sidecar must have followed
+    keep = [1, 2, 4]
+    sc = local.token_sidecar()
+    got_rows = np.asarray(sc[0])[: local.count]
+    got_lens = np.asarray(sc[1])[: local.count]
+    assert np.array_equal(got_rows, rows[keep])
+    assert np.array_equal(got_lens, lens[keep])
+    rag = FusedRAG(enc, local, gen, QA_TEMPLATE, k=2)
+    out = rag.ask("how is glucose controlled?", max_new_tokens=8)
+    assert "chunk 0" not in out["sources"] and "chunk 3" not in out["sources"]
+
+
+def test_qa_service_policy_fused_vs_batcher(stack):
+    """ask() routes: fused when the batcher is idle, classic slots when
+    busy — and k overrides bypass the fixed-k fused program."""
+    from docqa_tpu.service.qa import QAService
+
+    enc, store, gen = stack
+    rag = FusedRAG(enc, store, gen, QA_TEMPLATE, k=3)
+
+    calls = []
+
+    class _Rag:
+        def ask(self, q):
+            calls.append("fused")
+            return {"answer": "a", "sources": []}
+
+    class _Batcher:
+        def __init__(self, active):
+            self.n_active = active
+            self.n_queued = 0
+            self.engine = gen
+
+        def submit_text(self, prompt, max_new_tokens=None):
+            calls.append("batcher")
+            import threading
+
+            class H:
+                def text(self, tok, timeout=None):
+                    return "b"
+
+            return H()
+
+    qa = QAService(enc, store, gen, None, k=3, batcher=_Batcher(0),
+                   fused_rag=_Rag())
+    assert qa.ask("q")["answer"] == "a"          # idle -> fused
+    qa.batcher = _Batcher(2)
+    assert qa.ask("q")["answer"] == "b"          # busy -> slots
+    qa.batcher = _Batcher(0)
+    assert qa.ask("q", k=2)["answer"] == "b"     # k override -> classic
+    assert calls == ["fused", "batcher", "batcher"]
+
+    # and the REAL fused object answers through the real service wiring
+    qa2 = QAService(enc, store, gen, None, k=3, batcher=None, fused_rag=rag)
+    out = qa2.ask("what reduces cardiac risk?")
+    assert out["answer"] and out["sources"]
+
+
+def test_tombstoned_tokens_never_pack_into_prompts(stack):
+    """Under-fill leak regression: with fewer live rows than k, top_k pads
+    with NEG_INF ties whose indices point at tombstoned rows — their
+    sidecar tokens must not appear in the packed prompt (erased clinical
+    text leaking into generation would be a PHI violation)."""
+    enc, _store, gen = stack
+    local = VectorStore(StoreConfig(dim=16, shard_capacity=256, token_width=8))
+    vecs = np.asarray(enc.encode_texts(CHUNKS[:4]), np.float32)
+    # distinctive sidecar tokens per row: row i carries 100+i repeated
+    rows = np.tile(np.arange(100, 104, dtype=np.int32)[:, None], (1, 8))
+    lens = np.full((4,), 8, np.int32)
+    local.add(
+        vecs,
+        [
+            {"doc_id": f"d{i}", "source": f"chunk {i}", "text_content": t}
+            for i, t in enumerate(CHUNKS[:4])
+        ],
+        token_rows=rows,
+        token_lens=lens,
+    )
+    local.delete_docs(["d1", "d2", "d3"])  # one live row, k=3
+    rag = FusedRAG(enc, local, gen, QA_TEMPLATE, k=3)
+    ans = rag.ask_submit("what reduces cardiac risk?", max_new_tokens=4)
+    prompt = set(ans.prompt_tokens())
+    assert 100 in prompt  # the live row's content IS there
+    assert not prompt & {101, 102, 103}, "tombstoned tokens leaked"
+    assert [h.metadata["source"] for h in ans.hits()] == ["chunk 0"]
