@@ -357,6 +357,9 @@ def main() -> None:
     n_chunks = 20_000 if small else 1_000_000
     max_new = 16 if small else 64
     n_queries = 5 if small else 20
+    # 7B e2e sample count: 5-sample p50s swung 445-683 ms run to run on
+    # the tunnel; 15 asks cost ~7 s per spec_k and cut that spread
+    n_e2e_7b = min(15, n_queries)
     dec_cfg = (
         DecoderConfig()  # smoke size
         if small
@@ -1064,7 +1067,9 @@ def main() -> None:
                     )
                     try:
                         p50k, p95k = measure_e2e(
-                            eng_k, q_texts[2:7], f"7B-int8 spec_k={spec_k}"
+                            eng_k,
+                            q_texts[2 : 2 + n_e2e_7b],
+                            f"7B-int8 spec_k={spec_k}",
                         )
                     finally:
                         # release on the error path too: a leaked spec
@@ -1321,7 +1326,9 @@ def main() -> None:
                 )
                 try:
                     p50_4, p95_4 = measure_e2e(
-                        eng4, q_texts[2:7], f"7B-int4 spec_k={best_k4}"
+                        eng4,
+                        q_texts[2 : 2 + n_e2e_7b],
+                        f"7B-int4 spec_k={best_k4}",
                     )
                 finally:
                     if eng4 is not gen4:
