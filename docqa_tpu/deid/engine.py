@@ -102,6 +102,13 @@ _MIN_PHONE_DIGITS = 7
 # operating curve on the disjoint evalset (bench threshold_sweep) — one
 # constant so serving and the training-recipe gate (training/ner.py
 # evaluate_ner) score the SAME operating point.
+#
+# CAVEAT: the operating curve behind 0.8 is derived from the SYNTHETIC
+# dev split (deid/evalset.py) — on real clinical notes with distribution
+# shift a higher bar can drop true PHI spans that 0.5 would have caught.
+# Re-sweep on an annotated sample of the real corpus before production
+# use (the all-words deny veto and the pattern-recognizer exemption
+# mitigate, they do not replace, that re-sweep).
 DEFAULT_NER_THRESHOLD = 0.8
 
 # NER deny-list (Presidio pairs its NER with deny/allow lists the same way,

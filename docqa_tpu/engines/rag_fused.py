@@ -133,8 +133,31 @@ class FusedRAG:
         # are encode(pre) + raw + encode(post, no specials)
         chat = getattr(generator, "_chat_template", None)
         if chat is None:
-            self._prefix = [tok.cls_id] + _seg_tokens(tok, before)
-            self._tail_extra: List[int] = [tok.sep_id]
+            # Mirror encode()'s special-token behavior EXACTLY on both
+            # ends — the classic text path is tokenizer.encode(prompt),
+            # so any special the fused stream adds that encode() would
+            # not (or vice versa) silently diverges the two paths:
+            # * hash tokenizer (no add_bos/add_eos attrs): encode always
+            #   wraps [CLS] ... [SEP];
+            # * BPE/SentencePiece: leading BOS only when ``add_bos`` AND
+            #   ``bos_id is not None``; trailing EOS only when
+            #   ``add_eos`` AND ``eos_id is not None`` (False for
+            #   sentencepiece-lineage vocabs, absent-id for vocabs
+            #   without the control piece — sep_id would alias 0, a real
+            #   token, in that case).
+            if not hasattr(tok, "add_bos"):
+                head = [tok.cls_id]
+            elif tok.add_bos and tok.bos_id is not None:
+                head = [tok.bos_id]
+            else:
+                head = []
+            if not hasattr(tok, "add_eos"):
+                self._tail_extra: List[int] = [tok.sep_id]
+            elif tok.add_eos and tok.eos_id is not None:
+                self._tail_extra = [tok.eos_id]
+            else:
+                self._tail_extra = []
+            self._prefix = head + _seg_tokens(tok, before)
         else:
             pre, _, post = chat.partition("{prompt}")
             self._prefix = list(

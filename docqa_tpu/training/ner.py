@@ -296,10 +296,6 @@ def _train_in_subprocess(
     import subprocess
     import sys
 
-    payload = json.dumps(
-        {"cfg": dataclasses.asdict(cfg), "path": path, "steps": steps,
-         "seq": seq, "train_kw": train_kw}
-    )  # train_kw holds only JSON-able scalars (caller strips mesh)
     child = (
         "import json, sys\n"
         "spec = json.loads(sys.argv[1])\n"
@@ -313,6 +309,13 @@ def _train_in_subprocess(
         "                train_steps=spec['steps'])\n"
     )
     try:
+        # payload construction inside the try: a non-JSON-serializable
+        # value in train_kw must trigger the documented in-process
+        # fallback, not raise out of load_or_train
+        payload = json.dumps(
+            {"cfg": dataclasses.asdict(cfg), "path": path, "steps": steps,
+             "seq": seq, "train_kw": train_kw}
+        )  # train_kw holds only JSON-able scalars (caller strips mesh)
         r = subprocess.run(
             [sys.executable, "-c", child, payload],
             capture_output=True,

@@ -233,6 +233,110 @@ def test_qa_service_policy_fused_vs_batcher(stack):
     assert out["answer"] and out["sources"]
 
 
+def test_untemplated_bpe_tail_matches_encode(tmp_path):
+    """ADVICE r4 (medium): with no chat template and a sentencepiece-
+    lineage BPE tokenizer (``add_eos=False``), the fused prompt must NOT
+    end in a spurious EOS — ``encode()`` would not have appended one, so
+    the classic text path's prompt doesn't end in one either.  The tail
+    segment is tokenized as ONE piece at ask time, so beyond the EOS gate
+    the packed tail must equal ``encode(mid+question+suffix)`` exactly."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, normalizers, trainers
+
+    from docqa_tpu.text.bpe import BPETokenizer
+
+    corpus = [QA_TEMPLATE.format(context=c, question=q) for c in CHUNKS
+              for q in ("what reduces cardiac risk?",)]
+    path = str(tmp_path / "metaspace.json")
+    t = Tokenizer(models.BPE(unk_token="<unk>", byte_fallback=True))
+    t.normalizer = normalizers.Sequence(
+        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+    )
+    byte_toks = [f"<0x{b:02X}>" for b in range(256)]
+    t.train_from_iterator(
+        corpus,
+        trainers.BpeTrainer(
+            vocab_size=600,
+            special_tokens=["<unk>", "<s>", "</s>"] + byte_toks,
+            show_progress=False,
+        ),
+    )
+    t.save(path)
+    import json as _json
+
+    blob = _json.load(open(path))
+    for at in blob["added_tokens"]:
+        if at["content"].startswith("<0x"):
+            at["special"] = False
+    _json.dump(blob, open(path, "w"))
+
+    tok = BPETokenizer.from_tokenizer_json(path)
+    assert tok.add_eos is False  # sentencepiece lineage: no trailing </s>
+
+    enc = EncoderEngine(ENC_CFG, seed=3)
+    import dataclasses
+
+    gen = GenerateEngine(
+        dataclasses.replace(DEC_CFG, vocab_size=1024), GEN,
+        tokenizer=tok, seed=11,
+    )
+    store = VectorStore(StoreConfig(dim=16, shard_capacity=256, token_width=32))
+    vecs = np.asarray(enc.encode_texts(CHUNKS), np.float32)
+    rows = np.zeros((len(CHUNKS), 32), np.int32)
+    lens = np.zeros((len(CHUNKS),), np.int32)
+    for i, text in enumerate(CHUNKS):
+        ids = tok.encode(text, add_specials=False)[:32]
+        rows[i, : len(ids)] = ids
+        lens[i] = len(ids)
+    store.add(
+        vecs,
+        [{"doc_id": f"d{i}", "source": f"chunk {i}", "text_content": c}
+         for i, c in enumerate(CHUNKS)],
+        token_rows=rows,
+        token_lens=lens,
+    )
+    rag = FusedRAG(enc, store, gen, QA_TEMPLATE, k=3)
+    assert rag._tail_extra == []  # the gate under test
+    question = "what reduces cardiac risk?"
+    ans = rag.ask_submit(question, max_new_tokens=4)
+    prompt = ans.prompt_tokens()
+    want_tail = [int(x) for x in tok.encode(
+        rag._mid + question + rag._suffix, add_specials=False
+    )]
+    assert prompt[-len(want_tail):] == want_tail
+    assert prompt[-1] != tok.eos_id, "spurious EOS at fused prompt tail"
+
+    # head gate: metaspace adds BOS (add_bos=True, bos_id present) — the
+    # fused prefix must open with it, same as encode()
+    assert rag._prefix[0] == tok.bos_id
+
+    # control: the hash tokenizer (no add_eos attr -> treated True, like
+    # its encode() which always closes with [SEP]) keeps the [SEP] tail
+    gen_hash = GenerateEngine(DEC_CFG, GEN, seed=11)
+    rag_hash = FusedRAG(enc, store, gen_hash, QA_TEMPLATE, k=3)
+    assert rag_hash._tail_extra == [gen_hash.tokenizer.sep_id]
+    assert rag_hash._prefix[0] == gen_hash.tokenizer.cls_id
+
+    # degenerate vocab: add_bos=False and add_eos=True but NO eos piece —
+    # encode() emits no specials at either end, so neither may the stream
+    bare = BPETokenizer(
+        {c: i for i, c in enumerate("abcdefgh?▁")},
+        [],
+        mode="metaspace",
+        add_bos=False,
+        add_eos=True,
+    )
+    assert bare.eos_id is None
+    gen_bare = GenerateEngine(DEC_CFG, GEN, tokenizer=bare, seed=11)
+    rag_bare = FusedRAG(
+        enc, store, gen_bare, "a {context} b {question} c", k=3
+    )
+    assert rag_bare._tail_extra == []
+    assert rag_bare._prefix == [
+        int(x) for x in bare.encode("a ", add_specials=False)
+    ]
+
+
 def test_tombstoned_tokens_never_pack_into_prompts(stack):
     """Under-fill leak regression: with fewer live rows than k, top_k pads
     with NEG_INF ties whose indices point at tombstoned rows — their
