@@ -43,6 +43,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.index.store import NEG_INF, SearchResult, _search_single
@@ -111,10 +113,12 @@ class FusedRAG:
     """Single-sync ask over (EncoderEngine, VectorStore+sidecar,
     GenerateEngine).
 
-    Single-device only (same constraint as FusedRetriever); the template
-    is the caller's QA template split at ``{context}``/``{question}``,
-    with the generator's chat template wrapped around the whole prompt
-    when configured."""
+    Composes with a row-sharded store: the search + sidecar gather run
+    under ``shard_map`` (per-shard top-k, owned-row token gather, psum
+    merge) and the packed prompt feeds the TP-sharded prefill+decode —
+    a v5e-8 keeps the one-sync path.  The template is the caller's QA
+    template split at ``{context}``/``{question}``, with the generator's
+    chat template wrapped around the whole prompt when configured."""
 
     def __init__(self, encoder, store, generator, template: str,
                  k: int = 3, joiner: str = "\n\n"):
@@ -180,6 +184,8 @@ class FusedRAG:
             pad_id = self.generator.gen.pad_id
             n_seg = 1 + 2 * k  # prefix, (chunk, sep)*(k-1), chunk, tail
             w_seg = max(W, len(self._prefix), len(self._sep), t_bucket, 1)
+            mesh = self.store.mesh
+            sharded = mesh is not None and mesh.n_model > 1
             # static per-chunk token budget: everything except the chunks
             # is non-negotiable (template + question), chunks absorb the
             # squeeze when l_bucket is clamped by max_seq_len - max_new
@@ -194,18 +200,71 @@ class FusedRAG:
                 // k,
             )
 
+            def _search_gather(buf, q, count, tok_dev, tok_len_dev, mask):
+                """(vals, row_ids, chunk_toks [k,W], chunk_lens [k]).
+
+                Sharded: the store's shard_map search kernel, then each
+                shard gathers the hit rows IT owns from its sidecar slice
+                and a psum merges the contributions (non-owners add
+                zeros) — the packed prompt never leaves the device and no
+                shard materializes another shard's sidecar."""
+                if not sharded:
+                    vals, row_ids = _search_single(buf, q, count, mask, k)
+                    rows = jnp.clip(row_ids[0], 0, tok_dev.shape[0] - 1)
+                    return vals, row_ids, tok_dev[rows], tok_len_dev[rows]
+                axis = mesh.model_axis
+                from docqa_tpu.index.store import _search_kernel
+
+                def body(buf_s, q_r, cnt, tok_s, tok_len_s, m):
+                    vals, ids = _search_kernel(buf_s, q_r, cnt, m, k, axis)
+                    n_local = tok_s.shape[0]
+                    off = jax.lax.axis_index(axis) * n_local
+                    local = ids[0] - off                      # [k]
+                    owned = (local >= 0) & (local < n_local)
+                    safe = jnp.clip(local, 0, n_local - 1)
+                    toks = jnp.where(owned[:, None], tok_s[safe], 0)
+                    lens = jnp.where(owned, tok_len_s[safe], 0)
+                    return (
+                        vals,
+                        ids,
+                        jax.lax.psum(toks, axis),
+                        jax.lax.psum(lens, axis),
+                    )
+
+                in_specs = [
+                    P(axis, None),  # vector rows sharded
+                    P(),            # query embedding replicated
+                    P(),            # count
+                    P(axis, None),  # sidecar tokens row-sharded
+                    P(axis),        # sidecar lengths row-sharded
+                ]
+                args = [buf, q, count, tok_dev, tok_len_dev]
+                if mask is not None:
+                    in_specs.append(P())
+                    args.append(mask)
+                    wrapped = body
+                else:
+                    def wrapped(buf_s, q_r, cnt, tok_s, tok_len_s):
+                        return body(buf_s, q_r, cnt, tok_s, tok_len_s, None)
+
+                return shard_map(
+                    wrapped,
+                    mesh=mesh.mesh,
+                    in_specs=tuple(in_specs),
+                    out_specs=(P(), P(), P(), P()),
+                    check_vma=False,
+                )(*args)
+
             def program(enc_params, q_ids, q_len, buf, count, tok_dev,
                         tok_len_dev, tail_ids, tail_len, mask):
                 emb = encode_batch(enc_params, enc_cfg, q_ids, q_len)
                 emb = emb / jnp.maximum(
                     jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
                 )
-                vals, row_ids = _search_single(
-                    buf, emb.astype(buf.dtype), count, mask, k
+                vals, row_ids, chunk_toks, chunk_lens = _search_gather(
+                    buf, emb.astype(buf.dtype), count, tok_dev,
+                    tok_len_dev, mask,
                 )
-                rows = jnp.clip(row_ids[0], 0, tok_dev.shape[0] - 1)
-                chunk_toks = tok_dev[rows]            # [k, W]
-                chunk_lens = tok_len_dev[rows]        # [k]
                 # Under-fill guard: with fewer than k LIVE rows, top_k
                 # pads with NEG_INF ties whose indices point at masked
                 # (tombstoned) rows — zero their lengths so erased
@@ -291,30 +350,56 @@ class FusedRAG:
             else round_up(l_need, 128),
             usable,
         )
-        with store._lock:
-            count = store._count
-            if count == 0:
-                raise EmptyStoreError("empty store: nothing to retrieve")
-            sidecar = store.token_sidecar()
-            k_eff = min(self.k, count)
-            # tombstoned rows must stay unretrievable through this path too
-            mask = store._compose_live_locked(None, already_live=False)
-            fn = self._get_fn(k_eff, t_bucket, l_bucket, masked=mask is not None)
-            args = [
-                self.encoder.params,
-                jnp.asarray(q_ids),
-                jnp.asarray(q_len),
-                store._dev,
-                jnp.int32(count),
-                sidecar[0],
-                sidecar[1],
-                jnp.asarray(tail_ids),
-                jnp.int32(min(len(tail), t_bucket)),
-            ]
-            if mask is not None:
-                args.append(jnp.asarray(mask))
-            with span("fused_rag_pack", DEFAULT_REGISTRY):
+        def snapshot_and_build():
+            """Consistent (fn, args) from ONE lock acquisition.  The jit
+            dispatch itself happens OUTSIDE the lock: the snapshot's
+            Python refs keep the device buffers alive, and the only
+            hazard — an ``add()`` donating the vector/sidecar buffers
+            between snapshot and dispatch — raises immediately at call
+            time (deleted-buffer check), which the retry below handles.
+            This keeps XLA tracing+compile of the fused program (seconds,
+            embedding the encoder forward) from stalling every concurrent
+            index/search under ``store._lock`` (ADVICE r4)."""
+            with store._lock:
+                count = store._count
+                if count == 0:
+                    raise EmptyStoreError("empty store: nothing to retrieve")
+                sidecar = store.token_sidecar()
+                k_eff = min(self.k, count)
+                # tombstoned rows must stay unretrievable through this
+                # path too
+                mask = store._compose_live_locked(None, already_live=False)
+                fn = self._get_fn(
+                    k_eff, t_bucket, l_bucket, masked=mask is not None
+                )
+                args = [
+                    self.encoder.params,
+                    jnp.asarray(q_ids),
+                    jnp.asarray(q_len),
+                    store._dev,
+                    jnp.int32(count),
+                    sidecar[0],
+                    sidecar[1],
+                    jnp.asarray(tail_ids),
+                    jnp.int32(min(len(tail), t_bucket)),
+                ]
+                if mask is not None:
+                    args.append(jnp.asarray(mask))
+            return fn, args
+
+        with span("fused_rag_pack", DEFAULT_REGISTRY):
+            fn, args = snapshot_and_build()
+            try:
                 prompt, total, vals, row_ids = fn(*args)
+            except RuntimeError:
+                # donation race: an add() consumed the snapshot's buffers
+                # mid-compile/dispatch.  Retry with BOTH snapshot and
+                # dispatch under the lock (RLock — reentrant), which
+                # excludes adds entirely; the program cache is warm now,
+                # so the held-lock dispatch is microseconds, not seconds.
+                with store._lock:
+                    fn, args = snapshot_and_build()
+                    prompt, total, vals, row_ids = fn(*args)
         # prefill+decode chained on the device-side prompt — no sync between
         gfn = gen._get_fn(
             1, l_bucket, max_new, greedy=gen.gen.temperature == 0.0

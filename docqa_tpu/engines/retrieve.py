@@ -11,12 +11,13 @@ device time either program needs, and the intermediate embedding paid an
 extra device->host->device hop.  Fusing collapses /ask retrieval to one
 XLA program and keeps the embedding on-device.
 
-Mesh caveat: with a row-sharded store (n_model > 1) search runs under
-``shard_map`` while the encoder is replicated-batch — the fused program
-would need the query broadcast inside the shard_map body.  That
-composition is left to the store's own kernel; the retriever transparently
-falls back to the two-dispatch path there (the multi-chip case amortizes
-dispatch overhead over 8 programs anyway).
+Mesh composition: with a row-sharded store (n_model > 1) the fused
+program keeps ONE dispatch — the encoder forward runs replicated under
+the jit, and the search enters the same ``shard_map`` kernel the store's
+own search uses (per-shard MXU matmul + local top-k + tiny all-gather
+merge, ``index/store.py:_search_kernel``), with the freshly-computed
+query embedding replicated into the shard bodies.  A v5e-8 serving mesh
+therefore pays the same single host->device round-trip as one chip.
 """
 
 from __future__ import annotations
@@ -28,12 +29,46 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
 from docqa_tpu.engines.encoder import marshal_texts
-from docqa_tpu.index.store import SearchResult, VectorStore, _search_single
+from docqa_tpu.index.store import (
+    SearchResult,
+    VectorStore,
+    _search_kernel,
+    _search_single,
+)
 from docqa_tpu.models.encoder import encode_batch
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
 
 QUERY_BATCH_BUCKETS = (1, 4, 16)
+
+
+def sharded_search(store_mesh, emb, buf, count, mask, k: int):
+    """Exact top-k over a row-sharded buffer from an in-program query
+    embedding: the same ``shard_map`` kernel ``VectorStore`` searches
+    with, entered from INSIDE a jit (the embedding never leaves the
+    device).  ``mask`` may be None.  Returns replicated (vals, ids)."""
+    axis = store_mesh.model_axis
+    kernel = functools.partial(_search_kernel, k=k, axis=axis)
+    in_specs = [P(axis, None), P(), P()]
+    if mask is not None:
+        in_specs.append(P())
+        body = kernel
+        args = (buf, emb, count, mask)
+    else:
+        def body(vectors, queries, cnt):
+            return kernel(vectors, queries, cnt, None)
+
+        args = (buf, emb, count)
+    return shard_map(
+        body,
+        mesh=store_mesh.mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(*args)
 
 
 class FusedRetriever:
@@ -51,25 +86,13 @@ class FusedRetriever:
         self.store = store
         self._fns: Dict[Any, Any] = {}
 
-    @property
-    def _fusable(self) -> bool:
-        """Single-device only: a row-sharded store searches under
-        ``shard_map`` and a data-parallel mesh needs the encoder's batch
-        rounding + ``batch_sharded`` placement — both keep the generic
-        two-step path."""
-        mesh = self.store.mesh
-        if mesh is None:
-            return True
-        return (
-            getattr(mesh, "n_model", 1) == 1
-            and getattr(mesh, "n_data", 1) == 1
-        )
-
     def _get_fn(self, k: int, masked: bool):
         key = (k, masked)
         fn = self._fns.get(key)
         if fn is None:
             enc_cfg = self.encoder.cfg
+            mesh = self.store.mesh
+            sharded = mesh is not None and mesh.n_model > 1
 
             def program(enc_params, ids, lengths, buf, count, mask):
                 emb = encode_batch(enc_params, enc_cfg, ids, lengths)
@@ -79,9 +102,13 @@ class FusedRetriever:
                 emb = emb / jnp.maximum(
                     jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
                 )
-                vals, row_ids = _search_single(
-                    buf, emb.astype(buf.dtype), count, mask, k
-                )
+                q = emb.astype(buf.dtype)
+                if sharded:
+                    vals, row_ids = sharded_search(
+                        mesh, q, buf, count, mask, k
+                    )
+                else:
+                    vals, row_ids = _search_single(buf, q, count, mask, k)
                 return vals, row_ids, emb
 
             if masked:
@@ -104,10 +131,6 @@ class FusedRetriever:
         k = k or store.cfg.default_k
         if not len(texts):
             return []
-        if not self._fusable:
-            emb = self.encoder.encode_texts(texts)
-            return store.search(emb, k=k, filters=filters)
-
         n = len(texts)
         ids_p, len_p = marshal_texts(
             self.encoder.tokenizer,
@@ -159,8 +182,10 @@ class FusedTieredRetriever:
     fallback) is shared with ``TieredIndex.search`` via ``_merge``.
 
     Falls back to the fused-exact path (``FusedRetriever``) whenever the
-    tiered index itself would: no IVF tier yet, filtered queries, or a
-    multi-device mesh.
+    tiered index itself would: no IVF tier yet, or filtered queries.  On a
+    multi-device mesh it serves through the three-dispatch tiered path
+    (the tier's cell tensors are replicated; only the exact fused path is
+    mesh-fused today).
     """
 
     def __init__(self, encoder, tiered):
@@ -223,10 +248,14 @@ class FusedTieredRetriever:
             # pre-IVF or filtered: the (masked) exact fused path is the
             # right tool — identical policy to TieredIndex.search
             return self._exact.search_texts(texts, k=k, filters=filters)
-        if not self._exact._fusable:
-            # multi-device mesh: fusion is off, but the TIER must still
-            # serve — an exact fallback here would silently full-scan the
-            # store the operator configured tiered serving to avoid
+        mesh = store.mesh
+        if mesh is not None and (mesh.n_model > 1 or mesh.n_data > 1):
+            # multi-device mesh: the IVF tier's cell tensors are built
+            # replicated, so the three-dispatch tiered path serves — the
+            # TIER must still serve (an exact fallback here would silently
+            # full-scan the store the operator configured tiered serving
+            # to avoid).  The exact fused path composes with the mesh
+            # (sharded_search); fusing the probe kernel is future work.
             emb = np.asarray(
                 self.encoder.encode_texts(texts), np.float32
             )

@@ -172,15 +172,20 @@ class VectorStore:
         # Token sidecar (cfg.token_width > 0): per-row generator-token ids
         # + true lengths, row-aligned with the vector buffer through every
         # add/grow/compact/snapshot — the device-side prompt source for
-        # the fused RAG path (engines/rag_fused.py).  Unsharded: fusion is
-        # single-device only (FusedRetriever._fusable), and a sharded mesh
-        # keeps the classic two-step path.
+        # the fused RAG path (engines/rag_fused.py).  Row-sharded over the
+        # model axis exactly like the vector buffer, so the fused
+        # single-sync ask composes with a sharded mesh (the per-shard
+        # token gather + psum merge lives in engines/rag_fused.py).
         W = cfg.token_width
         if W:
             self._tok_host = np.zeros((0, W), np.int32)
             self._tok_len_host = np.zeros((0,), np.int32)
-            self._tok_dev = jnp.zeros((self._capacity, W), jnp.int32)
-            self._tok_len_dev = jnp.zeros((self._capacity,), jnp.int32)
+            self._tok_dev = self._place_rows(
+                jnp.zeros((self._capacity, W), jnp.int32)
+            )
+            self._tok_len_dev = self._place_rows(
+                jnp.zeros((self._capacity,), jnp.int32)
+            )
             self._tok_append_jit = jax.jit(
                 _append_kernel, donate_argnums=(0,)
             )
@@ -236,11 +241,16 @@ class VectorStore:
         quantum = 128 * self._n_shards
         return max(quantum, round_up(n, quantum))
 
+    def _place_rows(self, arr: jax.Array) -> jax.Array:
+        """Shard a [capacity, ...] array's rows over the model axis (no-op
+        without a mesh) — the one placement rule for the vector buffer and
+        its token sidecar, so the two can never drift apart."""
+        if self.mesh is None:
+            return arr
+        return jax.device_put(arr, self.mesh.row_sharded)
+
     def _alloc(self, capacity: int) -> jax.Array:
-        buf = jnp.zeros((capacity, self.cfg.dim), self._dtype)
-        if self.mesh is not None:
-            buf = jax.device_put(buf, self.mesh.row_sharded)
-        return buf
+        return self._place_rows(jnp.zeros((capacity, self.cfg.dim), self._dtype))
 
     def _grow_to(self, needed: int) -> None:
         new_cap = self._capacity
@@ -252,9 +262,7 @@ class VectorStore:
         self._capacity = new_cap
         buf = np.zeros((new_cap, self.cfg.dim), np.float32)
         buf[: self._count] = self._host[: self._count]
-        self._dev = jnp.asarray(buf, self._dtype)
-        if self.mesh is not None:
-            self._dev = jax.device_put(self._dev, self.mesh.row_sharded)
+        self._dev = self._place_rows(jnp.asarray(buf, self._dtype))
         if self.cfg.token_width:
             self._upload_tok_locked()
 
@@ -266,8 +274,8 @@ class VectorStore:
         tok[: self._count] = self._tok_host[: self._count]
         tl = np.zeros((self._capacity,), np.int32)
         tl[: self._count] = self._tok_len_host[: self._count]
-        self._tok_dev = jnp.asarray(tok)
-        self._tok_len_dev = jnp.asarray(tl)
+        self._tok_dev = self._place_rows(jnp.asarray(tok))
+        self._tok_len_dev = self._place_rows(jnp.asarray(tl))
 
     # ---- public API ----------------------------------------------------------
 
@@ -550,9 +558,7 @@ class VectorStore:
             self._capacity = self._round_capacity(max(n_pad, 128))
             buf = np.zeros((self._capacity, self.cfg.dim), np.float32)
             buf[: self._count] = self._host[: self._count]
-            self._dev = jnp.asarray(buf, self._dtype)
-            if self.mesh is not None:
-                self._dev = jax.device_put(self._dev, self.mesh.row_sharded)
+            self._dev = self._place_rows(jnp.asarray(buf, self._dtype))
             if self.cfg.token_width:
                 self._upload_tok_locked()
             if self._count == 0:  # keep a 1-row pad so slicing stays valid
