@@ -168,6 +168,27 @@ class TestContextualPHI:
         assert ev["per_entity"]["EMAIL_ADDRESS"]["f1"] >= 0.99, ev
         assert ev["per_entity"]["DATE_TIME"]["recall"] >= 0.99, ev
 
+    def test_dev_test_split_evaluation(self, engine):
+        """VERDICT r4 item 5: the reported deid quality must come from
+        spans never used to pick the served threshold.  The split scorer
+        returns dev (threshold-selection) and test (held-out) metrics
+        with a bootstrap CI; floors here are calibrated on the in-test
+        550-step tagger (measured: test span_recall 0.72, char F1 0.65)
+        — the bench's fully-trained tagger reports its own numbers."""
+        from docqa_tpu.deid.evalset import evaluate_deid_split
+
+        ev = evaluate_deid_split(engine, n_boot=100)
+        assert ev["dev"]["gold_spans"] + ev["test"]["gold_spans"] >= 100
+        assert ev["test"]["gold_spans"] >= 60
+        assert ev["test"]["span_recall_any"] >= 0.6, ev["test"]
+        assert ev["test"]["char_f1"] >= 0.5, ev["test"]
+        lo, hi = ev["test"]["entity_f1_ci95"]
+        assert lo <= ev["test"]["entity_f1"] <= hi
+        # pattern-backed entities stay near-perfect on the held-out
+        # split too (no training involved)
+        assert ev["test"]["per_entity"]["EMAIL_ADDRESS"]["f1"] >= 0.99
+        assert ev["test"]["per_entity"]["PHONE_NUMBER"]["recall"] >= 0.99
+
     def test_six_entity_contract_end_to_end(self, engine):
         # model entities + pattern entities in one document
         text = (
