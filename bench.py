@@ -6,27 +6,41 @@ measuring the north-star metric — end-to-end QA latency over a 1M-chunk
 HBM-resident corpus, target <1 s p50 (the reference publishes no numbers,
 BASELINE.md: "measured, not inherited"; vs_baseline = 1000 / p50_ms).
 
-The rest of the BASELINE.json config matrix is measured in the same run,
-logged to stderr, and written to ``bench_details.json``:
+HEADLINE-FIRST ordering (VERDICT r4 item 1): the run drives straight to
+the headline configuration — corpus ingest -> fused retriever ->
+7B-int8 e2e at the known-best speculation — and PRINTS the JSON line the
+moment it is measured (~8-10 min in).  Everything else runs AFTER the
+line, each section gated by a wall-clock budget
+(``DOCQA_BENCH_BUDGET_S``, default 1050 s) so the process always exits
+cleanly inside the driver window; skipped sections are recorded under
+``DETAILS["skipped"]`` with the reason.
+
+Post-headline sections (stderr + ``bench_details.json``):
 
   1. retrieval: exact top-k latency at 1M chunks, encode-only, and the
-     fused one-dispatch text->top-k path
-  2. deid: NER PHI tagging throughput, batch = 32 docs
+     fused one-dispatch text->top-k path (measured pre-headline — it is
+     on the headline path anyway)
+  2. deid: NER PHI tagging throughput, batch = 32 docs (+ the trained-
+     tagger quality eval on the dev/test split evalset, late)
   3. generator: greedy decode tokens/s + HBM-bandwidth utilization for
-     the 1.1B-class serving model in bf16 AND int8 (the serving default —
-     the headline e2e runs on int8, with a bf16 e2e alongside for round-1
-     comparability), plus Mistral-7B-class attempts in bf16 and int8
-     (one v5e chip has 16 GB HBM; if the bf16 7B OOMs that is recorded)
+     the 7B class (int8 serving, int4 if the backend can lower it, bf16
+     if HBM allows) and the 1.1B class in bf16 AND int8
   4. summarizer: 5-chunk patient summary latency on the decoder backend
      and on the dedicated BART-class encoder-decoder
-  5. full RAG under load: sustained QPS through the continuous batcher
-     (target 16) with per-request latency
+  5. full RAG under load: closed-loop sustained QPS through the
+     continuous batcher (target 16) AND a fixed-arrival OPEN-loop run at
+     exactly QPS 16 reporting request p50/p95 + queue depth — the
+     latency-under-target-load number BASELINE's metric names
+     (VERDICT r4 item 3)
 
 Corpus vectors are drawn from a 2000-center mixture (embedding-like
 cluster structure) so the IVF recall measurement means something —
 uniform random vectors are IVF's degenerate worst case and nothing like
-real sentence embeddings.  IVF/tiered recall@10 + latency vs exact are
-reported alongside config 1.
+real sentence embeddings.  Chunk TEXTS (and the token sidecar) come from
+a realistic clinical-sentence pool, 60-120 generator tokens per chunk,
+so the fused-vs-classic A/B carries equal context on both paths
+(VERDICT r4 item 6 — the r04 A/B compared 2-token sources against
+100-token sidecar rows and was rightly ruled invalid).
 """
 
 from __future__ import annotations
@@ -94,6 +108,63 @@ def clustered_vectors(rng, n, dim, centers):
     )
 
 
+_POOL_DRUGS = (
+    "aspirin", "metformin", "lisinopril", "warfarin", "albuterol",
+    "atorvastatin", "omeprazole", "amlodipine", "sertraline", "insulin",
+    "prednisone", "furosemide", "gabapentin", "levothyroxine", "ramipril",
+)
+_POOL_CONDITIONS = (
+    "type 2 diabetes", "essential hypertension", "atrial fibrillation",
+    "chronic heart failure", "asthma exacerbation", "major depression",
+    "hypothyroidism", "chronic kidney disease stage 3", "osteoarthritis",
+    "gastroesophageal reflux", "stable angina", "migraine without aura",
+)
+_POOL_FINDINGS = (
+    "blood pressure 142 over 88", "heart rate 76 regular",
+    "fasting glucose 7.8 mmol per liter", "creatinine 104 umol per liter",
+    "oxygen saturation 97 percent on room air", "INR 2.4 in range",
+    "HbA1c 7.1 percent improving", "LDL 2.9 mmol per liter",
+    "mild pitting edema both ankles", "clear lung fields bilaterally",
+)
+_POOL_PLANS = (
+    "continue current dose and reassess in three months",
+    "titrate the dose upward if tolerated at review",
+    "order repeat laboratory panel before the next visit",
+    "refer to the specialist clinic for further assessment",
+    "counselled on diet adherence and daily exercise",
+    "monitor for dizziness and report any bleeding promptly",
+)
+
+
+def make_chunk_pool(rng, n_pool: int = 4096):
+    """Deterministic pool of realistic clinical chunk texts, 55-110 WORDS
+    each (60-120 generator tokens with the whitespace tokenizer) — the
+    chunk content the 1M rows cycle through, so the prompt a classic ask
+    builds from ``text_content`` and the prompt the fused path packs from
+    the token sidecar carry the SAME context (VERDICT r4 item 6)."""
+    pool = []
+    for i in range(n_pool):
+        target = int(rng.integers(55, 110))
+        parts = [
+            f"Progress note {i}: patient with "
+            f"{_POOL_CONDITIONS[rng.integers(0, len(_POOL_CONDITIONS))]} "
+            f"reviewed in clinic."
+        ]
+        n_words = len(parts[0].split())
+        while n_words < target:
+            sent = (
+                f"Current therapy includes "
+                f"{_POOL_DRUGS[rng.integers(0, len(_POOL_DRUGS))]} with "
+                f"{_POOL_FINDINGS[rng.integers(0, len(_POOL_FINDINGS))]}; "
+                f"plan is to "
+                f"{_POOL_PLANS[rng.integers(0, len(_POOL_PLANS))]}."
+            )
+            parts.append(sent)
+            n_words += len(sent.split())
+        pool.append(" ".join(parts))
+    return pool
+
+
 def dispatch_health(tag: str) -> None:
     """Record the dispatch+sync median under DETAILS["dispatch_ms"].
 
@@ -146,12 +217,14 @@ def _device_backend_alive(timeout_s: float = 150.0) -> bool:
 
 
 def _device_backend_alive_retrying(
-    attempts: int = 4, probe_timeout_s: float = 150.0, backoff_s: float = 60.0
+    attempts: int = 2, probe_timeout_s: float = 120.0, backoff_s: float = 45.0
 ) -> bool:
     """Bounded retry/backoff around the probe: a transient tunnel outage at
     bench start must not forfeit the whole round to a CPU smoke run (it did,
-    twice).  Budget: ~4 probes over ~13 min — small next to the bench window,
-    large next to a tunnel blip."""
+    twice).  Budget: ~2 probes over ~4.5 min worst case — the r04 lesson
+    cut this from ~13 min: every pre-headline minute is driver-window
+    risk (the r04 driver artifact was a timeout with the headline already
+    measured but unprinted)."""
     for i in range(attempts):
         if _device_backend_alive(probe_timeout_s):
             if i:
@@ -166,7 +239,7 @@ def _device_backend_alive_retrying(
     return False
 
 
-def _start_stall_watchdog(stall_min: float = 30.0) -> None:
+def _start_stall_watchdog(stall_min: float = 10.0) -> None:
     """Abort (exit 3) if NO section lands a measurement for ``stall_min``
     minutes.
 
@@ -264,7 +337,10 @@ def _run_with_fallback() -> int:
         t.join(timeout=30)
         return got_json[0]
 
-    budget = float(os.environ.get("DOCQA_BENCH_OUTER_BUDGET_S", "5400"))
+    # outer kill-switch: if the real child has not printed the headline by
+    # this point, kill it and smoke-rerun — total worst case (1200 s +
+    # ~480 s smoke) stays inside the ~30 min driver window r04 ran out of
+    budget = float(os.environ.get("DOCQA_BENCH_OUTER_BUDGET_S", "1200"))
     if run_child({}, budget):
         return 0
     log("bench run produced no headline — rerunning as forced-CPU smoke")
@@ -280,7 +356,7 @@ def _run_with_fallback() -> int:
         except OSError as e:
             log(f"could not preserve partial details: {e!r}")
     if run_child(
-        {"DOCQA_BENCH_FORCE_CPU": "1", "DOCQA_BENCH_SMALL": "1"}, 1800.0
+        {"DOCQA_BENCH_FORCE_CPU": "1", "DOCQA_BENCH_SMALL": "1"}, 600.0
     ):
         return 0
     log("smoke fallback also failed to produce a headline")
@@ -321,6 +397,17 @@ def _bench_lock(max_wait_s: float = 3600.0) -> None:
 def main() -> None:
     _bench_lock()
     _start_stall_watchdog()
+    T0 = time.monotonic()
+    # Wall-clock budget for the whole inner run.  The headline path is NOT
+    # gated (it must always print); every post-headline section is, so the
+    # process exits cleanly inside the driver window no matter what —
+    # r04's driver artifact was rc=124/parsed:null with the headline
+    # measured but unprinted, which this ordering makes impossible.
+    budget_s = float(os.environ.get("DOCQA_BENCH_BUDGET_S", "1050"))
+
+    def remaining() -> float:
+        return budget_s - (time.monotonic() - T0)
+
     force_cpu = os.environ.get("DOCQA_BENCH_FORCE_CPU") == "1"
     if force_cpu or not _device_backend_alive_retrying():
         # degrade honestly: a CPU smoke run labeled as such beats a hang
@@ -334,6 +421,8 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    import dataclasses
 
     import jax
 
@@ -353,13 +442,14 @@ def main() -> None:
     from docqa_tpu.engines.generate import GenerateEngine
     from docqa_tpu.index.store import VectorStore
     from docqa_tpu.runtime.mesh import make_mesh
+    from docqa_tpu.text.tokenizer import default_tokenizer
 
     n_chunks = 20_000 if small else 1_000_000
     max_new = 16 if small else 64
     n_queries = 5 if small else 20
     # 7B e2e sample count: 5-sample p50s swung 445-683 ms run to run on
-    # the tunnel; 15 asks cost ~7 s per spec_k and cut that spread
-    n_e2e_7b = min(15, n_queries)
+    # the tunnel; 15 asks cost ~10 s per engine and cut that spread
+    n_e2e = 5 if small else 15
     dec_cfg = (
         DecoderConfig()  # smoke size
         if small
@@ -374,25 +464,42 @@ def main() -> None:
             max_seq_len=4096,
         )
     )
+    cfg7 = DecoderConfig.mistral_7b()
 
     mesh = make_mesh() if jax.device_count() > 1 else None
     DETAILS["backend"] = backend
     DETAILS["n_chunks"] = n_chunks
-    # sections that are slow and NOT headline-critical (long compiles,
-    # training) run after the summary line is already printed, so a
-    # driver-side timeout cannot cost the round its headline
-    late_sections = []
+    DETAILS["budget_s"] = budget_s
 
-    # ---- corpus: 1M clustered chunks, HBM-resident -------------------------
+    # ---- corpus: 1M clustered chunks with REALISTIC texts, HBM-resident ----
     rng = np.random.default_rng(0)
     dim = 384
     centers = make_centers(rng, 2000, dim)
+    W = 128  # token sidecar width (+512 MB at 1M rows)
+    # chunk texts + sidecar tokens cycle through a realistic pool so the
+    # fused and classic ask paths carry EQUAL context (VERDICT r4 item 6)
+    pool_texts = make_chunk_pool(
+        np.random.default_rng(7), 1024 if small else 4096
+    )
+    gen_vocab = dec_cfg.vocab_size if small else cfg7.vocab_size
+    gen_tok = default_tokenizer(gen_vocab)
+    n_pool = len(pool_texts)
+    pool_tok = np.zeros((n_pool, W), np.int32)
+    pool_len = np.zeros((n_pool,), np.int32)
+    for i, t in enumerate(pool_texts):
+        ids = gen_tok.encode(t, add_specials=False)[:W]
+        pool_tok[i, : len(ids)] = ids
+        pool_len[i] = len(ids)
+    DETAILS["chunk_pool"] = {
+        "n": n_pool,
+        "token_len_mean": round(float(pool_len.mean()), 1),
+        "token_len_min": int(pool_len.min()),
+        "token_len_max": int(pool_len.max()),
+    }
 
     encoder = EncoderEngine(EncoderConfig(), mesh=mesh)
-    # token_width: per-row generator tokens in HBM (+512 MB at 1M rows)
-    # feed the single-sync fused RAG path measured as qa_e2e*_fused
     store = VectorStore(
-        StoreConfig(shard_capacity=max(n_chunks, 16384), token_width=128),
+        StoreConfig(shard_capacity=max(n_chunks, 16384), token_width=W),
         mesh=mesh,
     )
     t0 = time.perf_counter()
@@ -400,28 +507,29 @@ def main() -> None:
     for start in range(0, n_chunks, block):
         n = min(block, n_chunks - start)
         vecs = clustered_vectors(rng, n, dim, centers)
-        tok_lens = rng.integers(60, 128, n).astype(np.int32)
-        tok_rows = rng.integers(5, 30_000, (n, 128)).astype(np.int32)
-        tok_rows[np.arange(128)[None, :] >= tok_lens[:, None]] = 0
+        idx = np.arange(start, start + n) % n_pool
         store.add(
             vecs,
             [
-                {"doc_id": f"d{i}", "source": f"chunk {i}", "type": "kb"}
+                {
+                    "doc_id": f"d{i}",
+                    "source": f"chunk {i}",
+                    "text_content": pool_texts[i % n_pool],
+                    "type": "kb",
+                }
                 for i in range(start, start + n)
             ],
-            token_rows=tok_rows,
-            token_lens=tok_lens,
+            token_rows=pool_tok[idx],
+            token_lens=pool_len[idx],
         )
         # watchdog breadcrumb: each ~200 MB block transfer is progress
         DETAILS["ingest_rows"] = start + n
     log(f"corpus: {n_chunks} chunks ingested in {time.perf_counter()-t0:.1f}s")
     dispatch_health("after_corpus")
 
-    gen = GenerateEngine(dec_cfg, mesh=mesh)
-
     # ---- config 1: retrieval (encode + exact top-k at 1M) -------------------
     q_texts = [
-        f"What formula treats syndrome {i} with highest score and why?"
+        f"What therapy best controls condition {i} and at what dose?"
         for i in range(n_queries + 2)
     ]
     from docqa_tpu.engines.retrieve import FusedRetriever
@@ -434,9 +542,7 @@ def main() -> None:
     retriever.search_texts([q_texts[0]], k=10)
     t_enc, _ = timed(lambda: encoder.encode_texts([q_texts[1]]), n=5)
     t_search, _ = timed(lambda: store.search(emb0, k=10), n=5)
-    t_fused, _ = timed(
-        lambda: retriever.search_texts([q_texts[1]], k=10), n=5
-    )
+    t_fused, _ = timed(lambda: retriever.search_texts([q_texts[1]], k=10), n=5)
     DETAILS["retrieval"] = {
         "encode_ms": round(t_enc * 1e3, 2),
         "exact_top10_ms": round(t_search * 1e3, 2),
@@ -449,86 +555,13 @@ def main() -> None:
     )
     flush_details()
 
-    # ---- IVF / tiered: recall@10 + latency vs exact -------------------------
-    try:
-        from docqa_tpu.index.tiered import TieredIndex
-
-        tiered = TieredIndex(
-            store,
-            nprobe=32,
-            min_rows=10_000,
-            rebuild_tail_rows=10 * n_chunks,  # no background churn mid-bench
-            n_clusters=None if small else 1000,
-        )
-        t0 = time.perf_counter()
-        tiered.rebuild()
-        t_build = time.perf_counter() - t0
-        probes = clustered_vectors(rng, 20, dim, centers)
-        exact_res = store.search(probes, k=10)
-        tiered.search(probes, k=10)  # compile at the TIMED batch shape
-        t_tier, tier_res = timed(lambda: tiered.search(probes, k=10))
-        hits = total = 0
-        for e_row, a_row in zip(exact_res, tier_res):
-            want = {r.row_id for r in e_row}
-            hits += len(want & {r.row_id for r in a_row})
-            total += len(want)
-        t_exact20, _ = timed(lambda: store.search(probes, k=10))
-        # batch-1 is IVF's regime: a single query probes nprobe*cap rows
-        # (~3% of the corpus) while exact must stream every row; at batch-20
-        # the exact matmul amortizes its one corpus read over all queries
-        # and wins — both numbers are reported so the crossover is explicit
-        one = probes[:1]
-        store.search(one, k=10)
-        tiered.search(one, k=10)  # compile batch-1 shapes
-        t_tier1, _ = timed(lambda: tiered.search(one, k=10), n=5)
-        t_exact1, _ = timed(lambda: store.search(one, k=10), n=5)
-        # the ONE-dispatch text->tiered program serving uses when
-        # serving_index="tiered" (encode + IVF probe + tail in one XLA
-        # program) — measured against the fused-exact number in
-        # DETAILS["retrieval"] so the serving-policy crossover table in
-        # docs/PERF.md §4 can be filled from one artifact
-        from docqa_tpu.engines.retrieve import FusedTieredRetriever
-
-        ft = FusedTieredRetriever(encoder, tiered)
-        ft.search_texts([q_texts[0]], k=10)  # compile
-        t_ftier, _ = timed(
-            lambda: ft.search_texts([q_texts[1]], k=10), n=5
-        )
-        DETAILS["ivf"] = {
-            "recall_at_10": round(hits / max(total, 1), 4),
-            "build_s": round(t_build, 1),
-            "tiered_batch20_ms": round(t_tier * 1e3, 2),
-            "exact_batch20_ms": round(t_exact20 * 1e3, 2),
-            "tiered_batch1_ms": round(t_tier1 * 1e3, 2),
-            "exact_batch1_ms": round(t_exact1 * 1e3, 2),
-            "fused_tiered_query_ms": round(t_ftier * 1e3, 2),
-        }
-        del ft
-        log(
-            f"ivf: recall@10 {hits/max(total,1):.3f}, build {t_build:.1f}s, "
-            f"batch-20 tiered {t_tier*1e3:.1f}ms vs exact "
-            f"{t_exact20*1e3:.1f}ms; batch-1 tiered {t_tier1*1e3:.1f}ms "
-            f"vs exact {t_exact1*1e3:.1f}ms"
-        )
-        del tiered
-        gc.collect()
-    except Exception as e:  # keep the headline alive
-        log(f"ivf bench failed: {e!r}")
-        DETAILS["ivf"] = {"error": repr(e)}
-    flush_details()
-
-    # ---- headline: e2e QA latency (solo requests) ---------------------------
-    # The serving default is int8 weight-only (w8a16, models/quant.py):
-    # decode is HBM-bandwidth bound, so halving the weight bytes read per
-    # step is the single biggest latency lever, and the scheme's worst-case
-    # relative weight error (<=1/254 per channel) is quality-neutral at
-    # serving precision.  The bf16 engine is measured alongside for
-    # comparability with round 1.
+    # ---- shared measurement helpers -----------------------------------------
     def make_ask(engine):
         def ask(q: str) -> None:
             hits = retriever.search_texts([q], k=3)[0]
-            ctx = "\n".join(
-                f"[{h.metadata['doc_id']}] {h.metadata['source']}" for h in hits
+            ctx = "\n\n".join(
+                h.metadata.get("text_content") or h.metadata["source"]
+                for h in hits
             )
             prompt = f"Context:\n{ctx}\n\nQuestion: {q}\nAnswer:"
             engine.generate_texts([prompt], max_new_tokens=max_new)
@@ -569,46 +602,19 @@ def main() -> None:
             + (f", HBM util {hbm_util:.0%}" if hbm_util else "")
         )
 
-    # bf16 companion numbers (round-1 comparability)
-    p50_bf16, p95_bf16 = measure_e2e(gen, q_texts[2:7], "bf16")
-    DETAILS["qa_e2e_bf16"] = {
-        "p50_ms": round(p50_bf16, 2),
-        "p95_ms": round(p95_bf16, 2),
-        "new_tokens": max_new,
-        "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}",
-    }
-    measure_decode(gen, "decode_1b", "config3a bf16")
-    del gen
-    gc.collect()
-
-    # the served engine: same architecture, int8 weights
-    import dataclasses
-
-    gen = GenerateEngine(
-        dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
-    )
-    dispatch_health("before_headline")
-    p50, p95 = measure_e2e(gen, q_texts[2:], "headline (int8 serving)")
-    DETAILS["qa_e2e"] = {
-        "p50_ms": round(p50, 2),
-        "p95_ms": round(p95, 2),
-        "new_tokens": max_new,
-        "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8",
-    }
-    DETAILS["headline_config"] = "qa_e2e"  # upgraded to 7B-int8 below
-    measure_decode(gen, "decode_1b_int8", "config3a int8")
-
-    # fused single-sync ask (engines/rag_fused.py): retrieval -> device-
-    # side prompt pack -> decode, chained with no intermediate fetch —
-    # the classic path above pays one extra sync for the chunk texts
     def measure_fused(engine, tag):
+        # single-sync ask: retrieval -> device-side prompt pack -> decode
+        # chained with no intermediate fetch (engines/rag_fused.py); the
+        # classic path above pays one extra sync for the chunk texts.
+        # Context is EQUAL on both paths now: the sidecar holds the same
+        # pool tokens the classic path reads as text_content.
         from docqa_tpu.engines.rag_fused import FusedRAG
         from docqa_tpu.service.qa import QA_TEMPLATE
 
         rag = FusedRAG(encoder, store, engine, QA_TEMPLATE, k=3)
         rag.ask(q_texts[0], max_new_tokens=max_new)  # compile
         lats = []
-        for q in q_texts[2 : 2 + n_queries]:
+        for q in q_texts[2 : 2 + n_e2e]:
             t0 = time.perf_counter()
             rag.ask(q, max_new_tokens=max_new)
             lats.append((time.perf_counter() - t0) * 1e3)
@@ -622,20 +628,120 @@ def main() -> None:
         log(f"{tag}: p50 {p50f:.1f}ms p95 {p95f:.1f}ms")
         return p50f, p95f
 
-    try:
-        measure_fused(gen, "qa_e2e_fused")
-    except Exception as e:
-        log(f"fused e2e failed: {e!r}")
-        DETAILS["qa_e2e_fused"] = {"error": repr(e)[:300]}
-    flush_details()
+    # ---- HEADLINE: e2e QA latency, measured FIRST, printed IMMEDIATELY ------
+    # Serving default is int8 weight-only (w8a16, models/quant.py): decode
+    # is HBM-bandwidth bound, so halving the weight bytes read per step is
+    # the biggest latency lever.  The 7B class (BASELINE config 3's model
+    # class) is the headline; speculation k=8 was the measured winner of
+    # the r04 sweep (573 ms vs 617 at k=4, 1007 at k=0) — the k=4
+    # comparator re-measures post-headline.
+    S: dict = {"gen8": None, "params8": None, "gen1": None}
+    p50 = p95 = None
+    if not small:
+        try:
+            from docqa_tpu.models.quant import init_quantized_decoder_params
 
-    # ---- config 5: sustained QPS through the continuous batcher -------------
+            HEAD_SPEC_K = 8
+            # HOST init: the device-side jax.random init sequence leaves
+            # the tunneled client in its degraded mode (docs/PERF.md §1,
+            # ~70 ms on EVERY later dispatch) and everything measured in
+            # this process runs after this point.
+            S["params8"] = init_quantized_decoder_params(
+                jax.random.PRNGKey(0), cfg7, host_init=True, host_seed=0
+            )
+            S["gen8"] = GenerateEngine(
+                cfg7,
+                GenerateConfig(
+                    max_new_tokens=64,
+                    prefill_buckets=(512, 1024),
+                    speculative_k=HEAD_SPEC_K,
+                ),
+                params=S["params8"],
+            )
+            dispatch_health("before_headline")
+            p50, p95 = measure_e2e(
+                S["gen8"],
+                q_texts[2 : 2 + n_e2e],
+                f"HEADLINE 7B-int8 spec_k={HEAD_SPEC_K}",
+            )
+            DETAILS["qa_e2e_7b_int8"] = {
+                "p50_ms": round(p50, 2),
+                "p95_ms": round(p95, 2),
+                "new_tokens": max_new,
+                "decoder": "mistral-7b-class-int8",
+                "speculative_k": HEAD_SPEC_K,
+                "context": "3 x 60-120-token chunks (realistic pool)",
+                "attempts": [
+                    {
+                        "speculative_k": HEAD_SPEC_K,
+                        "p50_ms": round(p50, 2),
+                        "p95_ms": round(p95, 2),
+                    }
+                ],
+            }
+            DETAILS["headline_config"] = "qa_e2e_7b_int8"
+        except Exception as e:
+            log(f"7B headline failed, falling back to 1.1B-int8: {e!r}")
+            DETAILS["qa_e2e_7b_int8"] = {"error": repr(e)[:500]}
+            S["gen8"] = S["params8"] = None
+            gc.collect()
+    if p50 is None:
+        # small mode, or the 7B path failed: the 1.1B-int8 serving class
+        S["gen1"] = GenerateEngine(
+            dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+        )
+        p50, p95 = measure_e2e(
+            S["gen1"], q_texts[2 : 2 + n_e2e], "headline (1.1B/smoke int8)"
+        )
+        DETAILS["qa_e2e"] = {
+            "p50_ms": round(p50, 2),
+            "p95_ms": round(p95, 2),
+            "new_tokens": max_new,
+            "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8",
+        }
+        DETAILS["headline_config"] = "qa_e2e"
+
+    # ---- EMIT THE ONE LINE (before everything else) -------------------------
+    # A CPU fallback run must be UNMISTAKABLE in the one line the driver
+    # parses: distinct metric name AND an explicit degraded flag.
+    degraded = not on_tpu
+    DETAILS["degraded"] = degraded
+    DETAILS["headline_printed_at_s"] = round(time.monotonic() - T0, 1)
+    flush_details()
+    summary = {
+        "metric": "qa_e2e_p50_ms" + ("_cpu_smoke" if degraded else ""),
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(1000.0 / p50, 3),
+    }
+    if degraded:
+        summary["degraded"] = True
+    print(json.dumps(summary), flush=True)
+    log(f"headline printed at +{DETAILS['headline_printed_at_s']}s")
+
+    # ---- post-headline sections, each budget-gated --------------------------
+    def run_section(name: str, fn, need_s: float = 90.0) -> bool:
+        if remaining() < need_s:
+            DETAILS.setdefault("skipped", {})[name] = (
+                f"budget: {remaining():.0f}s left, need ~{need_s:.0f}s"
+            )
+            log(f"SKIP {name}: {DETAILS['skipped'][name]}")
+            flush_details()
+            return False
+        log(f"section {name} (budget left {remaining():.0f}s)")
+        try:
+            fn()
+        except Exception as e:
+            log(f"section {name} failed: {e!r}")
+            DETAILS.setdefault("section_errors", {})[name] = repr(e)[:300]
+        flush_details()
+        return True
+
+    # ---- load harnesses ------------------------------------------------------
     def run_load(engine, n_slots, chunk, n_req, cache_len):
-        """One load measurement: n_req concurrent requests, max_new tokens
-        each, through a ContinuousBatcher with the given knobs.  Returns
-        (qps, wall_s, lat_ms) where lat_ms are per-request completion
-        latencies (submit→done, measured by waiter threads so slow early
-        results don't distort later ones)."""
+        """Closed-loop load: n_req concurrent requests, max_new tokens
+        each, through a ContinuousBatcher.  Returns (qps, wall_s, lat_ms)
+        where lat_ms are submit->done completion latencies."""
         import threading as _threading
 
         from docqa_tpu.engines.serve import ContinuousBatcher
@@ -647,9 +753,6 @@ def main() -> None:
             prompt_ids = [
                 [7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(n_req)
             ]
-            # warm: compile the batched admission prefill at the shapes the
-            # loaded rounds hit (full-slot rounds) plus trickle shapes, and
-            # the slot decode program
             for h in [
                 b.submit_ids(p, max_new_tokens=4) for p in prompt_ids[:n_slots]
             ]:
@@ -672,20 +775,15 @@ def main() -> None:
                 w.join()
             wall = time.perf_counter() - t0
         finally:
-            # stop on EVERY path: a leaked batcher thread holds the engine
             b.stop()
             del b
             gc.collect()
         return n_req / wall, wall, lat_ms
 
     def sweep_load(engine, n_req, cache_len, grid):
-        """A REAL knob grid (VERDICT r3 item 2): measure every (n_slots,
-        chunk) combo in ``grid`` — slots and chunk trade per-request latency
-        for aggregate throughput, and the served config should be the
-        measured winner, not a guess.  Stops early only once the target is
-        comfortably beaten (QPS ≥ 20: past that the remaining bench budget
-        buys more than another grid point does).  Returns the rag_load
-        DETAILS dict; the speculative_k stage runs at the winner after."""
+        """Closed-loop knob grid over (n_slots, chunk); the served config
+        should be the measured winner, not a guess.  Stops early once the
+        target is comfortably beaten (QPS >= 20)."""
         attempts = []
         qps, wall, lat = run_load(engine, *grid[0], n_req, cache_len)
         attempts.append(
@@ -706,90 +804,320 @@ def main() -> None:
                 )
                 if q2 > qps:
                     qps, wall, lat = q2, w2, l2
-        best = max(
-            (a for a in attempts if "qps" in a), key=lambda a: a["qps"]
-        )
+        best = max((a for a in attempts if "qps" in a), key=lambda a: a["qps"])
         return {
+            "arrival": "closed-loop burst",
             "requests": n_req,
             "wall_s": round(wall, 2),
             "sustained_qps": round(qps, 2),
             "qps_target": 16,
-            # BASELINE config 5 asks for per-request latency under load,
-            # not just aggregate QPS (winner's distribution)
             "request_p50_ms": round(float(np.percentile(lat, 50)), 1),
             "request_p95_ms": round(float(np.percentile(lat, 95)), 1),
             "best_knobs": {"n_slots": best["n_slots"], "chunk": best["chunk"]},
             "attempts": attempts,
         }
 
-    try:
+    def run_open_loop(engine, n_slots, chunk, cache_len, qps_target, n_req):
+        """OPEN-loop load (VERDICT r4 item 3): requests arrive on a fixed
+        schedule at exactly ``qps_target``, with RAG-realistic prompt
+        lengths (template + 3 pool chunks + question, ~300 tokens).
+        Latency is measured from each request's SCHEDULED arrival, so
+        queueing delay counts — this is the latency-under-target-load
+        number BASELINE's metric names.  Queue depth is sampled at 20 Hz."""
+        import threading as _threading
+
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        rngp = np.random.default_rng(3)
+        prompts = []
+        for i in range(n_req + n_slots):
+            parts = [5, 9, 11]
+            for j in rngp.integers(0, n_pool, 3):
+                row = pool_tok[int(j)][: int(pool_len[int(j)])]
+                parts.extend(int(t) for t in row)
+            parts.extend((7 + i % 13, 3 + i % 7))
+            prompts.append(parts)
+        b = ContinuousBatcher(
+            engine, n_slots=n_slots, chunk=chunk, cache_len=cache_len
+        )
+        try:
+            for h in [
+                b.submit_ids(p, max_new_tokens=4) for p in prompts[:n_slots]
+            ]:
+                h.result()
+            b.submit_ids(prompts[0], max_new_tokens=max_new).result()
+            lat_ms = [0.0] * n_req
+            qdepth: list = []
+            done_evt = _threading.Event()
+
+            def sampler():
+                while not done_evt.is_set():
+                    qdepth.append(b.n_queued)
+                    time.sleep(0.05)
+
+            smp = _threading.Thread(target=sampler, daemon=True)
+            smp.start()
+            waiters = []
+            t0 = time.perf_counter()
+
+            def wait_one(idx, handle, sched):
+                handle.result()
+                lat_ms[idx] = (time.perf_counter() - sched) * 1e3
+
+            for i in range(n_req):
+                sched = t0 + i / qps_target
+                now = time.perf_counter()
+                if sched > now:
+                    time.sleep(sched - now)
+                h = b.submit_ids(
+                    prompts[n_slots + i], max_new_tokens=max_new
+                )
+                w = _threading.Thread(target=wait_one, args=(i, h, sched))
+                w.start()
+                waiters.append(w)
+            for w in waiters:
+                w.join()
+            wall = time.perf_counter() - t0
+            done_evt.set()
+            smp.join(timeout=2)
+        finally:
+            b.stop()
+            del b
+            gc.collect()
+        return {
+            "arrival": f"open@{qps_target}",
+            "requests": n_req,
+            "wall_s": round(wall, 2),
+            "achieved_qps": round(n_req / wall, 2),
+            "request_p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+            "request_p95_ms": round(float(np.percentile(lat_ms, 95)), 1),
+            "queue_depth_max": int(max(qdepth)) if qdepth else 0,
+            "queue_depth_mean": (
+                round(float(np.mean(qdepth)), 1) if qdepth else 0.0
+            ),
+            "prompt_tokens": "~300 (template + 3 pool chunks)",
+            "n_slots": n_slots,
+            "chunk": chunk,
+        }
+
+    late_sections = []
+
+    # ---- 7B sections (params live from the headline) ------------------------
+    if S["gen8"] is not None:
+
+        def sec_decode_7b():
+            # decode tok/s; the engine's smallest prefill bucket is 512
+            # (the realistic-prompt shape), so the number includes one
+            # 512-token prefill — noted, and conservative by ~5%
+            measure_decode(S["gen8"], "decode_7b_int8", "config3c 7B int8")
+            DETAILS["decode_7b_int8"]["includes_prefill"] = 512
+
+        def sec_spec4():
+            eng = GenerateEngine(
+                cfg7,
+                GenerateConfig(
+                    max_new_tokens=64,
+                    prefill_buckets=(512, 1024),
+                    speculative_k=4,
+                ),
+                params=S["params8"],
+            )
+            try:
+                p50b, p95b = measure_e2e(
+                    eng, q_texts[2 : 2 + n_e2e], "7B-int8 spec_k=4"
+                )
+            finally:
+                del eng
+                gc.collect()
+            DETAILS["qa_e2e_7b_int8"]["attempts"].append(
+                {
+                    "speculative_k": 4,
+                    "p50_ms": round(p50b, 2),
+                    "p95_ms": round(p95b, 2),
+                }
+            )
+
+        def sec_fused_7b():
+            p50f, _ = measure_fused(S["gen8"], "qa_e2e_7b_int8_fused")
+            DETAILS["fused_ab_7b"] = {
+                "classic_p50_ms": DETAILS["qa_e2e_7b_int8"]["p50_ms"],
+                "fused_p50_ms": round(p50f, 2),
+                "context": "EQUAL both paths: 3 x 60-120-token pool chunks",
+                "speculative_k": DETAILS["qa_e2e_7b_int8"]["speculative_k"],
+            }
+
+        def sec_load_7b():
+            from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY as _REG
+
+            hist = _REG.histogram("serve_tokens_per_chunk")
+            count0 = hist.count
+            sum0 = (hist.mean * count0) if count0 else 0.0
+            load_engine = GenerateEngine(
+                cfg7,
+                GenerateConfig(
+                    max_new_tokens=64,
+                    prefill_buckets=(128, 512),
+                    speculative_k=8,
+                ),
+                params=S["params8"],
+            )
+            try:
+                # closed-loop grid, widened per VERDICT r4 item 3:
+                # (32,32) was the r04 winner; 48-slot points probe whether
+                # more lanes per weight-read push past the 9.3 plateau
+                DETAILS["rag_load_7b_int8"] = sweep_load(
+                    load_engine, 64, 512,
+                    ((32, 32), (48, 32), (32, 16), (48, 16)),
+                )
+                DETAILS["rag_load_7b_int8"]["speculative_k"] = 8
+                d_count = hist.count - count0
+                DETAILS["rag_load_7b_int8"]["serve_tokens_per_chunk_mean"] = (
+                    round((hist.mean * hist.count - sum0) / d_count, 2)
+                    if d_count > 0
+                    else None
+                )
+                log(f"config5b 7B-int8 closed load: {DETAILS['rag_load_7b_int8']}")
+                bk = DETAILS["rag_load_7b_int8"]["best_knobs"]
+                if remaining() > 180:
+                    DETAILS["rag_load_7b_open16"] = run_open_loop(
+                        load_engine, bk["n_slots"], bk["chunk"], 1024,
+                        qps_target=16, n_req=96,
+                    )
+                    log(
+                        f"config5b 7B-int8 OPEN loop @16: "
+                        f"{DETAILS['rag_load_7b_open16']}"
+                    )
+                else:
+                    DETAILS.setdefault("skipped", {})["load_7b_open16"] = (
+                        f"budget: {remaining():.0f}s left"
+                    )
+            finally:
+                del load_engine
+                gc.collect()
+
+        run_section("decode_7b_int8", sec_decode_7b, 90)
+        run_section("e2e_7b_fused", sec_fused_7b, 150)
+        run_section("e2e_7b_spec4", sec_spec4, 150)
+        run_section("load_7b", sec_load_7b, 300)
+        dispatch_health("after_7b_sections")
+        # free the 7B tree before the 1.1B / IVF / bf16 sections need HBM
+        S["gen8"] = S["params8"] = None
+        gc.collect()
+
+    # ---- 1.1B class (round-over-round comparability) ------------------------
+    def sec_1b():
+        gen_bf = GenerateEngine(dec_cfg, mesh=mesh)
+        p50b, p95b = measure_e2e(gen_bf, q_texts[2:7], "1.1B bf16")
+        DETAILS["qa_e2e_bf16"] = {
+            "p50_ms": round(p50b, 2),
+            "p95_ms": round(p95b, 2),
+            "new_tokens": max_new,
+            "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}",
+        }
+        measure_decode(gen_bf, "decode_1b", "config3a bf16")
+        del gen_bf
+        gc.collect()
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        if "qa_e2e" not in DETAILS:
+            p50i, p95i = measure_e2e(
+                S["gen1"], q_texts[2 : 2 + n_e2e], "1.1B int8"
+            )
+            DETAILS["qa_e2e"] = {
+                "p50_ms": round(p50i, 2),
+                "p95_ms": round(p95i, 2),
+                "new_tokens": max_new,
+                "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8",
+            }
+        measure_decode(S["gen1"], "decode_1b_int8", "config3a int8")
+        measure_fused(S["gen1"], "qa_e2e_fused")
+
+    def sec_load_1b():
+        if S["gen1"] is None:  # e2e_1b skipped on budget
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        gen1 = S["gen1"]
         n_req = 64 if not small else 8
         cache_len = 1024 if not small else 256
-        # stage 1 of the grid: n_slots x chunk (16,32) first — the prior
-        # rounds' serving default — then the rest in rising-cost order
         DETAILS["rag_load"] = sweep_load(
-            gen,
-            n_req,
-            cache_len,
-            ((16, 32), (32, 32), (16, 64), (32, 64), (16, 16), (32, 16)),
+            gen1, n_req, cache_len, ((32, 16), (16, 16), (32, 32))
         )
         if not small and DETAILS["rag_load"]["sustained_qps"] < 20:
-            # stage 2 of the grid (VERDICT r2 item 2 / r3 item 2):
-            # speculative_k at the stage-1 winner — each batcher chunk
-            # verifies spec_k draft tokens per slot in one weight read,
-            # raising aggregate tokens/read.  Own try: a failure here must
-            # not wipe the measured sweep above.
+            # speculation at the winner: each batcher chunk verifies
+            # spec_k draft tokens per slot in one weight read
+            bk = DETAILS["rag_load"]["best_knobs"]
+            for spec_k in (4,):
+                gen_spec = GenerateEngine(
+                    dataclasses.replace(dec_cfg, quantize_weights=True),
+                    GenerateConfig(speculative_k=spec_k),
+                    mesh=mesh,
+                    params=gen1.params,
+                )
+                try:
+                    qs, ws, ls = run_load(
+                        gen_spec, bk["n_slots"], bk["chunk"], n_req, cache_len
+                    )
+                finally:
+                    del gen_spec
+                    gc.collect()
+                DETAILS["rag_load"]["attempts"].append(
+                    {**bk, "speculative_k": spec_k, "qps": round(qs, 2)}
+                )
+                if qs > DETAILS["rag_load"]["sustained_qps"]:
+                    DETAILS["rag_load"].update(
+                        sustained_qps=round(qs, 2),
+                        wall_s=round(ws, 2),
+                        request_p50_ms=round(float(np.percentile(ls, 50)), 1),
+                        request_p95_ms=round(float(np.percentile(ls, 95)), 1),
+                        best_knobs={**bk, "speculative_k": spec_k},
+                    )
+        log(f"config5 1.1B closed load: {DETAILS['rag_load']}")
+        if not small and remaining() > 150:
+            bk = DETAILS["rag_load"]["best_knobs"]
+            spec_k = bk.get("speculative_k", 0)
+            open_engine = (
+                GenerateEngine(
+                    dataclasses.replace(dec_cfg, quantize_weights=True),
+                    GenerateConfig(
+                        speculative_k=spec_k, prefill_buckets=(128, 512)
+                    ),
+                    mesh=mesh,
+                    params=gen1.params,
+                )
+                if spec_k
+                else gen1
+            )
             try:
-                bk = DETAILS["rag_load"]["best_knobs"]
-                for spec_k in (4, 8):
-                    gen_spec = GenerateEngine(
-                        dataclasses.replace(dec_cfg, quantize_weights=True),
-                        GenerateConfig(speculative_k=spec_k),
-                        mesh=mesh,
-                        params=gen.params,
-                    )
-                    try:
-                        qs, ws, ls = run_load(
-                            gen_spec, bk["n_slots"], bk["chunk"], n_req,
-                            cache_len,
-                        )
-                    finally:
-                        del gen_spec
-                        gc.collect()
-                    DETAILS["rag_load"]["attempts"].append(
-                        {**bk, "speculative_k": spec_k, "qps": round(qs, 2)}
-                    )
-                    if qs > DETAILS["rag_load"]["sustained_qps"]:
-                        DETAILS["rag_load"].update(
-                            sustained_qps=round(qs, 2),
-                            wall_s=round(ws, 2),
-                            request_p50_ms=round(
-                                float(np.percentile(ls, 50)), 1
-                            ),
-                            request_p95_ms=round(
-                                float(np.percentile(ls, 95)), 1
-                            ),
-                            best_knobs={**bk, "speculative_k": spec_k},
-                        )
-            except Exception as e:
-                log(f"config5 speculation attempt failed: {e!r}")
-                DETAILS["rag_load"]["speculation_error"] = repr(e)[:200]
-        log(f"config5 load: {DETAILS['rag_load']}")
-    except Exception as e:
-        log(f"qps bench failed: {e!r}")
-        DETAILS["rag_load"] = {"error": repr(e)}
-    flush_details()
+                DETAILS["rag_load_open16"] = run_open_loop(
+                    open_engine, bk["n_slots"], bk["chunk"], 1024,
+                    qps_target=16, n_req=96,
+                )
+                log(f"config5 1.1B OPEN loop @16: {DETAILS['rag_load_open16']}")
+            finally:
+                if open_engine is not gen1:
+                    del open_engine
+                    gc.collect()
+
+    run_section("e2e_1b", sec_1b, 240)
+    run_section("load_1b", sec_load_1b, 200)
 
     # ---- config 4: summarizer, 5 retrieved chunks ---------------------------
-    summ = None
-    try:
+    docs = [
+        (f"doc{i}", f"Patient note {i}: " + "stable vitals observed. " * 40)
+        for i in range(5)
+    ]
+
+    def sec_summarize():
         from docqa_tpu.engines.summarize import SummarizeEngine
 
-        summ = SummarizeEngine(gen, SummarizerConfig())
-        docs = [
-            (f"doc{i}", f"Patient note {i}: " + "stable vitals observed. " * 40)
-            for i in range(5)
-        ]
+        if S["gen1"] is None:  # e2e_1b skipped on budget
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        summ = SummarizeEngine(S["gen1"], SummarizerConfig())
         summ.summarize_patient("p1", docs, max_tokens=32 if small else 128)
         t_summ, _ = timed(
             lambda: summ.summarize_patient(
@@ -798,30 +1126,23 @@ def main() -> None:
         )
         DETAILS["summarize"] = {"five_chunk_ms": round(t_summ * 1e3, 1)}
         log(f"config4 summarize (5 chunks): {t_summ*1e3:.0f}ms")
-    except Exception as e:
-        log(f"summarize bench failed: {e!r}")
-        DETAILS["summarize"] = {"error": repr(e)}
+        del summ
+        gc.collect()
 
-    # ---- config 4b: the dedicated BART-class encoder-decoder backend --------
-    # (the architecture BASELINE config 4 actually names; bart-large-cnn
-    # shape, ~0.8 GB bf16 — raw-source summarization, no instruction prompt)
-    try:
+    def sec_seq2seq():
+        # config 4b: the dedicated BART-class encoder-decoder backend
+        # (the architecture BASELINE config 4 actually names; greedy for
+        # the timed run — the beam-4 program compiles for minutes at
+        # bart-large depth and runs late)
         from docqa_tpu.config import Seq2SeqConfig
         from docqa_tpu.engines.seq2seq import Seq2SeqEngine
+        from docqa_tpu.engines.summarize import SummarizeEngine
 
-        import dataclasses as _dc
-
-        # greedy for the timed run: the beam-4 program XLA-compiles for
-        # minutes at bart-large depth on this host and measures the same
-        # bandwidth-bound forward; beam decode is covered by tests
         s2s_cfg = (
             Seq2SeqConfig()
             if small
-            else _dc.replace(
+            else dataclasses.replace(
                 Seq2SeqConfig.bart_large_cnn(),
-                # route through the plain greedy program: the generation
-                # constraints all live in the beam program, whose compile
-                # at bart-large depth runs minutes on this host
                 num_beams=1,
                 min_length=0,
                 no_repeat_ngram=0,
@@ -849,19 +1170,16 @@ def main() -> None:
         del s2s, summ2
         gc.collect()
         if not small:
+
             def run_beam_late():
-                # beam-4 with the full generation constraints — BASELINE
-                # config 4 names bart-large-cnn whose published decode IS
-                # beam.  Deferred: the beam program's XLA compile at this
-                # depth is the risk (minutes), not its runtime — it must
-                # not sit between the driver and the headline.
+                # beam-4 with the full generation constraints — deferred:
+                # the beam program's XLA compile at bart-large depth is
+                # the risk (minutes), not its runtime
                 try:
                     s2s_beam = Seq2SeqEngine(Seq2SeqConfig.bart_large_cnn())
                     summ_b = SummarizeEngine(
                         s2s_beam,
-                        SummarizerConfig(
-                            max_input_tokens=s2s_cfg.max_src_len
-                        ),
+                        SummarizerConfig(max_input_tokens=s2s_cfg.max_src_len),
                         instruction_prompts=False,
                     )
                     t0 = time.perf_counter()
@@ -875,9 +1193,7 @@ def main() -> None:
                     DETAILS["summarize_seq2seq_beam"] = {
                         "five_chunk_ms": round(t_beam * 1e3, 1),
                         "compile_s": round(compile_s, 1),
-                        "num_beams": (
-                            Seq2SeqConfig.bart_large_cnn().num_beams
-                        ),
+                        "num_beams": Seq2SeqConfig.bart_large_cnn().num_beams,
                     }
                     log(
                         f"config4b beam summarize (5 chunks): "
@@ -885,34 +1201,28 @@ def main() -> None:
                     )
                 except Exception as e:
                     log(f"beam summarize bench failed: {e!r}")
-                    DETAILS["summarize_seq2seq_beam"] = {
-                        "error": repr(e)[:300]
-                    }
+                    DETAILS["summarize_seq2seq_beam"] = {"error": repr(e)[:300]}
 
-            late_sections.append(run_beam_late)
-    except Exception as e:
-        log(f"seq2seq summarize bench failed: {e!r}")
-        DETAILS["summarize_seq2seq"] = {"error": repr(e)[:300]}
-    flush_details()
+            late_sections.append(("summarize_beam", run_beam_late, 360))
+
+    run_section("summarize", sec_summarize, 90)
+    run_section("summarize_seq2seq", sec_seq2seq, 180)
 
     # ---- config 2: deid NER throughput, batch = 32 --------------------------
-    try:
+    _ner_cache = os.path.join(
+        os.path.expanduser("~"), ".cache", "docqa_tpu", "ner.npz"
+    )
+
+    def sec_deid():
         from docqa_tpu.deid.engine import DeidEngine
 
-        _ner_cache = os.path.join(
-            os.path.expanduser("~"), ".cache", "docqa_tpu", "ner.npz"
-        )
         if small:
             # random-init weights: identical FLOPs/memory to trained, and
             # the tagger architecture is what config 2 measures
             deid = DeidEngine(NERConfig(), use_ner_model=True)
         else:
-            # trained weights via the cache: realistic weights for the
-            # throughput number, reused by the late quality section and
-            # across bench reruns; load_or_train runs any needed training
-            # in a CHILD process so its minutes of step loops and sync
-            # churn never sit inside this process between the driver and
-            # the 7B headline
+            # trained weights via the cache; load_or_train runs any needed
+            # training in a CHILD process
             os.makedirs(os.path.dirname(_ner_cache), exist_ok=True)
             deid = DeidEngine.trained(NERConfig(), params_path=_ner_cache)
         docs32 = [
@@ -926,361 +1236,225 @@ def main() -> None:
             "batch32_ms": round(t_deid * 1e3, 1),
             "docs_per_s": round(32 / t_deid, 1),
         }
-        log(f"config2 deid: batch-32 in {t_deid*1e3:.0f}ms = {32/t_deid:.0f} docs/s")
+        log(
+            f"config2 deid: batch-32 in {t_deid*1e3:.0f}ms = "
+            f"{32/t_deid:.0f} docs/s"
+        )
         del deid
         gc.collect()
         if not small:
+
             def run_deid_quality_late():
-                # quality, not just speed: train the real tagger and
-                # score it on the HAND-WRITTEN eval set (deid/evalset.py
-                # — sentences disjoint from the training generator's
-                # templates, so this measures generalization, not
-                # memorization).  Deferred: training takes minutes and
-                # must not sit between the driver and the headline.
+                # quality, not just speed: score the trained tagger on the
+                # dev/test SPLIT evalset (deid/evalset.py) — the reported
+                # F1 comes from test spans never used to pick the served
+                # threshold (VERDICT r4 item 5).
                 try:
-                    from docqa_tpu.deid.evalset import evaluate_deid
+                    from docqa_tpu.deid.evalset import evaluate_deid_split
 
                     t0 = time.perf_counter()
                     deid_trained = DeidEngine.trained(
                         NERConfig(), params_path=_ner_cache
                     )
-                    ev = evaluate_deid(deid_trained)
-                    # record the headline quality numbers BEFORE the sweep:
-                    # a sweep failure must not discard minutes of training
-                    # plus a successful base eval
+                    ev = evaluate_deid_split(deid_trained)
                     DETAILS["deid"].update(
                         {
                             "train_s": round(time.perf_counter() - t0, 1),
-                            "f1": ev["entity_f1"],
-                            "char_f1": ev["char_f1"],
-                            "span_recall_any": ev["span_recall_any"],
+                            "f1": ev["test"]["entity_f1"],
+                            "char_f1": ev["test"]["char_f1"],
+                            "span_recall_any": ev["test"]["span_recall_any"],
                             "eval": ev,
                         }
                     )
-                    # the softmax acceptance threshold is a no-retrain
-                    # precision/recall lever; each eval is sub-second with
-                    # the tagger in memory, so sweep it and report the
-                    # operating curve alongside the served default
-                    th_sweep = {}
-                    served_th = deid_trained.ner_threshold
-                    try:
-                        for th in (0.3, 0.5, 0.65, 0.8, 0.9):
-                            deid_trained.ner_threshold = th
-                            e = evaluate_deid(deid_trained)
-                            th_sweep[str(th)] = {
-                                "entity_f1": e["entity_f1"],
-                                "char_f1": e["char_f1"],
-                            }
-                    except Exception as e:  # keep the base metrics
-                        th_sweep["error"] = repr(e)[:200]
-                    finally:
-                        deid_trained.ner_threshold = served_th
-                    DETAILS["deid"]["threshold_sweep"] = th_sweep
-                    log(
-                        f"config2 deid quality (handwritten eval): entity "
-                        f"F1 {ev['entity_f1']}, char F1 {ev['char_f1']}, "
-                        f"span recall {ev['span_recall_any']}"
-                    )
+                    log(f"config2 deid quality (dev/test split): {ev}")
                     del deid_trained
                     gc.collect()
                 except Exception as e:
                     log(f"deid quality eval failed: {e!r}")
                     DETAILS["deid"]["eval_error"] = repr(e)[:300]
 
-            late_sections.append(run_deid_quality_late)
-    except Exception as e:
-        log(f"deid bench failed: {e!r}")
-        DETAILS["deid"] = {"error": repr(e)}
-    flush_details()
+            late_sections.append(("deid_quality", run_deid_quality_late, 420))
 
-    # ---- configs 3c/5b/3b: Mistral-7B-class on one chip ---------------------
-    if not small:
-        # free the 1.1B engines — including `summ`, which holds one as
-        # .generator (a leaked ref here would make the 7B verdict measure
-        # under ~2 GB of false memory pressure).  The 1M store (~0.8 GB)
-        # STAYS resident: the headline configuration is 7B-int8 e2e over it
-        # (the model class BASELINE config 3 actually names).
-        summ = None  # noqa: F841
-        del gen
+    run_section("deid", sec_deid, 120)
+
+    # ---- IVF / tiered: recall@10 + latency vs exact -------------------------
+    def sec_ivf():
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+        from docqa_tpu.index.tiered import TieredIndex
+
+        tiered = TieredIndex(
+            store,
+            nprobe=32,
+            min_rows=10_000,
+            rebuild_tail_rows=10 * n_chunks,  # no background churn mid-bench
+            n_clusters=None if small else 1000,
+        )
+        t0 = time.perf_counter()
+        tiered.rebuild()
+        t_build = time.perf_counter() - t0
+        probes = clustered_vectors(rng, 20, dim, centers)
+        exact_res = store.search(probes, k=10)
+        tiered.search(probes, k=10)  # compile at the TIMED batch shape
+        t_tier, tier_res = timed(lambda: tiered.search(probes, k=10))
+        hits = total = 0
+        for e_row, a_row in zip(exact_res, tier_res):
+            want = {r.row_id for r in e_row}
+            hits += len(want & {r.row_id for r in a_row})
+            total += len(want)
+        t_exact20, _ = timed(lambda: store.search(probes, k=10))
+        one = probes[:1]
+        store.search(one, k=10)
+        tiered.search(one, k=10)  # compile batch-1 shapes
+        t_tier1, _ = timed(lambda: tiered.search(one, k=10), n=5)
+        t_exact1, _ = timed(lambda: store.search(one, k=10), n=5)
+        ft = FusedTieredRetriever(encoder, tiered)
+        ft.search_texts([q_texts[0]], k=10)  # compile
+        t_ftier, _ = timed(lambda: ft.search_texts([q_texts[1]], k=10), n=5)
+        DETAILS["ivf"] = {
+            "recall_at_10": round(hits / max(total, 1), 4),
+            "build_s": round(t_build, 1),
+            "tiered_batch20_ms": round(t_tier * 1e3, 2),
+            "exact_batch20_ms": round(t_exact20 * 1e3, 2),
+            "tiered_batch1_ms": round(t_tier1 * 1e3, 2),
+            "exact_batch1_ms": round(t_exact1 * 1e3, 2),
+            "fused_tiered_query_ms": round(t_ftier * 1e3, 2),
+        }
+        log(
+            f"ivf: recall@10 {hits/max(total,1):.3f}, build {t_build:.1f}s, "
+            f"batch-20 tiered {t_tier*1e3:.1f}ms vs exact "
+            f"{t_exact20*1e3:.1f}ms; batch-1 tiered {t_tier1*1e3:.1f}ms "
+            f"vs exact {t_exact1*1e3:.1f}ms"
+        )
+        del ft, tiered
         gc.collect()
 
-        # ---- config 3c: 7B int8 weights (w8a16) — the serving path that
-        # fits one v5e chip (~7.2 GB tree, half the bytes per decode step;
-        # models/quant.py)
-        try:
-            from docqa_tpu.models.quant import init_quantized_decoder_params
+    run_section("ivf", sec_ivf, 400 if not small else 90)
 
-            cfg7 = DecoderConfig.mistral_7b()
-            # HOST init deliberately: the device-side jax.random init
-            # sequence leaves the tunneled client in its degraded mode
-            # (docs/PERF.md §1, ~70 ms on EVERY later dispatch) and the
-            # headline e2e + 5b load both run after this point in this
-            # process.  The one-time cost is drawing + transferring the
-            # 7.2 GB tree — the decode-only bf16 attempt (config 3b, runs
-            # last) keeps device init because nothing measured after it.
-            params8 = init_quantized_decoder_params(
-                jax.random.PRNGKey(0), cfg7, host_init=True, host_seed=0
+    # ---- IVF crossover at 2M/4M rows (VERDICT r4 item 4) --------------------
+    # Vectors only (no sidecar), measured in the regime the bytes model
+    # says IVF should win.  Slow (ingest + build per scale) — runs only
+    # with a raised budget (in-session / DOCQA_BENCH_BUDGET_S override).
+    def sec_ivf_scale():
+        from docqa_tpu.index.tiered import TieredIndex
+
+        S["gen1"] = None
+        gc.collect()
+        out = {}
+        for target_n in (2_000_000, 4_000_000):
+            if remaining() < 900:
+                out[str(target_n)] = "skipped: budget"
+                break
+            big = VectorStore(
+                StoreConfig(shard_capacity=target_n), mesh=mesh
             )
-            pb8 = param_bytes(params8)
-            gen8 = GenerateEngine(
-                cfg7,
-                GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
-                params=params8,
+            rngb = np.random.default_rng(1)
+            t0 = time.perf_counter()
+            for start in range(0, target_n, block):
+                n = min(block, target_n - start)
+                big.add(
+                    clustered_vectors(rngb, n, dim, centers),
+                    [{"doc_id": f"s{i}"} for i in range(start, start + n)],
+                )
+                DETAILS["ivf_scale_ingest"] = f"{target_n}:{start + n}"
+            t_ing = time.perf_counter() - t0
+            tiered = TieredIndex(
+                big,
+                nprobe=32,
+                min_rows=10_000,
+                rebuild_tail_rows=10 * target_n,
+                n_clusters=int(np.sqrt(target_n)) * 2,
             )
-            gen8.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
-            t8, _ = timed(
-                lambda: gen8.generate_ids([[5, 9, 11]], max_new_tokens=64), n=3
-            )
-            tok8 = 64 / t8
-            util8 = tok8 * pb8 / (V5E_HBM_GBPS * 1e9) if on_tpu else None
-            DETAILS["decode_7b_int8"] = {
-                "tokens_per_s": round(tok8, 1),
-                "param_bytes_gb": round(pb8 / 1e9, 2),
-                "hbm_utilization": round(util8, 3) if util8 else None,
+            t0 = time.perf_counter()
+            tiered.rebuild()
+            t_build = time.perf_counter() - t0
+            probes = clustered_vectors(rngb, 20, dim, centers)
+            exact_res = big.search(probes, k=10)
+            tiered.search(probes, k=10)
+            t_t20, tier_res = timed(lambda: tiered.search(probes, k=10), n=3)
+            t_e20, _ = timed(lambda: big.search(probes, k=10), n=3)
+            one = probes[:1]
+            big.search(one, k=10)
+            tiered.search(one, k=10)
+            t_t1, _ = timed(lambda: tiered.search(one, k=10), n=5)
+            t_e1, _ = timed(lambda: big.search(one, k=10), n=5)
+            hits = total = 0
+            for e_row, a_row in zip(exact_res, tier_res):
+                want = {r.row_id for r in e_row}
+                hits += len(want & {r.row_id for r in a_row})
+                total += len(want)
+            out[str(target_n)] = {
+                "ingest_s": round(t_ing, 1),
+                "build_s": round(t_build, 1),
+                "recall_at_10": round(hits / max(total, 1), 4),
+                "tiered_batch1_ms": round(t_t1 * 1e3, 2),
+                "exact_batch1_ms": round(t_e1 * 1e3, 2),
+                "tiered_batch20_ms": round(t_t20 * 1e3, 2),
+                "exact_batch20_ms": round(t_e20 * 1e3, 2),
             }
-            log(
-                f"config3c Mistral-7B-class int8 ({pb8/1e9:.1f}GB): "
-                f"{tok8:.1f} tok/s"
-                + (f", HBM util {util8:.0%}" if util8 else "")
-            )
-
-            # ---- HEADLINE: 7B-int8 e2e QA over the 1M store, speculation
-            # swept.  Prompt-lookup speculation is output-exact (greedy
-            # match or it falls back), so the best speculative_k is purely
-            # a latency decision — measure, don't guess.
-            try:
-                e2e_attempts = []
-                best = None
-                for spec_k in (0, 4, 8):
-                    eng_k = (
-                        gen8
-                        if spec_k == 0
-                        else GenerateEngine(
-                            cfg7,
-                            GenerateConfig(
-                                max_new_tokens=64,
-                                prefill_buckets=(128,),
-                                speculative_k=spec_k,
-                            ),
-                            params=params8,
-                        )
-                    )
-                    try:
-                        p50k, p95k = measure_e2e(
-                            eng_k,
-                            q_texts[2 : 2 + n_e2e_7b],
-                            f"7B-int8 spec_k={spec_k}",
-                        )
-                    finally:
-                        # release on the error path too: a leaked spec
-                        # engine would hold the 7B tree and starve the
-                        # bf16 attempt below of HBM it needs
-                        if eng_k is not gen8:
-                            del eng_k
-                            gc.collect()
-                    e2e_attempts.append(
-                        {
-                            "speculative_k": spec_k,
-                            "p50_ms": round(p50k, 2),
-                            "p95_ms": round(p95k, 2),
-                        }
-                    )
-                    if best is None or p50k < best[1]:
-                        best = (spec_k, p50k, p95k)
-                DETAILS["qa_e2e_7b_int8"] = {
-                    "p50_ms": round(best[1], 2),
-                    "p95_ms": round(best[2], 2),
-                    "new_tokens": max_new,
-                    "decoder": "mistral-7b-class-int8",
-                    "speculative_k": best[0],
-                    "attempts": e2e_attempts,
-                }
-                # this is the number the summary line reports — the 1.1B
-                # figures above stay in DETAILS for round-over-round
-                # comparability
-                p50 = best[1]
-                DETAILS["headline_config"] = "qa_e2e_7b_int8"
-                log(
-                    f"HEADLINE 7B-int8 e2e: p50 {best[1]:.1f}ms "
-                    f"p95 {best[2]:.1f}ms (spec_k={best[0]})"
-                )
-                # fused single-sync variant at the winning spec_k — takes
-                # the headline only if its measured p50 actually wins
-                try:
-                    eng_f = GenerateEngine(
-                        cfg7,
-                        GenerateConfig(
-                            max_new_tokens=64,
-                            prefill_buckets=(512, 1024),
-                            speculative_k=best[0],
-                        ),
-                        params=params8,
-                    )
-                    try:
-                        p50f, _ = measure_fused(
-                            eng_f, "qa_e2e_7b_int8_fused"
-                        )
-                    finally:
-                        del eng_f
-                        gc.collect()
-                    if p50f < p50:
-                        p50 = p50f
-                        DETAILS["headline_config"] = "qa_e2e_7b_int8_fused"
-                        log(
-                            f"HEADLINE upgraded to fused 7B-int8 e2e: "
-                            f"p50 {p50f:.1f}ms"
-                        )
-                except Exception as e:
-                    log(f"7B fused e2e failed: {e!r}")
-                    DETAILS["qa_e2e_7b_int8_fused"] = {
-                        "error": repr(e)[:300]
-                    }
-            except Exception as e:
-                log(f"7B e2e headline failed (1.1B number stands): {e!r}")
-                DETAILS["qa_e2e_7b_int8"] = {"error": repr(e)[:300]}
-
-            # ---- config 5b: 7B-class under load — BASELINE config 5's
-            # generator class through the batcher.  The slots share each
-            # int8 weight read, so aggregate throughput approaches
-            # slots/step-time even at 7B on one chip.
-            try:
-                from docqa_tpu.runtime.metrics import (
-                    DEFAULT_REGISTRY as _REG,
-                )
-
-                # delta-window the global histogram: config 5's 1.1B runs
-                # already observed into it, and the lifetime mean would
-                # blend models
-                hist = _REG.histogram("serve_tokens_per_chunk")
-                count0 = hist.count
-                sum0 = (hist.mean * count0) if count0 else 0.0
-                # serve with the e2e sweep's best speculative_k: in the
-                # batcher each chunk verifies spec_k draft tokens per slot
-                # in ONE weight read, so speculation raises load
-                # throughput, not just solo latency
-                best_k = DETAILS.get("qa_e2e_7b_int8", {}).get(
-                    "speculative_k", 0
-                )
-                load_engine = (
-                    GenerateEngine(
-                        cfg7,
-                        GenerateConfig(
-                            max_new_tokens=64,
-                            prefill_buckets=(128,),
-                            speculative_k=best_k,
-                        ),
-                        params=params8,
-                    )
-                    if best_k
-                    else gen8
-                )
-                try:
-                    # (32, 32) first — the r04 full-bench winner (9.26 QPS
-                    # vs 9.13 at (32,16), docs/bench_r04_insession.json);
-                    # the two small-chunk points stay in the grid because
-                    # they trade within noise run-to-run
-                    DETAILS["rag_load_7b_int8"] = sweep_load(
-                        load_engine, 32, 512, ((32, 32), (32, 16), (16, 64))
-                    )
-                finally:
-                    # release on the error path too: a leaked 7B engine
-                    # would starve the bf16 attempt below of HBM
-                    if load_engine is not gen8:
-                        del load_engine
-                        gc.collect()
-                DETAILS["rag_load_7b_int8"]["speculative_k"] = best_k
-                d_count = hist.count - count0
-                DETAILS["rag_load_7b_int8"]["serve_tokens_per_chunk_mean"] = (
-                    round((hist.mean * hist.count - sum0) / d_count, 2)
-                    if d_count > 0
-                    else None
-                )
-                log(f"config5b 7B-int8 load: {DETAILS['rag_load_7b_int8']}")
-            except Exception as e:
-                log(f"7B int8 load bench failed: {e!r}")
-                DETAILS["rag_load_7b_int8"] = {"error": repr(e)[:300]}
-            dispatch_health("after_7b_sections")
-            del gen8, params8
+            log(f"ivf_scale {target_n}: {out[str(target_n)]}")
+            DETAILS["ivf_scale"] = out
+            flush_details()
+            del tiered, big
             gc.collect()
-        except Exception as e:
-            log(f"config3c 7B int8 attempt failed: {e!r}")
-            DETAILS["decode_7b_int8"] = {"error": repr(e)[:500]}
-        flush_details()
+        DETAILS["ivf_scale"] = out
 
-        # ---- config 3d: 7B grouped-int4 (w4a16, ~3.6 GB — the q4 class
-        # the reference's Ollama runtime actually served).  Decode reads
-        # half of int8's bytes, so bandwidth-bound tok/s should ~double;
-        # if its e2e beats the int8 headline, it takes the headline.
-        gen4 = params4 = None
-        try:
-            cfg7 = DecoderConfig.mistral_7b()
-            # Capability gate FIRST (r04 post-mortem): on the tunneled
-            # axon backend, lowering an S4 program fails client-side, and
-            # the subsequent full-program compile attempt came back
-            # UNIMPLEMENTED and left the client in a state where EVERY
-            # later dispatch failed — killing config 3b, the beam bench,
-            # and the deid quality eval of that run.  probe_int4_support
-            # proves the dtype end-to-end on a toy program (which fails
-            # fast WITHOUT poisoning the client — verified in-session)
-            # before anything allocates a multi-GB tree or compiles an
-            # int4-shaped program.
-            import jax.numpy as _jnp
+    if not small:
+        run_section("ivf_scale", sec_ivf_scale, 1200)
 
-            from docqa_tpu.models.quant import probe_int4_support
+    # ---- config 3d: 7B grouped-int4 (w4a16) ---------------------------------
+    def sec_int4():
+        import jax.numpy as _jnp
 
-            _int4_ok, _int4_why = probe_int4_support()
-            if not _int4_ok:
-                raise RuntimeError(
-                    "backend cannot execute int4 programs "
-                    f"(capability probe: {_int4_why})"
-                )
-            # fusion probe BEFORE allocating the tree: if the backend
-            # materializes the dequantized bf16 weight instead of fusing
-            # the grouped dequant into the dot, the temp allocation shows
-            # it here (one mlp weight = 117 MB bf16) and the section's
-            # tok/s will confirm — record both, never assume
-            try:
+        from docqa_tpu.models.quant import (
+            init_quantized_decoder_params,
+            probe_int4_support,
+        )
 
-                from docqa_tpu.models.decoder import _qmatmul
-
-                _g = 128
-                _probe_p = {
-                    "w": _jnp.zeros(
-                        (cfg7.mlp_dim // _g, _g, cfg7.hidden_dim),
-                        _jnp.int4,
-                    ),
-                    "w__scale": _jnp.zeros(
-                        (cfg7.mlp_dim // _g, cfg7.hidden_dim), _jnp.float32
-                    ),
-                }
-                _x = _jnp.zeros((1, cfg7.mlp_dim), _jnp.bfloat16)
-                _ma = (
-                    jax.jit(
-                        lambda x, p: _qmatmul(x, p, "w", _jnp.bfloat16)
-                    )
-                    .lower(_x, _probe_p)
-                    .compile()
-                    .memory_analysis()
-                )
-                DETAILS["int4_fusion_probe"] = {
-                    "temp_bytes": int(_ma.temp_size_in_bytes),
-                    "materialized_tree_bytes": cfg7.mlp_dim
-                    * cfg7.hidden_dim
-                    * 2,
-                }
-                log(f"int4 fusion probe: {DETAILS['int4_fusion_probe']}")
-                del _probe_p, _x
-            except Exception as e:
-                log(f"int4 fusion probe failed: {e!r}")
-            params4 = init_quantized_decoder_params(
-                jax.random.PRNGKey(0), cfg7, host_init=True, bits=4,
-                host_seed=0,
+        S["gen1"] = None
+        gc.collect()
+        # Capability gate FIRST (r04 post-mortem): an ungated S4 compile
+        # on the tunneled backend poisoned every later dispatch.  The toy
+        # probe fails fast WITHOUT poisoning the client.
+        _int4_ok, _int4_why = probe_int4_support()
+        if not _int4_ok:
+            raise RuntimeError(
+                f"backend cannot execute int4 programs (probe: {_int4_why})"
             )
-            pb4 = param_bytes(params4)  # NOTE: host itemsize counts int4
-            # as 1 byte; the packed on-device tree is half this
+        try:
+            from docqa_tpu.models.decoder import _qmatmul
+
+            _g = 128
+            _probe_p = {
+                "w": _jnp.zeros(
+                    (cfg7.mlp_dim // _g, _g, cfg7.hidden_dim), _jnp.int4
+                ),
+                "w__scale": _jnp.zeros(
+                    (cfg7.mlp_dim // _g, cfg7.hidden_dim), _jnp.float32
+                ),
+            }
+            _x = _jnp.zeros((1, cfg7.mlp_dim), _jnp.bfloat16)
+            _ma = (
+                jax.jit(lambda x, p: _qmatmul(x, p, "w", _jnp.bfloat16))
+                .lower(_x, _probe_p)
+                .compile()
+                .memory_analysis()
+            )
+            DETAILS["int4_fusion_probe"] = {
+                "temp_bytes": int(_ma.temp_size_in_bytes),
+                "materialized_tree_bytes": cfg7.mlp_dim * cfg7.hidden_dim * 2,
+            }
+            log(f"int4 fusion probe: {DETAILS['int4_fusion_probe']}")
+            del _probe_p, _x
+        except Exception as e:
+            log(f"int4 fusion probe failed: {e!r}")
+        params4 = init_quantized_decoder_params(
+            jax.random.PRNGKey(0), cfg7, host_init=True, bits=4, host_seed=0
+        )
+        try:
+            pb4 = param_bytes(params4)  # host itemsize counts int4 as 1B
             gen4 = GenerateEngine(
                 cfg7,
-                GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
+                GenerateConfig(max_new_tokens=64, prefill_buckets=(512,)),
                 params=params4,
             )
             gen4.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
@@ -1303,82 +1477,42 @@ def main() -> None:
                 "hbm_utilization": round(util4, 3) if util4 else None,
             }
             log(
-                f"config3d Mistral-7B-class int4 ({pb4_packed/1e9:.1f}GB "
-                f"packed): {tok4:.1f} tok/s"
+                f"config3d 7B int4 ({pb4_packed/1e9:.1f}GB packed): "
+                f"{tok4:.1f} tok/s"
                 + (f", HBM util {util4:.0%}" if util4 else "")
             )
-            try:
-                best_k4 = DETAILS.get("qa_e2e_7b_int8", {}).get(
-                    "speculative_k", 0
-                )
-                eng4 = (
-                    gen4
-                    if not best_k4
-                    else GenerateEngine(
-                        cfg7,
-                        GenerateConfig(
-                            max_new_tokens=64,
-                            prefill_buckets=(128,),
-                            speculative_k=best_k4,
-                        ),
-                        params=params4,
-                    )
-                )
-                try:
-                    p50_4, p95_4 = measure_e2e(
-                        eng4,
-                        q_texts[2 : 2 + n_e2e_7b],
-                        f"7B-int4 spec_k={best_k4}",
-                    )
-                finally:
-                    if eng4 is not gen4:
-                        del eng4
-                        gc.collect()
-                DETAILS["qa_e2e_7b_int4"] = {
-                    "p50_ms": round(p50_4, 2),
-                    "p95_ms": round(p95_4, 2),
-                    "new_tokens": max_new,
-                    "decoder": "mistral-7b-class-int4-g128",
-                    "speculative_k": best_k4,
-                }
-                if p50_4 < p50:
-                    p50 = p50_4
-                    DETAILS["headline_config"] = "qa_e2e_7b_int4"
-                    log(
-                        f"HEADLINE upgraded to 7B-int4 e2e: p50 "
-                        f"{p50_4:.1f}ms"
-                    )
-            except Exception as e:
-                log(f"7B int4 e2e failed: {e!r}")
-                DETAILS["qa_e2e_7b_int4"] = {"error": repr(e)[:300]}
-        except Exception as e:
-            log(f"config3d 7B int4 attempt failed: {e!r}")
-            DETAILS["decode_7b_int4"] = {"error": repr(e)[:500]}
-        finally:
-            # free on EVERY path: a leaked int4 tree would make config
-            # 3b's 14.5 GB bf16 attempt OOM for the wrong reason
-            del gen4, params4
-            gc.collect()
-            flush_details()
-
-        # ---- config 3b: the same 7B in bf16 (14.5 GB) — needs ALL the
-        # HBM, so the store/encoder go first; runs last for that reason
-        del store, encoder, retriever
-        gc.collect()
-        try:
-            import jax.numpy as jnp
-
-            from docqa_tpu.models.decoder import init_decoder_params
-
-            cfg7 = DecoderConfig.mistral_7b()
-            # device-side init deliberately: host init would draw + transfer
-            # 14.5 GB through the tunnel (minutes), while the dispatch
-            # degradation it avoids costs ~70 ms on each of the THREE timed
-            # decode calls this section makes — serving engines host-init,
-            # one-shot measurements don't need to
-            params7 = init_decoder_params(
-                jax.random.PRNGKey(0), cfg7, param_dtype=jnp.bfloat16
+            p50_4, p95_4 = measure_e2e(
+                gen4, q_texts[2 : 2 + n_e2e], "7B-int4 spec_k=0"
             )
+            DETAILS["qa_e2e_7b_int4"] = {
+                "p50_ms": round(p50_4, 2),
+                "p95_ms": round(p95_4, 2),
+                "new_tokens": max_new,
+                "decoder": "mistral-7b-class-int4-g128",
+            }
+            del gen4
+        finally:
+            del params4
+            gc.collect()
+
+    if not small:
+        run_section("int4_7b", sec_int4, 300)
+
+    # ---- config 3b: the same 7B in bf16 (14.5 GB) — needs ALL the HBM -------
+    def sec_bf16_7b():
+        import jax.numpy as jnp
+
+        from docqa_tpu.models.decoder import init_decoder_params
+
+        S["gen1"] = None
+        gc.collect()
+        # device-side init deliberately: host init would draw + transfer
+        # 14.5 GB through the tunnel (minutes) and nothing latency-
+        # sensitive is measured after this section
+        params7 = init_decoder_params(
+            jax.random.PRNGKey(0), cfg7, param_dtype=jnp.bfloat16
+        )
+        try:
             pb7 = param_bytes(params7)
             gen7 = GenerateEngine(
                 cfg7,
@@ -1387,7 +1521,8 @@ def main() -> None:
             )
             gen7.generate_ids([[5, 9, 11]], max_new_tokens=64)  # compile
             t7, _ = timed(
-                lambda: gen7.generate_ids([[5, 9, 11]], max_new_tokens=64), n=3
+                lambda: gen7.generate_ids([[5, 9, 11]], max_new_tokens=64),
+                n=3,
             )
             tok7 = 64 / t7
             util7 = tok7 * pb7 / (V5E_HBM_GBPS * 1e9) if on_tpu else None
@@ -1397,42 +1532,36 @@ def main() -> None:
                 "hbm_utilization": round(util7, 3) if util7 else None,
             }
             log(
-                f"config3b Mistral-7B-class bf16 ({pb7/1e9:.1f}GB): "
-                f"{tok7:.1f} tok/s"
+                f"config3b 7B bf16 ({pb7/1e9:.1f}GB): {tok7:.0f} tok/s"
                 + (f", HBM util {util7:.0%}" if util7 else "")
             )
-            del gen7, params7
+            del gen7
+        finally:
+            del params7
             gc.collect()
-        except Exception as e:
-            # one v5e chip has 16 GB HBM; a 14.5 GB weight tree may not
-            # leave room — record the honest outcome either way
-            log(f"config3b 7B bf16 attempt failed: {e!r}")
-            DETAILS["decode_7b"] = {"error": repr(e)[:500]}
 
-    # ---- emit ---------------------------------------------------------------
-    # A CPU fallback run must be UNMISTAKABLE in the one line the driver
-    # parses: distinct metric name AND an explicit degraded flag, so no
-    # artifact comparison can mistake a smoke run for a TPU measurement
-    # (the r02 artifact was misleading exactly this way).  The line prints
-    # BEFORE the deferred slow sections (NER training, beam compile): a
-    # driver-side timeout during those must not cost the round its
-    # headline number.
-    degraded = not on_tpu
-    DETAILS["degraded"] = degraded
+    if not small:
+        if remaining() >= 240:
+            # one v5e chip has 16 GB HBM; the 14.5 GB tree needs the
+            # store/encoder gone first (rebinding clears the closure
+            # cells — every section that used them has already run)
+            retriever = None
+            store = None
+            encoder = None
+            gc.collect()
+            run_section("bf16_7b", sec_bf16_7b, 240)
+        else:
+            DETAILS.setdefault("skipped", {})["bf16_7b"] = (
+                f"budget: {remaining():.0f}s left, need ~240s"
+            )
+            log("SKIP bf16_7b: budget")
+
+    # ---- late sections (slow compiles / training) ---------------------------
+    for name, fn, need in late_sections:
+        run_section(name, fn, need)
+
+    DETAILS["total_wall_s"] = round(time.monotonic() - T0, 1)
     flush_details()
-    summary = {
-        "metric": "qa_e2e_p50_ms" + ("_cpu_smoke" if degraded else ""),
-        "value": round(p50, 2),
-        "unit": "ms",
-        "vs_baseline": round(1000.0 / p50, 3),
-    }
-    if degraded:
-        summary["degraded"] = True
-    print(json.dumps(summary), flush=True)
-
-    for section in late_sections:
-        section()
-        flush_details()
     log(f"details: {json.dumps(DETAILS)}")
 
 
