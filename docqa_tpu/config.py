@@ -363,6 +363,12 @@ class ServiceConfig:
     synthesis_port: int = 8005
     host: str = "0.0.0.0"
     request_timeout_s: float = 60.0
+    # Tika-protocol extractor server for formats the in-process extractors
+    # cannot read (scanned PDFs, legacy .doc, RTF...).  None = disabled;
+    # the compose "extractor" profile provisions one and sets
+    # DOCQA_SERVICE__EXTRACTOR_URL (reference: docker-compose.yml:34-38,
+    # processing.py:15).
+    extractor_url: Optional[str] = None
 
 
 @dataclass(frozen=True)

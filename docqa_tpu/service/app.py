@@ -304,6 +304,11 @@ class DocQARuntime:
                 self.cfg.data.work_dir, "registry.db"
             )
         self.registry = DocumentRegistry(registry_url)
+        http_extractor = None
+        if self.cfg.service.extractor_url:
+            from docqa_tpu.service.extract import make_http_extractor
+
+            http_extractor = make_http_extractor(self.cfg.service.extractor_url)
         self.pipeline = DocumentPipeline(
             self.cfg,
             self.broker,
@@ -311,6 +316,7 @@ class DocQARuntime:
             self.deid,
             self.encoder,
             self.store,
+            http_extractor=http_extractor,
             on_indexed=self._on_indexed,
             # generator tokens at index time feed the single-sync fused
             # RAG path when the sidecar is enabled (engines/rag_fused.py)

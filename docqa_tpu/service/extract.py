@@ -16,7 +16,7 @@ import io
 import re
 import zipfile
 import zlib
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from docqa_tpu.runtime.metrics import get_logger
 
@@ -189,6 +189,78 @@ def make_http_extractor(server_url: str) -> Callable[[bytes], Optional[str]]:
     return extract
 
 
+# ---- failure diagnosis -----------------------------------------------------
+
+# PDF filters the in-process extractor cannot decode (only FlateDecode and
+# raw streams are); their presence explains a text-less extraction
+_PDF_HARD_FILTERS = (
+    b"LZWDecode", b"CCITTFaxDecode", b"JBIG2Decode", b"RunLengthDecode",
+    b"ASCII85Decode", b"ASCIIHexDecode",
+)
+_PDF_IMAGE_MARKS = (b"DCTDecode", b"JPXDecode", b"/Image")
+
+# THE signature table: known non-plain-text containers with no in-process
+# extractor, (magic prefixes, diagnosis slug).  Read by BOTH the dispatch
+# gate in extract_text_ex (so these never fall into the latin-1 text
+# sniffer) and diagnose_unextractable (so the failure reason names the
+# format) — one list, no drift.
+_BINARY_SIGNATURES = (
+    ((b"{\\rtf",), "rtf_document"),
+    ((b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1",), "legacy_ole2_document"),
+    (
+        (b"\xff\xd8\xff", b"\x89PNG\r\n\x1a\n", b"GIF8", b"II*\x00",
+         b"MM\x00*"),
+        "image_file",
+    ),
+)
+
+
+def _signature_slug(data: bytes) -> Optional[str]:
+    for prefixes, slug in _BINARY_SIGNATURES:
+        if data.startswith(prefixes):
+            return slug
+    return None
+
+
+def diagnose_unextractable(data: bytes, filename: str) -> str:
+    """Classify WHY extraction produced no text — an actionable reason
+    slug recorded as the registry row's ``status_detail`` (VERDICT r4
+    item 7: a scanned-PDF upload must produce a precise error, not
+    undifferentiated ERROR_EXTRACTION noise; the reference shipped every
+    format to Tika and could not say why one came back empty,
+    ``processing.py:16-19``).
+
+    Slugs (stable API, surfaced by ``GET /documents/``):
+      * ``pdf_encrypted``          — /Encrypt dictionary present
+      * ``pdf_scanned_image_only`` — image XObjects, no text operators
+      * ``pdf_unsupported_filter`` — LZW/CCITT/JBIG2/... streams only
+      * ``pdf_no_extractable_text``— PDF without either (CID-keyed fonts)
+      * ``legacy_ole2_document``   — .doc/.xls/.ppt (OLE2 container)
+      * ``rtf_document``           — RTF source
+      * ``image_file``             — bare JPEG/PNG/GIF/TIFF upload
+      * ``empty_file``             — zero-length body
+      * ``binary_unrecognized``    — none of the above
+    Each of these is extractable via the HTTP escape hatch
+    (``make_http_extractor`` + the compose ``extractor`` profile), so the
+    operator's fix is either "enable the extractor service" or "convert
+    before upload" — the detail says which document needs it.
+    """
+    if not data:
+        return "empty_file"
+    if data.startswith(b"%PDF"):
+        if b"/Encrypt" in data:
+            return "pdf_encrypted"
+        if any(m in data for m in _PDF_IMAGE_MARKS):
+            return "pdf_scanned_image_only"
+        if any(f in data for f in _PDF_HARD_FILTERS):
+            return "pdf_unsupported_filter"
+        return "pdf_no_extractable_text"
+    slug = _signature_slug(data)
+    if slug is not None:
+        return slug
+    return "binary_unrecognized"
+
+
 # ---- dispatch --------------------------------------------------------------
 
 _BY_EXT: Dict[str, Callable[[bytes], Optional[str]]] = {
@@ -201,16 +273,45 @@ _BY_EXT: Dict[str, Callable[[bytes], Optional[str]]] = {
 }
 
 
+def extract_text_ex(
+    data: bytes,
+    filename: str,
+    http_fallback: Optional[Callable[[bytes], Optional[str]]] = None,
+) -> Tuple[Optional[str], Optional[str]]:
+    """Extension-dispatched extraction; unknown extensions dispatch on
+    content signature, then try plain-text sniffing; anything still
+    unreadable goes to the HTTP fallback.  Returns
+    ``(text, failure_reason)`` — exactly one side is set."""
+    ext = filename.rsplit(".", 1)[-1].lower() if "." in filename else ""
+    fn = _BY_EXT.get(ext)
+    if fn is None:
+        # unknown extension: dispatch on signature.  Known NON-text
+        # containers must not fall into the text sniffer — RTF source or
+        # an OLE2 .doc decodes as latin-1 "text", which would index
+        # markup noise instead of failing with an actionable reason.
+        if data.startswith(b"%PDF"):
+            fn = extract_pdf
+        elif data[:2] == b"PK":  # zip container: try docx
+            fn = extract_docx
+        elif _signature_slug(data) is not None:
+            fn = None  # no in-process extractor; diagnose + escape hatch
+        else:
+            fn = extract_txt
+    text = fn(data) if fn is not None else None
+    if text is None and http_fallback is not None:
+        text = http_fallback(data)
+    if text is not None:
+        return text, None
+    reason = diagnose_unextractable(data, filename)
+    if http_fallback is not None:
+        reason += "_after_http_fallback"
+    return None, reason
+
+
 def extract_text(
     data: bytes,
     filename: str,
     http_fallback: Optional[Callable[[bytes], Optional[str]]] = None,
 ) -> Optional[str]:
-    """Extension-dispatched extraction; unknown extensions try plain-text
-    sniffing; anything still unreadable goes to the HTTP fallback."""
-    ext = filename.rsplit(".", 1)[-1].lower() if "." in filename else ""
-    fn = _BY_EXT.get(ext, extract_txt)
-    text = fn(data)
-    if text is None and http_fallback is not None:
-        text = http_fallback(data)
-    return text
+    """Back-compat wrapper over :func:`extract_text_ex` (text only)."""
+    return extract_text_ex(data, filename, http_fallback)[0]

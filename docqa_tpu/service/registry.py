@@ -57,6 +57,11 @@ class DocumentRecord:
     patient_id: Optional[str] = None
     doc_date: Optional[str] = None  # ISO date of the clinical document
     n_chunks: int = 0
+    # actionable failure reason accompanying an ERROR_* status (e.g.
+    # "pdf_scanned_image_only" — service/extract.py slugs); None otherwise.
+    # LAST field deliberately: rows are built positionally from SELECT *,
+    # and this column is appended to pre-existing databases via ALTER.
+    status_detail: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -113,9 +118,25 @@ class DocumentRegistry:
                     doc_type TEXT,
                     patient_id TEXT,
                     doc_date TEXT,
-                    n_chunks INTEGER DEFAULT 0
+                    n_chunks INTEGER DEFAULT 0,
+                    status_detail TEXT
                 )"""
             )
+            # migration for databases created before status_detail existed
+            # (sqlite and postgres both append the column, keeping the
+            # SELECT * positional order == dataclass field order)
+            try:
+                self._exec(
+                    "ALTER TABLE documents ADD COLUMN status_detail TEXT"
+                )
+            except Exception as e:
+                # ONLY the already-migrated case may be swallowed — any
+                # other failure (locked db, permissions) must abort boot,
+                # or every later INSERT/UPDATE would crash pointing away
+                # from the skipped migration
+                msg = str(e).lower()
+                if "duplicate column" not in msg and "already exists" not in msg:
+                    raise
             self._exec(
                 "CREATE INDEX IF NOT EXISTS idx_documents_filename "
                 "ON documents(filename)"
@@ -170,7 +191,7 @@ class DocumentRegistry:
         )
         with self._lock:
             self._exec(
-                "INSERT INTO documents VALUES (?,?,?,?,?,?,?,?)",
+                "INSERT INTO documents VALUES (?,?,?,?,?,?,?,?,?)",
                 (
                     rec.doc_id,
                     rec.filename,
@@ -180,24 +201,34 @@ class DocumentRegistry:
                     rec.patient_id,
                     rec.doc_date,
                     rec.n_chunks,
+                    rec.status_detail,
                 ),
             )
             self._conn.commit()
         return rec
 
     def set_status(
-        self, doc_id: str, status: str, n_chunks: Optional[int] = None
+        self,
+        doc_id: str,
+        status: str,
+        n_chunks: Optional[int] = None,
+        detail: Optional[str] = None,
     ) -> None:
+        """``detail``: actionable failure reason for ERROR_* statuses
+        (service/extract.py slugs).  Always written — a retry that
+        succeeds clears a stale reason."""
         with self._lock:
             if n_chunks is None:
                 self._exec(
-                    "UPDATE documents SET status=? WHERE doc_id=?",
-                    (status, doc_id),
+                    "UPDATE documents SET status=?, status_detail=? "
+                    "WHERE doc_id=?",
+                    (status, detail, doc_id),
                 )
             else:
                 self._exec(
-                    "UPDATE documents SET status=?, n_chunks=? WHERE doc_id=?",
-                    (status, n_chunks, doc_id),
+                    "UPDATE documents SET status=?, status_detail=?, "
+                    "n_chunks=? WHERE doc_id=?",
+                    (status, detail, n_chunks, doc_id),
                 )
             self._conn.commit()
 
