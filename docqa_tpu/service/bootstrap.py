@@ -8,8 +8,11 @@ indexed, filename-dispatched —
   (syndrome, plant, score) scoring matrix → one score sentence per row
   (``indexer.py:67-76``);
 * files whose name contains ``base`` or ``connaissance``: the denormalized
-  syndrome/formula/plant table → one detail sentence per row
-  (``indexer.py:79-89``);
+  syndrome/formula/plant table → one detail sentence per row, quoting the
+  monograph prose columns (nature/saveur/tropisme, indications, posologie,
+  contre-indications) when present (``indexer.py:79-89``);
+* files whose name contains ``monograph`` or ``plantes``: one per-herb
+  monograph sentence;
 * anything else: a generic "column: value" sentence (the reference skipped
   unknown files; we keep them searchable).
 
@@ -58,18 +61,80 @@ def row_to_sentence(filename: str, row: Dict[str, str]) -> Optional[str]:
         syndrome = _get(row, "nom_syndrome", "syndrome")
         formula = _get(row, "nom_formule", "formule", "formula")
         plant = _get(row, "nom_latin", "nom_plante", "plante")
+        chinese = _get(row, "nom_chinois")
         role = _get(row, "role", "role_plante")
         score = _get(row, "score_role", "score")
         parts = []
         if syndrome:
             parts.append(f"Syndrome: {syndrome}.")
         if formula:
-            parts.append(f"Formule associée: {formula}.")
+            f_ind = _get(row, "indication_formule", "indications_formule")
+            f_pos = _get(row, "posologie_formule")
+            line = f"Formule associée: {formula}"
+            if f_ind:
+                line += f" — {f_ind}"
+            parts.append(line + ".")
+            if f_pos:
+                parts.append(f"Posologie de la formule: {f_pos}.")
         if plant:
+            name = f"{plant} ({chinese})" if chinese else plant
             r = f" avec le rôle {role}" if role else ""
             s = f" (score {score})" if score else ""
-            parts.append(f"La plante {plant} y figure{r}{s}.")
+            parts.append(f"La plante {name} y figure{r}{s}.")
+            nature = _get(row, "nature_plante", "nature")
+            saveur = _get(row, "saveur_plante", "saveur")
+            trop = _get(row, "tropisme_plante", "tropisme")
+            props = "; ".join(
+                p
+                for p in (
+                    f"nature {nature}" if nature else None,
+                    f"saveur {saveur}" if saveur else None,
+                    f"tropisme {trop}" if trop else None,
+                )
+                if p
+            )
+            if props:
+                parts.append(f"Propriétés: {props}.")
+            ind = _get(row, "indications_plante", "indications")
+            if ind:
+                parts.append(f"Indications de la plante: {ind}.")
+            pos = _get(row, "posologie_plante", "posologie")
+            if pos:
+                parts.append(f"Posologie: {pos}.")
+            ci = _get(row, "contre_indications_plante", "contre_indications")
+            if ci:
+                parts.append(f"Contre-indications: {ci}.")
         return " ".join(parts) if parts else None
+    if "monograph" in base or "plantes" in base:
+        plant = _get(row, "nom_latin", "plante")
+        chinese = _get(row, "nom_chinois")
+        if not plant:
+            return None
+        name = f"{plant} ({chinese})" if chinese else plant
+        parts = [f"Monographie de la plante {name}."]
+        nature = _get(row, "nature")
+        saveur = _get(row, "saveur")
+        trop = _get(row, "tropisme")
+        props = "; ".join(
+            p
+            for p in (
+                f"nature {nature}" if nature else None,
+                f"saveur {saveur}" if saveur else None,
+                f"tropisme {trop}" if trop else None,
+            )
+            if p
+        )
+        if props:
+            parts.append(f"Propriétés: {props}.")
+        for field, label in (
+            ("indications", "Indications"),
+            ("posologie", "Posologie"),
+            ("contre_indications", "Contre-indications"),
+        ):
+            value = _get(row, field)
+            if value:
+                parts.append(f"{label}: {value}.")
+        return " ".join(parts)
     # generic fallback
     kv = [f"{k.strip()}: {v.strip()}" for k, v in row.items() if k and v and v.strip()]
     return ". ".join(kv) + "." if kv else None

@@ -80,6 +80,587 @@ PLANTS = {
     "sheng_ma": ("Cimicifuga foetida", "Sheng Ma"),
     "bai_he": ("Lilium brownii", "Bai He"),
     "zhi_mu": ("Anemarrhena asphodeloides", "Zhi Mu"),
+    "shi_gao": ("Gypsum fibrosum", "Shi Gao"),
+    "dan_shen": ("Salvia miltiorrhiza", "Dan Shen"),
+    "xiang_fu": ("Cyperus rotundus", "Xiang Fu"),
+    "mu_xiang": ("Aucklandia lappa", "Mu Xiang"),
+    "sha_ren": ("Amomum villosum", "Sha Ren"),
+    "yi_yi_ren": ("Coix lacryma-jobi", "Yi Yi Ren"),
+    "zhe_bei_mu": ("Fritillaria thunbergii", "Zhe Bei Mu"),
+    "gua_lou": ("Trichosanthes kirilowii", "Gua Lou"),
+    "jing_jie": ("Schizonepeta tenuifolia", "Jing Jie"),
+    "fang_feng": ("Saposhnikovia divaricata", "Fang Feng"),
+    "qiang_huo": ("Notopterygium incisum", "Qiang Huo"),
+    "du_huo": ("Angelica pubescens", "Du Huo"),
+    "sang_ji_sheng": ("Taxillus chinensis", "Sang Ji Sheng"),
+    "qin_jiao": ("Gentiana macrophylla", "Qin Jiao"),
+    "zhu_ru": ("Phyllostachys nigra caulis", "Zhu Ru"),
+    "shi_chang_pu": ("Acorus tatarinowii", "Shi Chang Pu"),
+    "bai_zi_ren": ("Platycladus orientalis semen", "Bai Zi Ren"),
+    "he_shou_wu": ("Polygonum multiflorum praeparata", "Zhi He Shou Wu"),
+    "tu_si_zi": ("Cuscuta chinensis", "Tu Si Zi"),
+    "yin_chen": ("Artemisia capillaris", "Yin Chen Hao"),
+}
+
+# key -> (nature, saveur, tropisme, indications, posologie,
+#         contre_indications) — own-worded monograph prose (VERDICT r4
+# item 8: the indexed sentences must carry quotable indication/posology/
+# description text, not just scores; reference shape: the 34-column
+# denormalized base the indexer templated at indexer.py:79-89).
+MONOGRAPHS = {
+    "ren_shen": (
+        "tiède", "douce, légèrement amère", "Rate, Poumon, Coeur",
+        "tonifie puissamment le Qi originel, soutient la Rate et le "
+        "Poumon, engendre les liquides et calme l'esprit; fatigue "
+        "profonde, essoufflement, palpitations avec épuisement",
+        "3 à 9 g en décoction séparée; jusqu'à 15 g en cas "
+        "d'effondrement du Qi",
+        "éviter en cas de Chaleur pléthorique ou d'hypertension non "
+        "contrôlée",
+    ),
+    "huang_qi": (
+        "légèrement tiède", "douce", "Rate, Poumon",
+        "tonifie le Qi et fait monter le Yang, consolide la surface et "
+        "réduit les transpirations spontanées, favorise la "
+        "cicatrisation; fatigue avec ptose, oedèmes par Vide de Qi",
+        "9 à 30 g en décoction",
+        "prudence en phase aiguë d'infection externe",
+    ),
+    "bai_zhu": (
+        "tiède", "douce, amère", "Rate, Estomac",
+        "renforce la Rate, assèche l'Humidité, stabilise la surface; "
+        "appétit faible, selles molles, lassitude des membres",
+        "6 à 12 g en décoction",
+        "réserver en cas de Vide de Yin avec soif",
+    ),
+    "fu_ling": (
+        "neutre", "douce, fade", "Coeur, Rate, Rein",
+        "draine l'Humidité par la diurèse, renforce la Rate et apaise "
+        "le Coeur; oedèmes, digestion lourde, sommeil agité",
+        "9 à 15 g en décoction",
+        "prudence en cas de polyurie avec Vide de Yin",
+    ),
+    "gan_cao": (
+        "neutre", "douce", "les douze méridiens",
+        "harmonise les autres plantes, tonifie le Qi du Foyer Moyen, "
+        "humidifie le Poumon et calme les spasmes; toux, douleurs "
+        "spasmodiques, harmonisation des formules",
+        "2 à 6 g en décoction",
+        "doses prolongées: rétention hydrosodée; éviter avec Gan Sui "
+        "et Da Ji",
+    ),
+    "dang_gui": (
+        "tiède", "douce, piquante", "Foie, Coeur, Rate",
+        "nourrit le Sang et l'anime, régularise les menstruations, "
+        "humidifie les intestins; teint pâle, règles irrégulières, "
+        "constipation sèche du Vide de Sang",
+        "6 à 12 g en décoction",
+        "éviter en cas de diarrhée par Humidité de la Rate",
+    ),
+    "shu_di": (
+        "légèrement tiède", "douce", "Foie, Rein",
+        "nourrit en profondeur le Sang et le Yin, renforce l'Essence "
+        "et la moelle; vertiges, acouphènes, lombes faibles, cheveux "
+        "ternes",
+        "9 à 15 g en décoction",
+        "digestion faible: associer une plante qui mobilise (Sha Ren, "
+        "Chen Pi)",
+    ),
+    "bai_shao": (
+        "légèrement froide", "amère, acide", "Foie, Rate",
+        "nourrit le Sang, assouplit le Foie, retient le Yin et calme "
+        "la douleur; crampes, douleurs hypochondriaques, "
+        "transpirations du Vide",
+        "6 à 15 g en décoction",
+        "incompatible avec Li Lu; prudence en cas de Froid-Vide",
+    ),
+    "chuan_xiong": (
+        "tiède", "piquante", "Foie, Vésicule Biliaire, Péricarde",
+        "anime le Sang et fait circuler le Qi, chasse le Vent et "
+        "arrête la douleur; céphalées, règles douloureuses, douleurs "
+        "par Stase",
+        "3 à 9 g en décoction",
+        "éviter en cas de Vide de Yin avec Feu ou de saignement actif",
+    ),
+    "chai_hu": (
+        "légèrement froide", "amère, piquante",
+        "Foie, Vésicule Biliaire",
+        "libère le Shao Yang, draine le Foie et fait monter le Qi "
+        "clair; alternance froid-chaleur, oppression des flancs, "
+        "humeur nouée",
+        "3 à 9 g en décoction",
+        "prudence en cas de montée du Yang du Foie ou de Vide de Yin",
+    ),
+    "bo_he": (
+        "fraîche", "piquante", "Poumon, Foie",
+        "disperse le Vent-Chaleur, clarifie la tête et la gorge, "
+        "libère la surface; fièvre légère, gorge irritée, yeux rouges",
+        "3 à 6 g, ajouté en fin de décoction",
+        "transpirations abondantes du Vide: éviter",
+    ),
+    "sheng_jiang": (
+        "tiède", "piquante", "Poumon, Rate, Estomac",
+        "libère la surface du Vent-Froid, réchauffe l'Estomac et "
+        "arrête les nausées; rhume débutant, vomissements par Froid",
+        "3 à 9 g en décoction",
+        "Chaleur interne ou Vide de Yin avec chaleur: réserver",
+    ),
+    "da_zao": (
+        "tiède", "douce", "Rate, Estomac",
+        "tonifie le Qi du Foyer Moyen, nourrit le Sang et adoucit les "
+        "formules; fatigue digestive, nervosité par Vide de Sang",
+        "3 à 10 fruits en décoction",
+        "ballonnements par Humidité: limiter",
+    ),
+    "chen_pi": (
+        "tiède", "piquante, amère", "Rate, Poumon",
+        "fait circuler le Qi, assèche l'Humidité et transforme les "
+        "Glaires; ballonnements, nausées, toux grasse",
+        "3 à 9 g en décoction",
+        "toux sèche par Vide de Yin: éviter",
+    ),
+    "ban_xia": (
+        "tiède", "piquante", "Rate, Estomac, Poumon",
+        "assèche l'Humidité et transforme les Glaires, fait descendre "
+        "le Qi rebelle; nausées, vomissements, toux à expectoration "
+        "abondante",
+        "3 à 9 g (préparée) en décoction",
+        "toujours utiliser la forme préparée; prudence pendant la "
+        "grossesse",
+    ),
+    "shan_yao": (
+        "neutre", "douce", "Rate, Poumon, Rein",
+        "tonifie doucement la Rate, le Poumon et le Rein, retient "
+        "l'Essence; selles molles chroniques, toux faible, leucorrhées",
+        "9 à 30 g en décoction",
+        "peu de restrictions; stagnation avec plénitude: limiter",
+    ),
+    "shan_zhu_yu": (
+        "légèrement tiède", "acide, astringente", "Foie, Rein",
+        "retient l'Essence et les liquides, tonifie le Foie et le "
+        "Rein; transpirations profuses, pollakiurie, vertiges",
+        "6 à 12 g en décoction",
+        "dysurie par Chaleur-Humidité: éviter",
+    ),
+    "mu_dan_pi": (
+        "légèrement froide", "amère, piquante", "Coeur, Foie, Rein",
+        "rafraîchit le Sang sans figer, anime le Sang et clarifie la "
+        "Chaleur-Vide; fièvres vespérales, règles en avance",
+        "6 à 12 g en décoction",
+        "grossesse et règles abondantes: prudence",
+    ),
+    "ze_xie": (
+        "froide", "douce, fade", "Rein, Vessie",
+        "draine l'Humidité et clarifie la Chaleur du Foyer Inférieur; "
+        "oedèmes, urines rares, vertiges par Glaires-Humidité",
+        "6 à 12 g en décoction",
+        "Vide de Yang avec polyurie claire: éviter",
+    ),
+    "gou_qi": (
+        "neutre", "douce", "Foie, Rein, Poumon",
+        "nourrit le Yin du Foie et du Rein, éclaire les yeux, "
+        "humidifie le Poumon; vision floue, lombes douloureuses, soif",
+        "6 à 12 g en décoction ou tel quel",
+        "diarrhée par Vide de Rate: limiter",
+    ),
+    "ju_hua": (
+        "légèrement froide", "douce, amère", "Poumon, Foie",
+        "disperse le Vent-Chaleur, apaise le Foie et éclaire les "
+        "yeux; céphalées, yeux rouges et secs, vertiges légers",
+        "5 à 10 g en infusion ou décoction courte",
+        "diarrhée par Froid-Vide: prudence",
+    ),
+    "jin_yin_hua": (
+        "froide", "douce", "Poumon, Coeur, Estomac",
+        "clarifie la Chaleur et élimine la Toxicité, disperse le "
+        "Vent-Chaleur; angines, furoncles, fièvre des affections "
+        "externes",
+        "6 à 15 g en décoction",
+        "plaies froides et Vide de la Rate: éviter",
+    ),
+    "lian_qiao": (
+        "légèrement froide", "amère", "Poumon, Coeur, Vésicule Biliaire",
+        "clarifie la Chaleur, élimine la Toxicité et disperse les "
+        "nouures; fièvre, gorge enflée, abcès débutants",
+        "6 à 15 g en décoction",
+        "diarrhée par Vide de Rate: prudence",
+    ),
+    "jie_geng": (
+        "neutre", "amère, piquante", "Poumon",
+        "ouvre le Poumon, transforme les Glaires et porte l'action "
+        "des formules vers le haut; toux, gorge enrouée, expectoration "
+        "difficile",
+        "3 à 9 g en décoction",
+        "toux sèche par montée du Qi avec hémoptysie: éviter",
+    ),
+    "ma_huang": (
+        "tiède", "piquante, légèrement amère", "Poumon, Vessie",
+        "libère fortement la surface, fait transpirer, ouvre le "
+        "Poumon et calme l'asthme; rhume sans transpiration, dyspnée",
+        "2 à 9 g en décoction",
+        "hypertension, palpitations, transpiration spontanée: éviter",
+    ),
+    "gui_zhi": (
+        "tiède", "piquante, douce", "Coeur, Poumon, Vessie",
+        "libère la surface et harmonise le Ying et le Wei, réchauffe "
+        "les méridiens et soutient le Yang; rhume avec transpiration, "
+        "membres froids, palpitations",
+        "3 à 9 g en décoction",
+        "maladies fébriles avec Chaleur, grossesse: prudence",
+    ),
+    "xing_ren": (
+        "légèrement tiède", "amère", "Poumon, Gros Intestin",
+        "fait descendre le Qi du Poumon, calme la toux et humidifie "
+        "les intestins; toux, dyspnée, constipation sèche",
+        "3 à 9 g en décoction",
+        "toux par Vide sans plénitude: prudence; amande légèrement "
+        "toxique à forte dose",
+    ),
+    "tao_ren": (
+        "neutre", "amère, douce", "Coeur, Foie, Gros Intestin",
+        "anime le Sang et disperse la Stase, humidifie les "
+        "intestins; douleurs fixes, règles retardées, constipation",
+        "4 à 9 g en décoction",
+        "grossesse: contre-indiqué",
+    ),
+    "hong_hua": (
+        "tiède", "piquante", "Coeur, Foie",
+        "anime le Sang, débloque les menstruations et arrête la "
+        "douleur de Stase; aménorrhée, douleurs thoraciques fixes",
+        "3 à 9 g en décoction",
+        "grossesse et tendance hémorragique: contre-indiqué",
+    ),
+    "suan_zao_ren": (
+        "neutre", "douce, acide", "Coeur, Foie, Vésicule Biliaire",
+        "nourrit le Coeur et le Foie, calme l'esprit et retient les "
+        "transpirations; insomnie, rêves abondants, palpitations",
+        "9 à 15 g en décoction, légèrement torréfiée",
+        "Chaleur pléthorique avec agitation: réserver",
+    ),
+    "yuan_zhi": (
+        "légèrement tiède", "amère, piquante", "Coeur, Rein, Poumon",
+        "relie le Coeur et le Rein, calme l'esprit et transforme les "
+        "Glaires; insomnie avec anxiété, mémoire faible, toux grasse",
+        "3 à 9 g en décoction",
+        "gastrite ou ulcère: prudence",
+    ),
+    "long_yan_rou": (
+        "tiède", "douce", "Coeur, Rate",
+        "nourrit le Sang du Coeur et tonifie la Rate, apaise "
+        "l'esprit; insomnie de surmenage, palpitations, mémoire faible",
+        "9 à 15 g en décoction",
+        "Glaires-Humidité ou stagnation digestive: limiter",
+    ),
+    "mai_dong": (
+        "légèrement froide", "douce, légèrement amère",
+        "Coeur, Poumon, Estomac",
+        "nourrit le Yin du Poumon et de l'Estomac, engendre les "
+        "liquides et apaise le Coeur; toux sèche, soif, agitation "
+        "nocturne",
+        "6 à 12 g en décoction",
+        "toux grasse par Froid ou diarrhée: éviter",
+    ),
+    "wu_wei_zi": (
+        "tiède", "acide", "Poumon, Coeur, Rein",
+        "retient le Qi du Poumon, consolide l'Essence et calme "
+        "l'esprit; toux chronique, transpirations, diarrhée de l'aube",
+        "2 à 6 g en décoction",
+        "affection externe en cours ou Chaleur interne: éviter",
+    ),
+    "huang_lian": (
+        "froide", "amère", "Coeur, Rate, Estomac, Gros Intestin",
+        "clarifie la Chaleur et assèche l'Humidité, draine le Feu et "
+        "élimine la Toxicité; dysenterie, agitation avec insomnie, "
+        "aphtes",
+        "2 à 5 g en décoction",
+        "très amère et froide: Vide de Rate sans Chaleur, éviter",
+    ),
+    "huang_qin": (
+        "froide", "amère", "Poumon, Vésicule Biliaire, Estomac, "
+        "Gros Intestin",
+        "clarifie la Chaleur du Foyer Supérieur, assèche l'Humidité "
+        "et calme le foetus; toux jaune, fièvre persistante, diarrhée "
+        "chaude",
+        "3 à 9 g en décoction",
+        "Froid-Vide de la Rate: éviter",
+    ),
+    "zhi_zi": (
+        "froide", "amère", "Coeur, Poumon, Triple Foyer",
+        "draine le Feu des trois Foyers, élimine l'irritabilité et "
+        "favorise la diurèse; insomnie fébrile, ictère, urines "
+        "foncées",
+        "6 à 9 g en décoction",
+        "selles molles par Froid-Vide: éviter",
+    ),
+    "da_huang": (
+        "froide", "amère", "Rate, Estomac, Gros Intestin, Foie, Coeur",
+        "purge la Chaleur accumulée, anime le Sang et élimine la "
+        "Toxicité; constipation par Chaleur, abdomen plein et "
+        "douloureux",
+        "3 à 12 g, ajouté en fin de décoction pour purger",
+        "grossesse, allaitement, menstruation: contre-indiqué",
+    ),
+    "hou_po": (
+        "tiède", "amère, piquante", "Rate, Estomac, Poumon, "
+        "Gros Intestin",
+        "fait circuler le Qi et dissout la plénitude, assèche "
+        "l'Humidité et fait descendre le rebelle; ballonnement, "
+        "oppression, toux chargée",
+        "3 à 9 g en décoction",
+        "grossesse: prudence; Vide de Qi sans stagnation: éviter",
+    ),
+    "zhi_shi": (
+        "légèrement froide", "amère, piquante", "Rate, Estomac",
+        "brise la stagnation du Qi et dissout les accumulations; "
+        "plénitude épigastrique, constipation avec ballonnement",
+        "3 à 9 g en décoction",
+        "grossesse et Vide de Qi marqué: prudence",
+    ),
+    "sang_ye": (
+        "froide", "douce, amère", "Poumon, Foie",
+        "disperse le Vent-Chaleur, clarifie le Poumon et éclaire les "
+        "yeux; toux sèche débutante, yeux rouges, céphalée légère",
+        "5 à 9 g en décoction",
+        "toux par Froid: réserver",
+    ),
+    "ge_gen": (
+        "fraîche", "douce, piquante", "Rate, Estomac",
+        "libère les muscles et fait monter le clair, engendre les "
+        "liquides; nuque raide, fièvre sans transpiration franche, "
+        "diarrhée chaude",
+        "9 à 15 g en décoction",
+        "transpirations profuses du Vide: prudence",
+    ),
+    "xi_xin": (
+        "tiède", "piquante", "Poumon, Rein, Coeur",
+        "chasse le Vent-Froid jusqu'aux os, réchauffe le Poumon et "
+        "transforme les Glaires froides; douleurs dentaires par "
+        "Froid, rhinite claire",
+        "1 à 3 g en décoction — petite dose impérative",
+        "ne pas dépasser 3 g; Vide de Yin avec chaleur: "
+        "contre-indiqué",
+    ),
+    "gan_jiang": (
+        "chaude", "piquante", "Rate, Estomac, Coeur, Poumon",
+        "réchauffe le Foyer Moyen et fait revenir le Yang, transforme "
+        "les Glaires froides; douleurs abdominales par Froid, membres "
+        "glacés, toux claire",
+        "3 à 9 g en décoction",
+        "grossesse, Chaleur interne ou Vide de Yin: éviter",
+    ),
+    "rou_gui": (
+        "très chaude", "piquante, douce", "Rein, Rate, Coeur, Foie",
+        "réchauffe et tonifie le Yang du Rein, ramène le Feu à sa "
+        "source, débloque les méridiens; lombes et genoux froids, "
+        "polyurie claire, douleurs par Froid profond",
+        "1 à 4 g, en poudre ou ajouté en fin de décoction",
+        "grossesse, Chaleur par Vide de Yin, saignements: "
+        "contre-indiqué",
+    ),
+    "du_zhong": (
+        "tiède", "douce", "Foie, Rein",
+        "tonifie le Foie et le Rein, fortifie les os et les tendons, "
+        "calme le foetus; lombalgies chroniques, genoux faibles, "
+        "hypertension par Vide",
+        "9 à 15 g en décoction",
+        "Chaleur par Vide de Yin: prudence",
+    ),
+    "niu_xi": (
+        "neutre", "amère, acide", "Foie, Rein",
+        "anime le Sang vers le bas, fortifie lombes et genoux, "
+        "conduit le Feu et le Sang vers le Foyer Inférieur; douleurs "
+        "lombaires, règles retardées, gingivorragies par montée du Feu",
+        "6 à 12 g en décoction",
+        "grossesse et règles abondantes: contre-indiqué",
+    ),
+    "sheng_ma": (
+        "légèrement froide", "douce, piquante",
+        "Poumon, Rate, Estomac, Gros Intestin",
+        "fait monter le Yang clair et élève ce qui s'effondre, "
+        "élimine la Toxicité; ptoses, prolapsus, éruptions qui ne "
+        "sortent pas",
+        "3 à 9 g en décoction",
+        "montée du Yang du Foie ou plénitude en haut: éviter",
+    ),
+    "bai_he": (
+        "légèrement froide", "douce", "Coeur, Poumon",
+        "humidifie le Poumon, calme la toux et apaise le Coeur; toux "
+        "sèche persistante, agitation avec tristesse, insomnie "
+        "post-fébrile",
+        "6 à 12 g en décoction",
+        "toux par Froid avec Glaires: éviter",
+    ),
+    "zhi_mu": (
+        "froide", "amère, douce", "Poumon, Estomac, Rein",
+        "clarifie la Chaleur et draine le Feu, nourrit le Yin et "
+        "humidifie la sécheresse; fièvre élevée avec soif, chaleur "
+        "des cinq coeurs, toux sèche",
+        "6 à 12 g en décoction",
+        "diarrhée par Froid-Vide de la Rate: éviter",
+    ),
+    "shi_gao": (
+        "très froide", "douce, piquante", "Poumon, Estomac",
+        "clarifie puissamment la Chaleur du niveau Qi, draine le Feu "
+        "du Poumon et de l'Estomac; forte fièvre avec soif et "
+        "transpiration, toux brûlante, gencives enflées",
+        "15 à 60 g, concassé, décoction prolongée",
+        "Froid-Vide de la Rate et de l'Estomac: contre-indiqué",
+    ),
+    "dan_shen": (
+        "légèrement froide", "amère", "Coeur, Péricarde, Foie",
+        "anime le Sang et disperse la Stase, rafraîchit le Sang et "
+        "apaise l'esprit; douleurs thoraciques, règles douloureuses, "
+        "insomnie avec agitation",
+        "6 à 15 g en décoction",
+        "incompatible avec Li Lu; prudence sous anticoagulants",
+    ),
+    "xiang_fu": (
+        "neutre", "piquante, légèrement amère et douce",
+        "Foie, Triple Foyer",
+        "fait circuler le Qi du Foie et régularise les "
+        "menstruations; humeur nouée, douleurs des flancs, règles "
+        "irrégulières par stagnation",
+        "6 à 12 g en décoction",
+        "Vide de Qi sans stagnation ou Vide de Yin avec chaleur: "
+        "prudence",
+    ),
+    "mu_xiang": (
+        "tiède", "piquante, amère", "Rate, Estomac, Gros Intestin, "
+        "Vésicule Biliaire",
+        "fait circuler le Qi et arrête la douleur digestive, réveille "
+        "la Rate; ballonnements douloureux, ténesme, appétit bloqué",
+        "3 à 9 g, ajouté en fin de décoction",
+        "Vide de Yin avec sécheresse: prudence",
+    ),
+    "sha_ren": (
+        "tiède", "piquante", "Rate, Estomac, Rein",
+        "mobilise le Qi, réveille la Rate, transforme l'Humidité et "
+        "calme le foetus; digestion lourde, nausées matinales, "
+        "diarrhée par Froid-Humidité",
+        "3 à 6 g, ajouté en fin de décoction",
+        "Chaleur par Vide de Yin: prudence",
+    ),
+    "yi_yi_ren": (
+        "légèrement froide", "douce, fade", "Rate, Estomac, Poumon",
+        "draine l'Humidité en douceur, renforce la Rate, clarifie la "
+        "Chaleur et évacue le pus; oedèmes, courbatures par Humidité, "
+        "diarrhée",
+        "9 à 30 g en décoction",
+        "grossesse: prudence",
+    ),
+    "zhe_bei_mu": (
+        "froide", "amère", "Poumon, Coeur",
+        "transforme les Glaires-Chaleur, dissout les nouures et "
+        "arrête la toux; toux jaune et épaisse, gorge enflée, "
+        "nodules",
+        "4 à 9 g en décoction",
+        "incompatible avec les Aconits; toux froide: réserver",
+    ),
+    "gua_lou": (
+        "froide", "douce", "Poumon, Estomac, Gros Intestin",
+        "transforme les Glaires-Chaleur, ouvre la poitrine et "
+        "humidifie les intestins; oppression thoracique, toux "
+        "grasse jaune, constipation sèche",
+        "9 à 15 g en décoction",
+        "incompatible avec les Aconits; diarrhée par Vide: éviter",
+    ),
+    "jing_jie": (
+        "légèrement tiède", "piquante", "Poumon, Foie",
+        "libère la surface et chasse le Vent, favorise l'éruption; "
+        "rhume qu'il soit Froid ou Chaleur, urticaire, début "
+        "d'éruption",
+        "4 à 9 g en décoction courte",
+        "éruption déjà complètement sortie: inutile",
+    ),
+    "fang_feng": (
+        "légèrement tiède", "piquante, douce", "Vessie, Foie, Rate",
+        "chasse le Vent de la surface et des articulations, vainc "
+        "l'Humidité et arrête les spasmes; courbatures fébriles, "
+        "démangeaisons, raideurs",
+        "4 à 9 g en décoction",
+        "spasmes par Vide de Sang sans Vent externe: réserver",
+    ),
+    "qiang_huo": (
+        "tiède", "piquante, amère", "Vessie, Rein",
+        "chasse le Vent-Froid-Humidité du haut du corps, libère la "
+        "surface; nuque et épaules douloureuses, céphalée occipitale",
+        "3 à 9 g en décoction",
+        "douleurs par Vide de Sang: éviter; arôme puissant, nausées "
+        "possibles",
+    ),
+    "du_huo": (
+        "tiède", "piquante, amère", "Rein, Vessie",
+        "chasse le Vent-Froid-Humidité du bas du corps; lombalgies et "
+        "douleurs des genoux aggravées au froid, sciatique",
+        "3 à 9 g en décoction",
+        "douleurs par Chaleur ou Vide de Yin: réserver",
+    ),
+    "sang_ji_sheng": (
+        "neutre", "amère, douce", "Foie, Rein",
+        "tonifie le Foie et le Rein, fortifie tendons et os, chasse "
+        "le Vent-Humidité et calme le foetus; lombalgies chroniques, "
+        "articulations faibles, grossesse agitée",
+        "9 à 15 g en décoction",
+        "peu de restrictions connues",
+    ),
+    "qin_jiao": (
+        "neutre", "amère, piquante", "Foie, Vésicule Biliaire, Estomac",
+        "chasse le Vent-Humidité sans assécher, détend les tendons et "
+        "clarifie la Chaleur-Vide; douleurs articulaires errantes, "
+        "fièvre vespérale chronique",
+        "4 à 9 g en décoction",
+        "diarrhée par Vide de Rate: prudence",
+    ),
+    "zhu_ru": (
+        "légèrement froide", "douce", "Poumon, Estomac, Vésicule "
+        "Biliaire",
+        "clarifie la Chaleur et transforme les Glaires, arrête les "
+        "nausées; vomissements amers, toux jaune, agitation avec "
+        "insomnie",
+        "4 à 9 g en décoction",
+        "vomissements par Froid d'Estomac: éviter",
+    ),
+    "shi_chang_pu": (
+        "tiède", "piquante, amère", "Coeur, Estomac",
+        "ouvre les orifices et transforme les Glaires, réveille "
+        "l'esprit et la Rate; confusion par Glaires, mémoire faible, "
+        "poitrine oppressée",
+        "3 à 9 g en décoction",
+        "Vide de Yin avec agitation du Yang: prudence",
+    ),
+    "bai_zi_ren": (
+        "neutre", "douce", "Coeur, Rein, Gros Intestin",
+        "nourrit le Coeur et calme l'esprit, humidifie les "
+        "intestins; insomnie avec palpitations, transpirations "
+        "nocturnes, constipation des personnes âgées",
+        "9 à 15 g en décoction",
+        "selles molles ou Glaires abondantes: éviter",
+    ),
+    "he_shou_wu": (
+        "légèrement tiède", "douce, amère, astringente", "Foie, Rein",
+        "nourrit le Sang et l'Essence sans figer, noircit les "
+        "cheveux, fortifie os et tendons; cheveux blancs précoces, "
+        "vertiges, lombes faibles",
+        "9 à 15 g en décoction (forme préparée)",
+        "utiliser la forme préparée; surveiller la fonction "
+        "hépatique en usage prolongé",
+    ),
+    "tu_si_zi": (
+        "neutre", "piquante, douce", "Foie, Rein, Rate",
+        "tonifie le Yang sans assécher et nourrit le Yin, retient "
+        "l'Essence et éclaire les yeux; lombes faibles, urines "
+        "fréquentes, vision baissée",
+        "6 à 12 g en décoction",
+        "Chaleur par Vide de Yin avec constipation: prudence",
+    ),
+    "yin_chen": (
+        "légèrement froide", "amère, piquante", "Rate, Estomac, Foie, "
+        "Vésicule Biliaire",
+        "clarifie la Chaleur-Humidité et fait disparaître l'ictère; "
+        "jaunisse, urines foncées, sensation de lourdeur",
+        "6 à 15 g en décoction",
+        "ictère par Froid-Vide: associer des plantes qui réchauffent",
+    ),
 }
 
 # formula -> (syndrome, [(plant_key, role, score), ...])
@@ -357,6 +938,563 @@ FORMULAS = {
             ("wu_wei_zi", "Assistant", 6),
         ],
     ),
+    "Ba Zhen Tang": (
+        "Vide de Qi et de Sang",
+        [
+            ("ren_shen", "Empereur", 8),
+            ("shu_di", "Empereur", 7),
+            ("bai_zhu", "Ministre", 6),
+            ("dang_gui", "Ministre", 7),
+            ("fu_ling", "Assistant", 5),
+            ("bai_shao", "Assistant", 5),
+            ("chuan_xiong", "Messager", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Shi Quan Da Bu Tang": (
+        "Vide de Qi et de Sang avec Froid",
+        [
+            ("huang_qi", "Empereur", 8),
+            ("ren_shen", "Ministre", 7),
+            ("shu_di", "Ministre", 7),
+            ("dang_gui", "Ministre", 6),
+            ("bai_zhu", "Assistant", 5),
+            ("fu_ling", "Assistant", 5),
+            ("bai_shao", "Assistant", 5),
+            ("chuan_xiong", "Assistant", 4),
+            ("rou_gui", "Messager", 5),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Dang Gui Bu Xue Tang": (
+        "Vide de Sang par effondrement du Qi",
+        [
+            ("huang_qi", "Empereur", 9),
+            ("dang_gui", "Ministre", 5),
+        ],
+    ),
+    "Zhen Wu Tang (variante)": (
+        "Vide de Yang avec Eau débordante",
+        [
+            ("rou_gui", "Empereur", 8),
+            ("fu_ling", "Ministre", 7),
+            ("bai_zhu", "Ministre", 6),
+            ("bai_shao", "Assistant", 5),
+            ("sheng_jiang", "Messager", 5),
+        ],
+    ),
+    "Wu Ling San (variante)": (
+        "Rétention d'Eau par trouble de la transformation",
+        [
+            ("ze_xie", "Empereur", 8),
+            ("fu_ling", "Ministre", 6),
+            ("bai_zhu", "Ministre", 6),
+            ("yi_yi_ren", "Assistant", 5),
+            ("gui_zhi", "Messager", 5),
+        ],
+    ),
+    "Xiao Qing Long Tang": (
+        "Vent-Froid externe avec Glaires-Froid interne",
+        [
+            ("ma_huang", "Empereur", 8),
+            ("gui_zhi", "Empereur", 7),
+            ("gan_jiang", "Ministre", 6),
+            ("xi_xin", "Ministre", 5),
+            ("ban_xia", "Assistant", 6),
+            ("wu_wei_zi", "Assistant", 5),
+            ("bai_shao", "Assistant", 5),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Ban Xia Xie Xin Tang": (
+        "Nouure de l'épigastre mêlant Froid et Chaleur",
+        [
+            ("ban_xia", "Empereur", 8),
+            ("huang_lian", "Ministre", 6),
+            ("huang_qin", "Ministre", 6),
+            ("gan_jiang", "Assistant", 5),
+            ("ren_shen", "Assistant", 5),
+            ("da_zao", "Messager", 3),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Chai Hu Shu Gan San": (
+        "Stagnation du Qi du Foie avec douleur des flancs",
+        [
+            ("chai_hu", "Empereur", 8),
+            ("xiang_fu", "Ministre", 7),
+            ("chuan_xiong", "Ministre", 6),
+            ("bai_shao", "Assistant", 6),
+            ("chen_pi", "Assistant", 5),
+            ("zhi_shi", "Assistant", 5),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Xue Fu Zhu Yu Tang (variante)": (
+        "Stase de Sang dans la poitrine",
+        [
+            ("tao_ren", "Empereur", 8),
+            ("hong_hua", "Empereur", 7),
+            ("dan_shen", "Ministre", 6),
+            ("dang_gui", "Ministre", 6),
+            ("chuan_xiong", "Assistant", 5),
+            ("bai_shao", "Assistant", 4),
+            ("niu_xi", "Assistant", 5),
+            ("chai_hu", "Messager", 4),
+            ("jie_geng", "Messager", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Dan Shen Yin (variante)": (
+        "Douleur épigastrique par Stase et stagnation du Qi",
+        [
+            ("dan_shen", "Empereur", 9),
+            ("sha_ren", "Ministre", 5),
+            ("mu_xiang", "Assistant", 4),
+        ],
+    ),
+    "Jing Fang Bai Du San (variante)": (
+        "Vent-Froid-Humidité en surface",
+        [
+            ("jing_jie", "Empereur", 7),
+            ("fang_feng", "Empereur", 7),
+            ("qiang_huo", "Ministre", 6),
+            ("du_huo", "Ministre", 6),
+            ("chai_hu", "Assistant", 5),
+            ("chuan_xiong", "Assistant", 4),
+            ("jie_geng", "Assistant", 4),
+            ("zhi_shi", "Assistant", 4),
+            ("fu_ling", "Assistant", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Qiang Huo Sheng Shi Tang (variante)": (
+        "Vent-Humidité de la nuque et du dos",
+        [
+            ("qiang_huo", "Empereur", 8),
+            ("du_huo", "Ministre", 7),
+            ("fang_feng", "Assistant", 6),
+            ("chuan_xiong", "Assistant", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "San Miao Wan (variante)": (
+        "Chaleur-Humidité du Foyer Inférieur",
+        [
+            ("huang_lian", "Empereur", 7),
+            ("yi_yi_ren", "Ministre", 6),
+            ("niu_xi", "Assistant", 5),
+        ],
+    ),
+    "Yin Chen Hao Tang": (
+        "Ictère par Chaleur-Humidité",
+        [
+            ("yin_chen", "Empereur", 9),
+            ("zhi_zi", "Ministre", 6),
+            ("da_huang", "Assistant", 5),
+        ],
+    ),
+    "Wen Dan Tang": (
+        "Glaires-Chaleur troublant l'esprit",
+        [
+            ("ban_xia", "Empereur", 7),
+            ("zhu_ru", "Empereur", 7),
+            ("zhi_shi", "Ministre", 6),
+            ("chen_pi", "Ministre", 5),
+            ("fu_ling", "Assistant", 5),
+            ("sheng_jiang", "Messager", 3),
+            ("da_zao", "Messager", 2),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Qing Qi Hua Tan Wan (variante)": (
+        "Toux par Glaires-Chaleur",
+        [
+            ("zhe_bei_mu", "Empereur", 7),
+            ("gua_lou", "Empereur", 7),
+            ("huang_qin", "Ministre", 6),
+            ("ban_xia", "Ministre", 5),
+            ("xing_ren", "Assistant", 5),
+            ("chen_pi", "Assistant", 4),
+            ("zhi_shi", "Assistant", 4),
+            ("fu_ling", "Assistant", 4),
+        ],
+    ),
+    "An Shen Ding Zhi Wan (variante)": (
+        "Frayeur par Vide du Qi du Coeur",
+        [
+            ("ren_shen", "Empereur", 6),
+            ("fu_ling", "Ministre", 6),
+            ("shi_chang_pu", "Ministre", 6),
+            ("yuan_zhi", "Assistant", 6),
+            ("suan_zao_ren", "Assistant", 5),
+        ],
+    ),
+    "Bai Zi Yang Xin Wan (variante)": (
+        "Insomnie par Vide de Sang du Coeur",
+        [
+            ("bai_zi_ren", "Empereur", 8),
+            ("suan_zao_ren", "Ministre", 6),
+            ("dang_gui", "Ministre", 5),
+            ("shu_di", "Assistant", 5),
+            ("yuan_zhi", "Assistant", 5),
+            ("mai_dong", "Assistant", 4),
+        ],
+    ),
+    "Qi Bao Mei Ran Dan (variante)": (
+        "Vide de l'Essence du Foie et du Rein",
+        [
+            ("he_shou_wu", "Empereur", 8),
+            ("tu_si_zi", "Ministre", 6),
+            ("gou_qi", "Ministre", 6),
+            ("dang_gui", "Assistant", 5),
+            ("niu_xi", "Messager", 4),
+        ],
+    ),
+    "Ju Pi Zhu Ru Tang": (
+        "Hoquet par Vide d'Estomac avec Chaleur",
+        [
+            ("chen_pi", "Empereur", 7),
+            ("zhu_ru", "Empereur", 7),
+            ("ren_shen", "Assistant", 4),
+            ("sheng_jiang", "Assistant", 4),
+            ("da_zao", "Messager", 2),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Xiang Sha Liu Jun Zi Tang": (
+        "Vide de Qi de la Rate avec stagnation et Glaires",
+        [
+            ("ren_shen", "Empereur", 7),
+            ("bai_zhu", "Ministre", 6),
+            ("fu_ling", "Ministre", 6),
+            ("ban_xia", "Assistant", 5),
+            ("chen_pi", "Assistant", 5),
+            ("mu_xiang", "Assistant", 5),
+            ("sha_ren", "Assistant", 5),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Shen Ling Bai Zhu San (variante)": (
+        "Vide de la Rate avec Humidité et diarrhée",
+        [
+            ("ren_shen", "Empereur", 7),
+            ("fu_ling", "Ministre", 6),
+            ("bai_zhu", "Ministre", 6),
+            ("shan_yao", "Assistant", 6),
+            ("yi_yi_ren", "Assistant", 5),
+            ("sha_ren", "Assistant", 4),
+            ("jie_geng", "Messager", 3),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Bai Hu Tang (variante)": (
+        "Chaleur pléthorique du niveau Qi",
+        [
+            ("shi_gao", "Empereur", 9),
+            ("zhi_mu", "Ministre", 7),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Ma Xing Shi Gan Tang": (
+        "Chaleur du Poumon avec dyspnée",
+        [
+            ("ma_huang", "Empereur", 7),
+            ("shi_gao", "Empereur", 8),
+            ("xing_ren", "Ministre", 6),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+    "Zhu Ye Shi Gao Tang (variante)": (
+        "Chaleur résiduelle avec Vide de Qi et de Yin",
+        [
+            ("shi_gao", "Empereur", 8),
+            ("mai_dong", "Ministre", 6),
+            ("ban_xia", "Assistant", 5),
+            ("ren_shen", "Assistant", 4),
+            ("zhu_ru", "Assistant", 4),
+            ("gan_cao", "Messager", 3),
+        ],
+    ),
+}
+
+# formula -> (indication prose, posologie prose) — own-worded usage text
+# templated into every base row so a fresh-boot /ask can QUOTE indication
+# and dosage, not just rankings (VERDICT r4 item 8).
+FORMULA_INFO = {
+    "Si Jun Zi Tang": (
+        "la décoction des quatre gentilshommes traite la fatigue avec "
+        "appétit faible, selles molles et voix sans force — le tableau "
+        "du Vide de Qi de la Rate",
+        "décoction quotidienne en deux prises tièdes, avant les repas",
+    ),
+    "Bu Zhong Yi Qi Tang": (
+        "relève le Qi central effondré: lassitude aggravée à l'effort, "
+        "ptoses d'organes, fièvre légère chronique du surmenage",
+        "décoction en deux prises le matin et à midi; cure de plusieurs "
+        "semaines",
+    ),
+    "Si Wu Tang": (
+        "la décoction des quatre substances nourrit le Sang: teint et "
+        "lèvres pâles, vertiges, règles peu abondantes ou retardées",
+        "décoction quotidienne; en cure d'au moins un cycle menstruel",
+    ),
+    "Tao Hong Si Wu Tang": (
+        "Si Wu Tang animée: règles douloureuses à caillots sombres, "
+        "douleurs fixes par Stase sur fond de Vide de Sang",
+        "décoction quotidienne pendant la période douloureuse",
+    ),
+    "Xiao Yao San": (
+        "la poudre du vagabond insouciant dénoue le Foie et soutient la "
+        "Rate: irritabilité, oppression des flancs, syndrome "
+        "prémenstruel, appétit instable",
+        "poudre 6 à 9 g deux fois par jour, ou décoction équivalente",
+    ),
+    "Liu Wei Di Huang Wan": (
+        "la pilule aux six saveurs nourrit le Yin du Rein: vertiges, "
+        "acouphènes, lombes faibles, transpirations nocturnes",
+        "pilule 6 à 9 g deux fois par jour, en cure prolongée",
+    ),
+    "Qi Ju Di Huang Wan": (
+        "Liu Wei augmentée pour les yeux: vision floue, yeux secs, "
+        "éblouissements sur Vide de Yin du Foie et du Rein",
+        "pilule 6 à 9 g deux fois par jour",
+    ),
+    "Er Chen Tang": (
+        "la décoction des deux ingrédients mûris transforme les "
+        "Glaires-Humidité: toux grasse blanche, nausées, langue à "
+        "enduit gras",
+        "décoction en deux prises après les repas",
+    ),
+    "Yin Qiao San": (
+        "disperse le Vent-Chaleur naissant: fièvre avec mal de gorge, "
+        "soif légère, début d'affection fébrile",
+        "poudre ou décoction courte, toutes les 4 à 6 heures les deux "
+        "premiers jours",
+    ),
+    "Ma Huang Tang": (
+        "libère la surface fermée par le Vent-Froid: fièvre sans "
+        "transpiration, frissons, courbatures, dyspnée",
+        "décoction chaude; arrêter dès que la transpiration vient",
+    ),
+    "Gui Zhi Tang": (
+        "harmonise Ying et Wei quand la surface reste ouverte: fièvre "
+        "légère AVEC transpiration, aversion au vent",
+        "décoction tiède suivie d'une bouillie chaude et de repos "
+        "couvert",
+    ),
+    "Gui Pi Tang": (
+        "restaure ensemble le Qi de la Rate et le Sang du Coeur: "
+        "insomnie du surmenage intellectuel, palpitations, mémoire "
+        "faible, règles abondantes et pâles",
+        "décoction en deux prises, ou pilule 9 g matin et soir",
+    ),
+    "Tian Wang Bu Xin Dan": (
+        "l'élixir du roi céleste nourrit le Yin du Coeur: insomnie "
+        "avec agitation, bouche sèche nocturne, aphtes récidivants",
+        "pilule 9 g au coucher, cure de plusieurs semaines",
+    ),
+    "Huang Lian Jie Du Tang": (
+        "draine le Feu toxique des trois Foyers: fièvre intense avec "
+        "agitation, dysenterie, furoncles, insomnie fébrile",
+        "décoction courte; traitement bref, arrêter dès l'amélioration",
+    ),
+    "Da Cheng Qi Tang": (
+        "purge majeure de la Chaleur liée: constipation opiniâtre, "
+        "abdomen plein, douloureux au toucher, fièvre de plénitude",
+        "décoction avec Da Huang ajouté en fin; usage ponctuel "
+        "uniquement",
+    ),
+    "Sang Ju Yin": (
+        "disperse le Vent-Chaleur léger avec toux: toux sèche "
+        "débutante, fièvre discrète, gorge qui gratte",
+        "décoction courte, deux à trois prises par jour",
+    ),
+    "Ge Gen Tang": (
+        "libère la surface et les muscles de la nuque: rhume avec "
+        "nuque et haut du dos raides, sans transpiration",
+        "décoction chaude en deux prises",
+    ),
+    "Li Zhong Wan": (
+        "réchauffe le Foyer Moyen glacé: douleurs abdominales "
+        "améliorées par la chaleur, diarrhée claire, membres froids",
+        "pilule 9 g ou décoction, deux à trois fois par jour",
+    ),
+    "Jin Gui Shen Qi Wan": (
+        "la pilule du Qi du Rein réchauffe le Yang: lombes et genoux "
+        "froids et faibles, polyurie claire nocturne, frilosité",
+        "pilule 6 à 9 g deux fois par jour, en cure prolongée",
+    ),
+    "Du Huo Ji Sheng Tang (variante)": (
+        "traite les lombalgies chroniques du Vide du Foie et du Rein "
+        "avec Vent-Humidité: douleurs lombaires anciennes aggravées au "
+        "froid, genoux faibles",
+        "décoction quotidienne en cure de plusieurs semaines",
+    ),
+    "Bai He Gu Jin Tang (variante)": (
+        "humidifie le Poumon désséché par le Vide de Yin: toux sèche "
+        "persistante, gorge sèche, filets de sang dans l'expectoration",
+        "décoction en deux prises, loin des repas",
+    ),
+    "Zhi Bai Di Huang Wan": (
+        "Liu Wei renforcée contre la Chaleur-Vide: chaleur des cinq "
+        "coeurs, transpirations nocturnes marquées, fièvre vespérale",
+        "pilule 6 à 9 g deux fois par jour",
+    ),
+    "Xiao Chai Hu Tang": (
+        "harmonise le Shao Yang: alternance de froid et de chaleur, "
+        "bouche amère, nausées, oppression des flancs",
+        "décoction en trois prises réparties dans la journée",
+    ),
+    "Ping Wei San": (
+        "assèche l'Humidité qui encombre le Foyer Moyen: lourdeur "
+        "épigastrique, langue à enduit épais et gras, goût fade",
+        "poudre 3 à 6 g ou décoction, après les repas",
+    ),
+    "Suan Zao Ren Tang": (
+        "nourrit le Foie et calme l'esprit: insomnie d'épuisement avec "
+        "irritabilité, palpitations, gorge sèche nocturne",
+        "décoction le soir, une heure avant le coucher",
+    ),
+    "Sheng Mai San": (
+        "la poudre qui restaure le pouls: essoufflement avec "
+        "transpiration et soif après maladie ou chaleur, voix faible",
+        "décoction ou poudre, deux prises par jour",
+    ),
+    "Ba Zhen Tang": (
+        "les huit trésors tonifient ensemble Qi et Sang: fatigue avec "
+        "pâleur, vertiges, palpitations, convalescence",
+        "décoction quotidienne en cure d'un mois",
+    ),
+    "Shi Quan Da Bu Tang": (
+        "la grande tonification parfaite ajoute la chaleur: Vide de Qi "
+        "et de Sang avec frilosité, plaies qui tardent à refermer",
+        "décoction quotidienne ou pilule, en cure prolongée",
+    ),
+    "Dang Gui Bu Xue Tang": (
+        "deux plantes seulement: le Qi massivement tonifié engendre le "
+        "Sang — fièvre de Vide après hémorragie, fatigue du post-partum",
+        "décoction quotidienne, cinq parts de Huang Qi pour une de "
+        "Dang Gui",
+    ),
+    "Zhen Wu Tang (variante)": (
+        "réchauffe le Yang pour maîtriser l'Eau: oedèmes avec membres "
+        "lourds et froids, urines rares, vertiges",
+        "décoction en deux prises tièdes",
+    ),
+    "Wu Ling San (variante)": (
+        "restaure la transformation des liquides: oedèmes, urines "
+        "rares, soif avec vomissement de l'eau bue",
+        "poudre 6 g ou décoction, trois fois par jour",
+    ),
+    "Xiao Qing Long Tang": (
+        "le petit dragon bleu disperse le Froid externe et les Glaires "
+        "froides: toux à expectoration claire et abondante, dyspnée "
+        "aggravée couché, rhinorrhée claire",
+        "décoction chaude en deux prises",
+    ),
+    "Ban Xia Xie Xin Tang": (
+        "dénoue l'épigastre où Froid et Chaleur se mêlent: plénitude "
+        "sous le sternum sans douleur, nausées, borborygmes avec "
+        "diarrhée",
+        "décoction en deux prises entre les repas",
+    ),
+    "Chai Hu Shu Gan San": (
+        "fait circuler le Qi du Foie noué: douleurs des flancs et de "
+        "l'épigastre, soupirs, humeur sombre, règles irrégulières",
+        "poudre 6 g ou décoction deux fois par jour",
+    ),
+    "Xue Fu Zhu Yu Tang (variante)": (
+        "chasse la Stase du manoir du Sang: douleur thoracique fixe "
+        "et piquante, céphalées anciennes, insomnie opiniâtre",
+        "décoction quotidienne en cure courte renouvelable",
+    ),
+    "Dan Shen Yin (variante)": (
+        "anime le Sang et mobilise le Qi à l'épigastre: douleur "
+        "épigastrique ou thoracique fixe, aggravée la nuit",
+        "décoction en deux prises",
+    ),
+    "Jing Fang Bai Du San (variante)": (
+        "libère la surface du Vent-Froid-Humidité: frissons sans "
+        "transpiration, courbatures lourdes, céphalée en casque",
+        "décoction chaude dès les premiers frissons",
+    ),
+    "Qiang Huo Sheng Shi Tang (variante)": (
+        "chasse le Vent-Humidité du haut du dos: nuque et épaules "
+        "raides et douloureuses, lourdeur de la tête",
+        "décoction en deux prises chaudes",
+    ),
+    "San Miao Wan (variante)": (
+        "assèche la Chaleur-Humidité descendue: genoux chauds et "
+        "gonflés, jambes lourdes, leucorrhées jaunes",
+        "pilule 6 g deux fois par jour",
+    ),
+    "Yin Chen Hao Tang": (
+        "fait disparaître l'ictère par Chaleur-Humidité: peau et yeux "
+        "jaune vif, urines foncées, abdomen plein",
+        "décoction quotidienne jusqu'à décoloration franche des urines",
+    ),
+    "Wen Dan Tang": (
+        "réchauffe la Vésicule en clarifiant les Glaires: insomnie "
+        "avec sursauts, vertiges, nausées, indécision anxieuse",
+        "décoction en deux prises dont une au coucher",
+    ),
+    "Qing Qi Hua Tan Wan (variante)": (
+        "clarifie le Qi et dissout les Glaires-Chaleur: toux à "
+        "expectoration jaune et épaisse, oppression, visage rouge",
+        "pilule 6 à 9 g deux fois par jour",
+    ),
+    "An Shen Ding Zhi Wan (variante)": (
+        "stabilise l'esprit effrayé: sursauts au moindre bruit, "
+        "sommeil peuplé de rêves, palpitations du Vide de Qi du Coeur",
+        "pilule 9 g au coucher",
+    ),
+    "Bai Zi Yang Xin Wan (variante)": (
+        "nourrit le Coeur par le Sang: insomnie avec palpitations et "
+        "transpirations nocturnes, constipation sèche associée",
+        "pilule 9 g le soir, cure de plusieurs semaines",
+    ),
+    "Qi Bao Mei Ran Dan (variante)": (
+        "l'élixir des sept trésors nourrit l'Essence: cheveux blancs "
+        "précoces, chute de cheveux, lombes faibles, vieillissement "
+        "prématuré",
+        "pilule 6 à 9 g deux fois par jour, cure longue",
+    ),
+    "Ju Pi Zhu Ru Tang": (
+        "abaisse le Qi rebelle de l'Estomac affaibli: hoquet ou "
+        "éructations persistantes après maladie, chaleur légère",
+        "décoction en prises fractionnées dans la journée",
+    ),
+    "Xiang Sha Liu Jun Zi Tang": (
+        "les six gentilshommes augmentés mobilisent ce que le Vide "
+        "laisse stagner: digestion lente et douloureuse, ballonnement "
+        "après les repas, nausées",
+        "décoction en deux prises avant les repas",
+    ),
+    "Shen Ling Bai Zhu San (variante)": (
+        "renforce la Rate et sèche la diarrhée chronique: selles "
+        "molles récidivantes, fatigue, membres lourds",
+        "poudre 6 g avec une bouillie de riz, deux fois par jour",
+    ),
+    "Bai Hu Tang (variante)": (
+        "le tigre blanc éteint la Chaleur du niveau Qi: les quatre "
+        "grands — grande fièvre, grande soif, grande transpiration, "
+        "grand pouls",
+        "décoction prolongée de gypse; réservée aux tableaux de "
+        "plénitude",
+    ),
+    "Ma Xing Shi Gan Tang": (
+        "clarifie le Poumon enflammé et calme le souffle: toux "
+        "brûlante avec dyspnée, fièvre, soif, avec ou sans "
+        "transpiration",
+        "décoction en deux à trois prises",
+    ),
+    "Zhu Ye Shi Gao Tang (variante)": (
+        "éteint la Chaleur résiduelle en soutenant les liquides: "
+        "fièvre traînante après maladie, soif, langue rouge et sèche, "
+        "nausées",
+        "décoction tiède en trois prises",
+    ),
 }
 
 # syndrome -> extra (plant, score) affinities beyond its formula's herbs —
@@ -427,17 +1565,53 @@ EXTRA_AFFINITIES = {
 
 
 def write_base(path: str) -> int:
+    """Denormalized (syndrome, formule, plante) rows WITH the monograph
+    and formula prose — the columns a retrieval hit can quote (indication,
+    posologie, contre-indications), mirroring the informational density of
+    the reference's 34-column base (``indexer.py:79-89``) in this repo's
+    own schema and words."""
     rows = 0
     with open(path, "w", newline="", encoding="utf-8") as f:
         w = csv.writer(f)
         w.writerow(
-            ["nom_syndrome", "nom_formule", "nom_latin", "role", "score_role"]
+            [
+                "nom_syndrome", "nom_formule", "nom_latin", "nom_chinois",
+                "role", "score_role", "nature_plante", "saveur_plante",
+                "tropisme_plante", "indications_plante", "posologie_plante",
+                "contre_indications_plante", "indication_formule",
+                "posologie_formule",
+            ]
         )
         for formula, (syndrome, comp) in FORMULAS.items():
+            f_ind, f_pos = FORMULA_INFO[formula]
             for key, role, score in comp:
-                latin, _ = PLANTS[key]
-                w.writerow([syndrome, formula, latin, role, score])
+                latin, pinyin = PLANTS[key]
+                nature, saveur, trop, ind, pos, ci = MONOGRAPHS[key]
+                w.writerow(
+                    [
+                        syndrome, formula, latin, pinyin, role, score,
+                        nature, saveur, trop, ind, pos, ci, f_ind, f_pos,
+                    ]
+                )
                 rows += 1
+    return rows
+
+
+def write_monographs(path: str) -> int:
+    """One monograph row per herb: the single-plant reference view."""
+    rows = 0
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(
+            [
+                "nom_latin", "nom_chinois", "nature", "saveur", "tropisme",
+                "indications", "posologie", "contre_indications",
+            ]
+        )
+        for key, (latin, pinyin) in PLANTS.items():
+            nature, saveur, trop, ind, pos, ci = MONOGRAPHS[key]
+            w.writerow([latin, pinyin, nature, saveur, trop, ind, pos, ci])
+            rows += 1
     return rows
 
 
@@ -472,9 +1646,12 @@ def main() -> None:
     n_mat = write_matrice(
         os.path.join(OUT_DIR, "matrice_plante_syndrome.csv")
     )
+    n_mono = write_monographs(
+        os.path.join(OUT_DIR, "monographies_plantes.csv")
+    )
     print(
-        f"wrote {n_base} base rows + {n_mat} matrice rows = "
-        f"{n_base + n_mat} total to {OUT_DIR}"
+        f"wrote {n_base} base rows + {n_mat} matrice rows + {n_mono} "
+        f"monograph rows = {n_base + n_mat + n_mono} total to {OUT_DIR}"
     )
 
 

