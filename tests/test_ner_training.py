@@ -168,20 +168,67 @@ class TestContextualPHI:
         assert ev["per_entity"]["EMAIL_ADDRESS"]["f1"] >= 0.99, ev
         assert ev["per_entity"]["DATE_TIME"]["recall"] >= 0.99, ev
 
+    def test_pattern_precision_on_clinical_register(self):
+        """The broadened date/person/NRP patterns must NOT corrupt
+        common clinical constructions (verb+number, 'Pt. Denies',
+        sentence-boundary initials, dotted organisms, French etiology)
+        while still catching the shapes they were added for."""
+        eng = DeidEngine(CFG, use_ner_model=False)
+        untouched = (
+            "dose decreased 3 mg this week.",
+            "seen on 2 separate occasions.",
+            "patient marched 5 km daily.",
+            "Pt. Denies chest pain.",
+            "Pt Tolerating PO intake.",
+            "Plan B. Follow up next week.",
+            "Culture grew E. Coli positive.",
+            "I.V. Fluids started overnight.",
+            "Embolie d'origine cardiaque suspectée.",
+            "Fièvre d'origine inconnue depuis trois jours.",
+        )
+        for text in untouched:
+            assert eng.anonymize(text) == text, eng.anonymize(text)
+        caught = (
+            ("0800 rounds: pt J. Castellano resting.", "<PERSON>"),
+            ("Consent witnessed by Beatrice Lindqvist, RN.", "<PERSON>"),
+            ("Patient d'origine kabyle, suivi à Toulouse.", "<NRP>"),
+            ("follow-up scheduled for May 21st.", "<DATE_TIME>"),
+            ("records transfer by the end of August.", "<DATE_TIME>"),
+            ("call me back before Friday.", "<DATE_TIME>"),
+            ("revu le 3 juin 2026 en consultation.", "<DATE_TIME>"),
+        )
+        for text, token in caught:
+            assert token in eng.anonymize(text), (text, eng.anonymize(text))
+        # both endpoints of a transfer are cued
+        spans = eng.analyze(
+            "transferred from Mercy General to Oakdale Manor today."
+        )
+        locs = {
+            "transferred from Mercy General to Oakdale Manor today."[
+                s.start : s.end
+            ]
+            for s in spans
+            if s.entity_type == "LOCATION"
+        }
+        assert {"Mercy General", "Oakdale Manor"} <= locs, locs
+
     def test_dev_test_split_evaluation(self, engine):
         """VERDICT r4 item 5: the reported deid quality must come from
         spans never used to pick the served threshold.  The split scorer
         returns dev (threshold-selection) and test (held-out) metrics
         with a bootstrap CI; floors here are calibrated on the in-test
-        550-step tagger (measured: test span_recall 0.72, char F1 0.65)
-        — the bench's fully-trained tagger reports its own numbers."""
+        550-step tagger + pattern/cue recognizers (measured: test
+        span_recall 0.97, char F1 0.90, entity F1 0.93) with slack for
+        training drift — the bench's fully-trained tagger reports its
+        own numbers."""
         from docqa_tpu.deid.evalset import evaluate_deid_split
 
         ev = evaluate_deid_split(engine, n_boot=100)
         assert ev["dev"]["gold_spans"] + ev["test"]["gold_spans"] >= 100
         assert ev["test"]["gold_spans"] >= 60
-        assert ev["test"]["span_recall_any"] >= 0.6, ev["test"]
-        assert ev["test"]["char_f1"] >= 0.5, ev["test"]
+        assert ev["test"]["span_recall_any"] >= 0.85, ev["test"]
+        assert ev["test"]["char_f1"] >= 0.75, ev["test"]
+        assert ev["test"]["entity_f1"] >= 0.70, ev["test"]
         lo, hi = ev["test"]["entity_f1_ci95"]
         assert lo <= ev["test"]["entity_f1"] <= hi
         # pattern-backed entities stay near-perfect on the held-out
