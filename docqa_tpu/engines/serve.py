@@ -507,15 +507,20 @@ class ContinuousBatcher:
         dispatch (async — no device sync; the round is finalized with one
         host fetch in ``_finalize_admissions``).
 
-        The batch axis is always padded to ``n_slots`` — a batch-16 prefill
-        forward costs barely more device time than batch-1 (the weight read
-        dominates), and a single batch shape per prompt bucket means every
-        program the loaded path needs is compiled by one warm round (batch
-        buckets would leave sizes 2..8 to jit-compile *inside* a latency
-        measurement the first time slots retire raggedly).  Padding lanes
-        scatter out of bounds (dropped) and their sampled tokens are
-        ignored.  A request whose prompt cannot be marshalled fails alone,
-        before the dispatch — not with the whole round."""
+        The batch axis pads to ONE of two shapes: a narrow trickle shape
+        (4) when the round admits <=4 requests, else the full ``n_slots``.
+        Always-``n_slots`` (the round-4 policy) made every open-loop
+        admission round pay the full-width prefill compute — at a
+        512-token bucket that is ~B×bucket tokens of forward FLOPs per
+        round regardless of how few requests arrived, and the r05
+        open-loop run (arrivals every 62 ms, 1-2 admits per round)
+        measured it as the throughput wall (docs/PERF.md §5).  Two
+        shapes per prompt bucket keeps the compile surface bounded —
+        the original rationale for a single shape — and the trickle
+        shape cuts the per-arrival prefill cost by n_slots/4.  Padding
+        lanes scatter out of bounds (dropped) and their sampled tokens
+        are ignored.  A request whose prompt cannot be marshalled fails
+        alone, before the dispatch — not with the whole round."""
         # Truncation limit mirrors the budget formula in
         # _finalize_admissions (cache_len - n_ids - 1 - spec_k) with one
         # extra row reserved, so a maximally-long prompt still gets
@@ -543,7 +548,7 @@ class ContinuousBatcher:
             else round_up(longest, 128),
             usable,
         )
-        B = self.n_slots
+        B = 4 if len(good) <= 4 and self.n_slots > 4 else self.n_slots
         padded = np.full((B, bucket), self.gen.pad_id, np.int32)
         lengths = np.ones((B,), np.int32)
         slots_arr = np.full((B,), self.n_slots, np.int32)  # OOB == dropped
