@@ -62,8 +62,13 @@ _PHONE_RE = re.compile(
 # the no-year date forms below those become DATE_TIME masks corrupting
 # clinical content ("dose <DATE_TIME> mg").
 _MONTH = (
+    # "May" stays CASE-SENSITIVE inside the otherwise-IGNORECASE date
+    # pattern ((?-i:...) group-local flag): with the year optional,
+    # lowercase auxiliary "may" would turn "The dose of 3 may be
+    # reduced" into a DATE_TIME mask.  French "mai" has no auxiliary
+    # reading and stays case-insensitive.
     r"(?:jan(?:\.|uary)?|feb(?:\.|ruary)?|mar(?:\.|ch)?|apr(?:\.|il)?"
-    r"|may|jun[.e]?|jul[.y]?|aug(?:\.|ust)?|sep(?:t?\.|t|tember)?"
+    r"|(?-i:May)|jun[.e]?|jul[.y]?|aug(?:\.|ust)?|sep(?:t?\.|t|tember)?"
     r"|oct(?:\.|ober)?|nov(?:\.|ember)?|dec(?:\.|ember)?"
     r"|janvier|f[ée]vrier|mars|avril|mai|juin|juillet|ao[ûu]t"
     r"|septembre|octobre|novembre|d[ée]cembre)"
@@ -103,22 +108,28 @@ _PERSON_CUE_RE = re.compile(
 # "pt <Name>" separately: "Pt. Denies chest pain" opens with a
 # capitalized VERB far more often than a name, so the pt cue demands at
 # least TWO capitalized tokens ("pt J. Castellano", "pt Rosa Delgado")
+# case-insensitivity scoped to the CUE only — a module-level IGNORECASE
+# would let the [A-Z] token classes match lowercase and mask ordinary
+# prose ("pt reported severe dizziness" -> "pt <PERSON>")
 _PT_NAME_RE = re.compile(
-    r"\bpt\.?\s+"
-    r"((?:[A-Z](?:[\w'’-]+|\.))(?:\s+[A-Z](?:[\w'’-]+|\.)){1,2})",
-    re.IGNORECASE,
+    r"\b(?i:pt)\.?\s+"
+    r"((?:[A-Z](?:[\w'’-]+|\.))(?:\s+[A-Z](?:[\w'’-]+|\.)){1,2})"
 )
 
 
-def _plausible_person_span(span: str) -> bool:
+def _plausible_person_span(span: str, require_lower: bool = True) -> bool:
     """Structural sanity for pattern-proposed PERSON spans: at least one
     token must carry a lowercase letter (rejects 'PO', 'I.V.'-only), and
     no token may be deny-listed ('Follow', 'Coli', 'Fluids', 'Denies' —
-    sentence openers and clinical abbreviations are never surnames)."""
+    sentence openers and clinical abbreviations are never surnames).
+
+    ``require_lower=False`` for the title cue: 'Dr. LEE' in a signature
+    block is a real all-caps surname — the honorific is evidence enough,
+    and dropping it would be a PHI leak."""
     toks = re.findall(r"[\w'’.-]+", span)
     if not toks:
         return False
-    if not any(any(c.islower() for c in t) for t in toks):
+    if require_lower and not any(any(c.islower() for c in t) for t in toks):
         return False
     return not any(t.rstrip(".").lower() in _NER_DENY_WORDS for t in toks)
 # Initialed names ("A. J. Vandenberg", "J. Castellano"): a synthetic-data
@@ -185,17 +196,29 @@ _NRP_CUE_RE = re.compile(
 
 # French etiology adjectives after "d'origine" — the MEDICAL sense of the
 # phrase, never an ethnicity; masking them would corrupt clinical content
-# ("embolie d'origine <NRP>")
+# ("AVC d'origine <NRP>").  The -ique/-euse/-eux suffix classes are
+# checked structurally (ischémique, embolique, néoplasique, infectieux,
+# ... — the etiology vocabulary is open-ended and overwhelmingly lands
+# in these suffixes); the explicit list covers the rest.  Known
+# trade-off: a nationality adjective in -ique ("britannique") is then
+# NOT masked by this cue — rare in French clinical prose, and the NER
+# tagger still gets its own vote on the span.
 _NRP_ETIOLOGY_FR = frozenset(
-    "inconnue indéterminée indeterminee cardiaque infectieuse virale "
-    "bactérienne bacterienne médicamenteuse medicamenteuse traumatique "
+    "inconnue indéterminée indeterminee virale "
+    "cardiaque coeliaque bactérienne bacterienne pulmonaire coronaire "
+    "médicamenteuse medicamenteuse "
     "inflammatoire tumorale dégénérative degenerative iatrogène iatrogene "
-    "centrale périphérique peripherique mixte alimentaire toxique "
-    "professionnelle métabolique metabolique vasculaire neurologique "
-    "musculaire osseuse digestive rénale renale hépatique hepatique "
-    "pulmonaire allergique auto-immune immunitaire génétique genetique "
-    "congénitale congenitale idiopathique".split()
+    "centrale mixte alimentaire "
+    "professionnelle vasculaire "
+    "musculaire osseuse digestive rénale renale "
+    "auto-immune immunitaire "
+    "congénitale congenitale multifactorielle".split()
 )
+
+
+def _is_etiology_fr(word: str) -> bool:
+    w = word.lower()
+    return w in _NRP_ETIOLOGY_FR or w.endswith(("ique", "euse", "eux"))
 
 _MIN_PHONE_DIGITS = 7
 
@@ -302,14 +325,14 @@ def _pattern_results(text: str) -> List[RecognizerResult]:
             out.append(
                 RecognizerResult("PHONE_NUMBER", m.start(), m.end(), 1.05)
             )
-    for person_re in (
-        _PERSON_TITLE_RE,
-        _PERSON_INITIALS_RE,
-        _PERSON_CUE_RE,
-        _PT_NAME_RE,
+    for person_re, need_lower in (
+        (_PERSON_TITLE_RE, False),  # "Dr. LEE": honorific is evidence
+        (_PERSON_INITIALS_RE, True),
+        (_PERSON_CUE_RE, True),
+        (_PT_NAME_RE, True),
     ):
         for m in person_re.finditer(text):
-            if _plausible_person_span(m.group(1)):
+            if _plausible_person_span(m.group(1), require_lower=need_lower):
                 out.append(
                     RecognizerResult("PERSON", m.start(1), m.end(1), 0.75)
                 )
@@ -326,8 +349,9 @@ def _pattern_results(text: str) -> List[RecognizerResult]:
         for g in range(1, (m.lastindex or 0) + 1):
             if m.group(g) is None:
                 continue
-            # "d'origine cardiaque/inconnue" is etiology, not ethnicity
-            if m.group(g).lower() in _NRP_ETIOLOGY_FR:
+            # "d'origine cardiaque/ischémique/inconnue" is etiology,
+            # not ethnicity
+            if _is_etiology_fr(m.group(g)):
                 continue
             out.append(
                 RecognizerResult("NRP", m.start(g), m.end(g), 1.02)

@@ -185,11 +185,18 @@ class TestContextualPHI:
             "I.V. Fluids started overnight.",
             "Embolie d'origine cardiaque suspectée.",
             "Fièvre d'origine inconnue depuis trois jours.",
+            "AVC d'origine ischémique confirmé.",
+            "pt reported severe dizziness overnight.",
+            "pt verbalized understanding of the plan.",
+            "The dose of 3 may be reduced.",
+            "Increase to 10 may help symptoms.",
         )
         for text in untouched:
             assert eng.anonymize(text) == text, eng.anonymize(text)
         caught = (
             ("0800 rounds: pt J. Castellano resting.", "<PERSON>"),
+            ("Dr. LEE on call tonight per signature block.", "<PERSON>"),
+            ("Seen by Dr. Smith on 3 May 2026.", "<DATE_TIME>"),
             ("Consent witnessed by Beatrice Lindqvist, RN.", "<PERSON>"),
             ("Patient d'origine kabyle, suivi à Toulouse.", "<NRP>"),
             ("follow-up scheduled for May 21st.", "<DATE_TIME>"),
