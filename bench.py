@@ -50,6 +50,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -239,7 +240,7 @@ def _device_backend_alive_retrying(
     return False
 
 
-def _start_stall_watchdog(stall_min: float = 10.0) -> None:
+def _start_stall_watchdog(stall_min: Optional[float] = None) -> None:
     """Abort (exit 3) if NO section lands a measurement for ``stall_min``
     minutes.
 
@@ -251,7 +252,13 @@ def _start_stall_watchdog(stall_min: float = 10.0) -> None:
     section writes there, and the corpus loop writes per-block
     breadcrumbs); on stall the watchdog flushes what was measured and
     exits 3 so the outer wrapper (``_run_with_fallback``) can still get
-    the driver its one JSON line from a CPU smoke rerun."""
+    the driver its one JSON line from a CPU smoke rerun.
+
+    Default 10 min; ``DOCQA_BENCH_STALL_MIN`` raises it for in-session
+    runs whose long single calls (multi-million-row IVF builds, beam
+    compiles) are legitimate silent stretches."""
+    if stall_min is None:
+        stall_min = float(os.environ.get("DOCQA_BENCH_STALL_MIN", "10"))
     import threading
 
     def snap() -> str:
@@ -1357,12 +1364,16 @@ def main() -> None:
                 )
                 DETAILS["ivf_scale_ingest"] = f"{target_n}:{start + n}"
             t_ing = time.perf_counter() - t0
+            # clusters capped: the full-corpus assignment pass scales with
+            # n x C, and the crossover question is about SEARCH latency,
+            # not k-means asymptotics — C=2000 at 4M keeps the build in
+            # minutes while a 32-probe still scans ~5% of the corpus
             tiered = TieredIndex(
                 big,
                 nprobe=32,
                 min_rows=10_000,
                 rebuild_tail_rows=10 * target_n,
-                n_clusters=int(np.sqrt(target_n)) * 2,
+                n_clusters=min(2000, int(np.sqrt(target_n))),
             )
             t0 = time.perf_counter()
             tiered.rebuild()
