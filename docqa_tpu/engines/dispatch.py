@@ -1,0 +1,58 @@
+"""Shared dispatch discipline for fused programs over donated buffers.
+
+The vector store's ``add()`` donates its device buffers, so any program
+that reads them must either dispatch under ``store._lock`` or hold a
+consistent snapshot and handle the (rare) donation race.  Dispatching
+under the lock is wrong for FIRST calls: XLA tracing+compile of a fused
+program (which embeds the encoder forward) takes seconds and would stall
+every concurrent index/search (ADVICE r4).  This module holds the ONE
+copy of the snapshot-outside/retry-under-lock discipline used by
+``FusedRetriever.search_texts`` and ``FusedRAG.ask_submit`` — the two
+must never drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+
+def _is_deleted_buffer_error(e: Exception) -> bool:
+    """True only for the use-after-donation failure mode (jax raises
+    RuntimeError mentioning the deleted/donated buffer).  Anything else —
+    compile failure, device OOM — must propagate: retrying it under the
+    lock would repeat a multi-second compile while holding up every
+    concurrent store caller, the exact stall this module exists to
+    avoid."""
+    msg = str(e).lower()
+    return "deleted" in msg or "donated" in msg
+
+
+def dispatch_with_donation_retry(
+    lock,
+    snapshot_and_build: Callable[[], Tuple[Optional[Callable], Any]],
+):
+    """Run ``fn(*args)`` from a consistent snapshot, compiling OUTSIDE the
+    lock.
+
+    ``snapshot_and_build`` must acquire ``lock`` internally, read a
+    consistent view of the store, and return ``(fn, args)`` — or
+    ``(None, None)`` when there is nothing to search (caller maps that to
+    its empty result).  The first dispatch runs unlocked: the snapshot's
+    Python refs keep the buffers alive, and if an ``add()`` donates them
+    mid-compile the dispatch raises immediately (deleted-buffer check)
+    and the retry re-snapshots AND re-dispatches fully under the lock —
+    which excludes adds, and is cheap because the program cache is warm
+    by then.  ``lock`` must be re-entrant (the store's RLock)."""
+    fn, args = snapshot_and_build()
+    if fn is None:
+        return None
+    try:
+        return fn(*args)
+    except RuntimeError as e:
+        if not _is_deleted_buffer_error(e):
+            raise
+        with lock:
+            fn, args = snapshot_and_build()
+            if fn is None:
+                return None
+            return fn(*args)

@@ -46,6 +46,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.index.store import NEG_INF, SearchResult, _search_single
 from docqa_tpu.models.encoder import encode_batch
@@ -351,15 +352,9 @@ class FusedRAG:
             usable,
         )
         def snapshot_and_build():
-            """Consistent (fn, args) from ONE lock acquisition.  The jit
-            dispatch itself happens OUTSIDE the lock: the snapshot's
-            Python refs keep the device buffers alive, and the only
-            hazard — an ``add()`` donating the vector/sidecar buffers
-            between snapshot and dispatch — raises immediately at call
-            time (deleted-buffer check), which the retry below handles.
-            This keeps XLA tracing+compile of the fused program (seconds,
-            embedding the encoder forward) from stalling every concurrent
-            index/search under ``store._lock`` (ADVICE r4)."""
+            """Consistent (fn, args) from ONE lock acquisition; the
+            dispatch discipline (compile outside the lock, donation-race
+            retry under it — ADVICE r4) lives in ``engines.dispatch``."""
             with store._lock:
                 count = store._count
                 if count == 0:
@@ -388,18 +383,9 @@ class FusedRAG:
             return fn, args
 
         with span("fused_rag_pack", DEFAULT_REGISTRY):
-            fn, args = snapshot_and_build()
-            try:
-                prompt, total, vals, row_ids = fn(*args)
-            except RuntimeError:
-                # donation race: an add() consumed the snapshot's buffers
-                # mid-compile/dispatch.  Retry with BOTH snapshot and
-                # dispatch under the lock (RLock — reentrant), which
-                # excludes adds entirely; the program cache is warm now,
-                # so the held-lock dispatch is microseconds, not seconds.
-                with store._lock:
-                    fn, args = snapshot_and_build()
-                    prompt, total, vals, row_ids = fn(*args)
+            prompt, total, vals, row_ids = dispatch_with_donation_retry(
+                store._lock, snapshot_and_build
+            )
         # prefill+decode chained on the device-side prompt — no sync between
         gfn = gen._get_fn(
             1, l_bucket, max_new, greedy=gen.gen.temperature == 0.0

@@ -32,6 +32,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.index.store import (
     SearchResult,
@@ -139,31 +140,39 @@ class FusedRetriever:
             batch_buckets=QUERY_BATCH_BUCKETS,
         )
 
-        # Dispatch under the store lock: add() donates the device buffer
-        # (same discipline as store.search).
-        with store._lock:
-            count = store._count
-            if count == 0:
-                return [[] for _ in texts]
-            k_eff = min(k, count)
-            mask = None
-            if filters:
-                mask = store._filter_mask_locked(filters)
-            mask = store._compose_live_locked(
-                mask, already_live=bool(filters)
+        def snapshot_and_build():
+            """Consistent (fn, args) from ONE lock acquisition; the
+            dispatch discipline lives in ``engines.dispatch``."""
+            with store._lock:
+                count = store._count
+                if count == 0:
+                    return None, None
+                k_eff = min(k, count)
+                mask = None
+                if filters:
+                    mask = store._filter_mask_locked(filters)
+                mask = store._compose_live_locked(
+                    mask, already_live=bool(filters)
+                )
+                fn = self._get_fn(k_eff, masked=mask is not None)
+                args = [
+                    self.encoder.params,
+                    jnp.asarray(ids_p),
+                    jnp.asarray(len_p),
+                    store._dev,
+                    jnp.int32(count),
+                ]
+                if mask is not None:
+                    args.append(jnp.asarray(mask))
+            return fn, args
+
+        with span("fused_query", DEFAULT_REGISTRY):
+            out = dispatch_with_donation_retry(
+                store._lock, snapshot_and_build
             )
-            fn = self._get_fn(k_eff, masked=mask is not None)
-            args = [
-                self.encoder.params,
-                jnp.asarray(ids_p),
-                jnp.asarray(len_p),
-                store._dev,
-                jnp.int32(count),
-            ]
-            if mask is not None:
-                args.append(jnp.asarray(mask))
-            with span("fused_query", DEFAULT_REGISTRY):
-                vals, row_ids, _emb = fn(*args)
+        if out is None:  # empty store
+            return [[] for _ in texts]
+        vals, row_ids, _emb = out
         vals = np.asarray(vals)[:n]
         row_ids = np.asarray(row_ids)[:n]
         return store.assemble_results(vals, row_ids)

@@ -120,6 +120,46 @@ class TestFusedMatchesTwoStep:
         assert rows[0].metadata["text_content"] in texts
 
 
+class TestDispatchDiscipline:
+    def test_donation_retry_and_error_propagation(self):
+        """The shared snapshot/retry helper: a deleted-buffer RuntimeError
+        retries once fully under the lock; any other RuntimeError
+        propagates without a locked retry (re-running a failed compile
+        under the store lock would stall every concurrent caller)."""
+        import threading
+
+        from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
+
+        lock = threading.RLock()
+        calls = []
+
+        def snap():
+            calls.append("snap")
+
+            def fn(x):
+                calls.append("run")
+                if calls.count("run") == 1:
+                    raise RuntimeError("Array has been deleted.")
+                return x + 1
+
+            return fn, (1,)
+
+        assert dispatch_with_donation_retry(lock, snap) == 2
+        assert calls == ["snap", "run", "snap", "run"]
+
+        def snap_err():
+            def fn():
+                raise RuntimeError("XLA compilation failure: OOM")
+
+            return fn, ()
+
+        with pytest.raises(RuntimeError, match="compilation"):
+            dispatch_with_donation_retry(lock, snap_err)
+
+        # empty-store sentinel passes through
+        assert dispatch_with_donation_retry(lock, lambda: (None, None)) is None
+
+
 class TestFusedTiered:
     """FusedTieredRetriever: encode + IVF probe + tail scan in one program
     must rank exactly like the two-step encode -> TieredIndex.search."""
