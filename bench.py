@@ -609,7 +609,7 @@ def main() -> None:
             + (f", HBM util {hbm_util:.0%}" if hbm_util else "")
         )
 
-    def measure_fused(engine, tag):
+    def measure_fused(engine, tag, extra=None):
         # single-sync ask: retrieval -> device-side prompt pack -> decode
         # chained with no intermediate fetch (engines/rag_fused.py); the
         # classic path above pays one extra sync for the chunk texts.
@@ -631,6 +631,7 @@ def main() -> None:
             "p50_ms": round(p50f, 2),
             "p95_ms": round(p95f, 2),
             "new_tokens": max_new,
+            **(extra or {}),
         }
         log(f"{tag}: p50 {p50f:.1f}ms p95 {p95f:.1f}ms")
         return p50f, p95f
@@ -640,10 +641,19 @@ def main() -> None:
     # is HBM-bandwidth bound, so halving the weight bytes read per step is
     # the biggest latency lever.  The 7B class (BASELINE config 3's model
     # class) is the headline; speculation k=8 was the measured winner of
-    # the r04 sweep (573 ms vs 617 at k=4, 1007 at k=0) — the k=4
-    # comparator re-measures post-headline.
+    # both the r04 sweep (573 vs 617 ms at k=4) and r05 (754 vs 805) —
+    # the k=4 comparator re-measures post-headline.
+    #
+    # The headline PATH is the fused single-sync ask (engines/rag_fused.py)
+    # — it is what QAService actually serves an interactive /ask with when
+    # the batcher is idle, and with the equal-context corpus it measured
+    # faster than the classic two-sync path at both model classes (r05:
+    # 579 vs 754 ms at 7B, 285 vs 387 at 1.1B; docs/PERF.md §1).  The
+    # classic path is measured post-headline as the A/B comparator; any
+    # fused failure falls back to classic BEFORE the line prints.
     S: dict = {"gen8": None, "params8": None, "gen1": None}
     p50 = p95 = None
+    head_engine = None
     if not small:
         try:
             from docqa_tpu.models.quant import init_quantized_decoder_params
@@ -666,47 +676,58 @@ def main() -> None:
                 params=S["params8"],
             )
             dispatch_health("before_headline")
-            p50, p95 = measure_e2e(
-                S["gen8"],
-                q_texts[2 : 2 + n_e2e],
-                f"HEADLINE 7B-int8 spec_k={HEAD_SPEC_K}",
-            )
-            DETAILS["qa_e2e_7b_int8"] = {
-                "p50_ms": round(p50, 2),
-                "p95_ms": round(p95, 2),
-                "new_tokens": max_new,
-                "decoder": "mistral-7b-class-int8",
-                "speculative_k": HEAD_SPEC_K,
-                "context": "3 x 60-120-token chunks (realistic pool)",
-                "attempts": [
-                    {
-                        "speculative_k": HEAD_SPEC_K,
-                        "p50_ms": round(p50, 2),
-                        "p95_ms": round(p95, 2),
-                    }
-                ],
-            }
-            DETAILS["headline_config"] = "qa_e2e_7b_int8"
+            head_engine = S["gen8"]
         except Exception as e:
-            log(f"7B headline failed, falling back to 1.1B-int8: {e!r}")
+            log(f"7B init failed, falling back to 1.1B-int8: {e!r}")
             DETAILS["qa_e2e_7b_int8"] = {"error": repr(e)[:500]}
             S["gen8"] = S["params8"] = None
             gc.collect()
-    if p50 is None:
-        # small mode, or the 7B path failed: the 1.1B-int8 serving class
+    if head_engine is None:
+        # small mode, or the 7B init failed: the 1.1B-int8 serving class
         S["gen1"] = GenerateEngine(
             dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
         )
-        p50, p95 = measure_e2e(
-            S["gen1"], q_texts[2 : 2 + n_e2e], "headline (1.1B/smoke int8)"
+        head_engine = S["gen1"]
+    head_name = "7b_int8" if head_engine is S["gen8"] else "1b_int8"
+    head_decoder = (
+        "mistral-7b-class-int8"
+        if head_name == "7b_int8"
+        else f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8"
+    )
+    head_provenance = {
+        "decoder": head_decoder,
+        "speculative_k": head_engine.gen.speculative_k,
+        "context": "3 x 60-120-token chunks (realistic pool)",
+    }
+    try:
+        p50, p95 = measure_fused(
+            head_engine, f"qa_e2e_{head_name}_fused", extra=head_provenance
         )
-        DETAILS["qa_e2e"] = {
+        DETAILS["headline_config"] = f"qa_e2e_{head_name}_fused"
+        log(f"HEADLINE fused {head_name}: p50 {p50:.1f}ms")
+    except Exception as e:
+        log(f"fused headline failed, classic path takes the line: {e!r}")
+        DETAILS[f"qa_e2e_{head_name}_fused"] = {"error": repr(e)[:300]}
+        p50, p95 = measure_e2e(
+            head_engine,
+            q_texts[2 : 2 + n_e2e],
+            f"HEADLINE classic {head_name}",
+        )
+        key = "qa_e2e_7b_int8" if head_name == "7b_int8" else "qa_e2e"
+        DETAILS[key] = {
             "p50_ms": round(p50, 2),
             "p95_ms": round(p95, 2),
             "new_tokens": max_new,
-            "decoder": f"{dec_cfg.hidden_dim}x{dec_cfg.num_layers}-int8",
+            **head_provenance,
+            "attempts": [
+                {
+                    "speculative_k": head_engine.gen.speculative_k,
+                    "p50_ms": round(p50, 2),
+                    "p95_ms": round(p95, 2),
+                }
+            ],
         }
-        DETAILS["headline_config"] = "qa_e2e"
+        DETAILS["headline_config"] = key
 
     # ---- EMIT THE ONE LINE (before everything else) -------------------------
     # A CPU fallback run must be UNMISTAKABLE in the one line the driver
@@ -919,6 +940,40 @@ def main() -> None:
             measure_decode(S["gen8"], "decode_7b_int8", "config3c 7B int8")
             DETAILS["decode_7b_int8"]["includes_prefill"] = 512
 
+        def sec_classic_7b():
+            # the classic two-sync path: the fused headline's A/B
+            # comparator (equal context — same pool chunks both ways)
+            if "p50_ms" in DETAILS.get("qa_e2e_7b_int8", {}):
+                return  # headline fell back to classic; already measured
+            p50c, p95c = measure_e2e(
+                S["gen8"], q_texts[2 : 2 + n_e2e], "7B-int8 classic spec_k=8"
+            )
+            DETAILS["qa_e2e_7b_int8"] = {
+                "p50_ms": round(p50c, 2),
+                "p95_ms": round(p95c, 2),
+                "new_tokens": max_new,
+                "decoder": "mistral-7b-class-int8",
+                "speculative_k": 8,
+                "context": "3 x 60-120-token chunks (realistic pool)",
+                "attempts": [
+                    {
+                        "speculative_k": 8,
+                        "p50_ms": round(p50c, 2),
+                        "p95_ms": round(p95c, 2),
+                    }
+                ],
+            }
+            fused = DETAILS.get("qa_e2e_7b_int8_fused", {})
+            if "p50_ms" in fused:
+                DETAILS["fused_ab_7b"] = {
+                    "classic_p50_ms": round(p50c, 2),
+                    "fused_p50_ms": fused["p50_ms"],
+                    "context": (
+                        "EQUAL both paths: 3 x 60-120-token pool chunks"
+                    ),
+                    "speculative_k": 8,
+                }
+
         def sec_spec4():
             eng = GenerateEngine(
                 cfg7,
@@ -936,22 +991,15 @@ def main() -> None:
             finally:
                 del eng
                 gc.collect()
-            DETAILS["qa_e2e_7b_int8"]["attempts"].append(
+            DETAILS.setdefault("qa_e2e_7b_int8", {}).setdefault(
+                "attempts", []
+            ).append(
                 {
                     "speculative_k": 4,
                     "p50_ms": round(p50b, 2),
                     "p95_ms": round(p95b, 2),
                 }
             )
-
-        def sec_fused_7b():
-            p50f, _ = measure_fused(S["gen8"], "qa_e2e_7b_int8_fused")
-            DETAILS["fused_ab_7b"] = {
-                "classic_p50_ms": DETAILS["qa_e2e_7b_int8"]["p50_ms"],
-                "fused_p50_ms": round(p50f, 2),
-                "context": "EQUAL both paths: 3 x 60-120-token pool chunks",
-                "speculative_k": DETAILS["qa_e2e_7b_int8"]["speculative_k"],
-            }
 
         def sec_load_7b():
             from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY as _REG
@@ -1003,7 +1051,7 @@ def main() -> None:
                 gc.collect()
 
         run_section("decode_7b_int8", sec_decode_7b, 90)
-        run_section("e2e_7b_fused", sec_fused_7b, 150)
+        run_section("e2e_7b_classic", sec_classic_7b, 150)
         run_section("e2e_7b_spec4", sec_spec4, 150)
         run_section("load_7b", sec_load_7b, 300)
         dispatch_health("after_7b_sections")

@@ -37,22 +37,27 @@ def dispatch_with_donation_retry(
     ``snapshot_and_build`` must acquire ``lock`` internally, read a
     consistent view of the store, and return ``(fn, args)`` — or
     ``(None, None)`` when there is nothing to search (caller maps that to
-    its empty result).  The first dispatch runs unlocked: the snapshot's
-    Python refs keep the buffers alive, and if an ``add()`` donates them
+    its empty result).  Dispatches run unlocked: the snapshot's Python
+    refs keep the buffers alive, and if an ``add()`` donates them
     mid-compile the dispatch raises immediately (deleted-buffer check)
-    and the retry re-snapshots AND re-dispatches fully under the lock —
-    which excludes adds, and is cheap because the program cache is warm
-    by then.  ``lock`` must be re-entrant (the store's RLock)."""
-    fn, args = snapshot_and_build()
-    if fn is None:
-        return None
-    try:
-        return fn(*args)
-    except RuntimeError as e:
-        if not _is_deleted_buffer_error(e):
-            raise
-        with lock:
-            fn, args = snapshot_and_build()
-            if fn is None:
-                return None
+    and we re-snapshot.  The SECOND attempt is also unlocked — the
+    racing add may have changed the program's shape key (count crossing
+    ``k``, a capacity double), and a fresh compile must never run under
+    the lock.  Only the final attempt dispatches under the lock, which
+    excludes adds entirely; reaching it twice through fresh donation
+    races is vanishingly rare, and by then every shape in play has a
+    warm program.  ``lock`` must be re-entrant (the store's RLock)."""
+    for unlocked_try in range(2):
+        fn, args = snapshot_and_build()
+        if fn is None:
+            return None
+        try:
             return fn(*args)
+        except RuntimeError as e:
+            if not _is_deleted_buffer_error(e):
+                raise
+    with lock:
+        fn, args = snapshot_and_build()
+        if fn is None:
+            return None
+        return fn(*args)
