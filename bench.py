@@ -746,6 +746,10 @@ def main() -> None:
         summary["degraded"] = True
     print(json.dumps(summary), flush=True)
     log(f"headline printed at +{DETAILS['headline_printed_at_s']}s")
+    # the engines stay reachable through S only: a lingering head_engine
+    # reference would pin the 7B tree (or the 1.1B fallback engine) past
+    # the explicit frees the HBM-hungry sections below rely on
+    head_engine = None
 
     # ---- post-headline sections, each budget-gated --------------------------
     def run_section(name: str, fn, need_s: float = 90.0) -> bool:
@@ -942,22 +946,26 @@ def main() -> None:
 
         def sec_classic_7b():
             # the classic two-sync path: the fused headline's A/B
-            # comparator (equal context — same pool chunks both ways)
+            # comparator (equal context — same pool chunks both ways).
+            # Provenance comes from the ENGINE, not literals — the
+            # headline's head_provenance dict is reused so a future
+            # HEAD_SPEC_K change cannot desynchronize the record.
             if "p50_ms" in DETAILS.get("qa_e2e_7b_int8", {}):
                 return  # headline fell back to classic; already measured
+            k_eng = S["gen8"].gen.speculative_k
             p50c, p95c = measure_e2e(
-                S["gen8"], q_texts[2 : 2 + n_e2e], "7B-int8 classic spec_k=8"
+                S["gen8"],
+                q_texts[2 : 2 + n_e2e],
+                f"7B-int8 classic spec_k={k_eng}",
             )
             DETAILS["qa_e2e_7b_int8"] = {
                 "p50_ms": round(p50c, 2),
                 "p95_ms": round(p95c, 2),
                 "new_tokens": max_new,
-                "decoder": "mistral-7b-class-int8",
-                "speculative_k": 8,
-                "context": "3 x 60-120-token chunks (realistic pool)",
+                **head_provenance,
                 "attempts": [
                     {
-                        "speculative_k": 8,
+                        "speculative_k": k_eng,
                         "p50_ms": round(p50c, 2),
                         "p95_ms": round(p95c, 2),
                     }
@@ -971,10 +979,17 @@ def main() -> None:
                     "context": (
                         "EQUAL both paths: 3 x 60-120-token pool chunks"
                     ),
-                    "speculative_k": 8,
+                    "speculative_k": k_eng,
                 }
 
         def sec_spec4():
+            if "p50_ms" not in DETAILS.get("qa_e2e_7b_int8", {}):
+                # classic section skipped/failed: recording a lone k=4
+                # attempt inside its entry would violate the schema
+                # PERF.md documents — use a standalone key instead
+                target = DETAILS.setdefault("qa_e2e_7b_int8_spec4_only", {})
+            else:
+                target = None
             eng = GenerateEngine(
                 cfg7,
                 GenerateConfig(
@@ -991,15 +1006,15 @@ def main() -> None:
             finally:
                 del eng
                 gc.collect()
-            DETAILS.setdefault("qa_e2e_7b_int8", {}).setdefault(
-                "attempts", []
-            ).append(
-                {
-                    "speculative_k": 4,
-                    "p50_ms": round(p50b, 2),
-                    "p95_ms": round(p95b, 2),
-                }
-            )
+            rec = {
+                "speculative_k": 4,
+                "p50_ms": round(p50b, 2),
+                "p95_ms": round(p95b, 2),
+            }
+            if target is not None:
+                target.update(rec)
+            else:
+                DETAILS["qa_e2e_7b_int8"]["attempts"].append(rec)
 
         def sec_load_7b():
             from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY as _REG

@@ -122,30 +122,45 @@ class TestFusedMatchesTwoStep:
 
 class TestDispatchDiscipline:
     def test_donation_retry_and_error_propagation(self):
-        """The shared snapshot/retry helper: a deleted-buffer RuntimeError
-        retries once fully under the lock; any other RuntimeError
-        propagates without a locked retry (re-running a failed compile
+        """The shared snapshot/retry helper: deleted-buffer RuntimeErrors
+        get a SECOND unlocked attempt (a racing add may have changed the
+        program's shape key — a fresh compile must never run under the
+        lock), then a final attempt under the lock; any other
+        RuntimeError propagates immediately (re-running a failed compile
         under the store lock would stall every concurrent caller)."""
         import threading
 
         from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
 
         lock = threading.RLock()
+
+        def make_snap(n_failures, calls):
+            def snap():
+                calls.append(("snap", lock._is_owned()))
+
+                def fn(x):
+                    calls.append(("run", lock._is_owned()))
+                    if sum(1 for c, _ in calls if c == "run") <= n_failures:
+                        raise RuntimeError("Array has been deleted.")
+                    return x + 1
+
+                return fn, (1,)
+
+            return snap
+
+        # one donation race: retried unlocked
+        calls: list = []
+        assert dispatch_with_donation_retry(lock, make_snap(1, calls)) == 2
+        assert [c for c, _ in calls] == ["snap", "run", "snap", "run"]
+        assert calls[-1][1] is False  # second attempt ran WITHOUT the lock
+
+        # two consecutive races: the third attempt runs under the lock
         calls = []
-
-        def snap():
-            calls.append("snap")
-
-            def fn(x):
-                calls.append("run")
-                if calls.count("run") == 1:
-                    raise RuntimeError("Array has been deleted.")
-                return x + 1
-
-            return fn, (1,)
-
-        assert dispatch_with_donation_retry(lock, snap) == 2
-        assert calls == ["snap", "run", "snap", "run"]
+        assert dispatch_with_donation_retry(lock, make_snap(2, calls)) == 2
+        assert [c for c, _ in calls] == [
+            "snap", "run", "snap", "run", "snap", "run",
+        ]
+        assert calls[-1][1] is True  # final attempt held the lock
 
         def snap_err():
             def fn():
