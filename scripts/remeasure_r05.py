@@ -1,0 +1,215 @@
+"""Re-measure the r05 sections the tunnel outage cut, on the real chip.
+
+Priority order (each independently try/except'd, results appended to
+``docs/bench_r05_insession.json`` under ``remeasure``):
+
+1. OPEN-loop QPS-16 load with the trickle-admission fix
+   (``engines/serve.py`` narrow 4-lane prefill shape) — the recorded
+   5.5 / 1.8 achieved-QPS numbers predate the fix.
+2. int4 capability probe (fails fast without poisoning; records why).
+3. 7B bf16 decode (14.5 GB — needs the HBM the loads leave free).
+
+Run: ``python scripts/remeasure_r05.py`` (uses the real chip; do NOT
+force CPU).  Wall budget ~25 min.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "bench_r05_insession.json",
+)
+
+
+def log(msg):
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def save(key, value):
+    d = json.load(open(OUT))
+    d.setdefault("remeasure", {})[key] = value
+    json.dump(d, open(OUT, "w"), indent=1)
+    log(f"saved remeasure.{key}")
+
+
+def main():
+    import jax
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    import bench  # the pool/open-loop machinery lives there
+
+    # Reuse bench's corpus/pool construction at reduced scale: the load
+    # sections don't need the 1M store, only realistic prompts.
+    rng = np.random.default_rng(7)
+    pool_texts = bench.make_chunk_pool(rng, 2048)
+    from docqa_tpu.config import DecoderConfig, GenerateConfig
+    from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.engines.serve import ContinuousBatcher
+    from docqa_tpu.text.tokenizer import default_tokenizer
+
+    dec_cfg = DecoderConfig(
+        vocab_size=32000, hidden_dim=2048, num_layers=16, num_heads=16,
+        num_kv_heads=8, head_dim=128, mlp_dim=5632, max_seq_len=4096,
+    )
+    tok = default_tokenizer(dec_cfg.vocab_size)
+    W = 128
+    pool_tok = np.zeros((len(pool_texts), W), np.int32)
+    pool_len = np.zeros((len(pool_texts),), np.int32)
+    for i, t in enumerate(pool_texts):
+        ids = tok.encode(t, add_specials=False)[:W]
+        pool_tok[i, : len(ids)] = ids
+        pool_len[i] = len(ids)
+
+    def open_loop(engine, n_slots, chunk, cache_len, qps, n_req, max_new=64):
+        import threading
+
+        rngp = np.random.default_rng(3)
+        prompts = []
+        for i in range(n_req + n_slots):
+            parts = [5, 9, 11]
+            for j in rngp.integers(0, len(pool_texts), 3):
+                parts.extend(
+                    int(t) for t in pool_tok[int(j)][: int(pool_len[int(j)])]
+                )
+            parts.extend((7 + i % 13, 3 + i % 7))
+            prompts.append(parts)
+        b = ContinuousBatcher(
+            engine, n_slots=n_slots, chunk=chunk, cache_len=cache_len
+        )
+        try:
+            for h in [
+                b.submit_ids(p, max_new_tokens=4) for p in prompts[:n_slots]
+            ]:
+                h.result()
+            b.submit_ids(prompts[0], max_new_tokens=max_new).result()
+            lat = [0.0] * n_req
+            qd: list = []
+            done = threading.Event()
+
+            def sampler():
+                while not done.is_set():
+                    qd.append(b.n_queued)
+                    time.sleep(0.05)
+
+            threading.Thread(target=sampler, daemon=True).start()
+            waiters = []
+            t0 = time.perf_counter()
+
+            def wait_one(i, h, sched):
+                h.result()
+                lat[i] = (time.perf_counter() - sched) * 1e3
+
+            for i in range(n_req):
+                sched = t0 + i / qps
+                now = time.perf_counter()
+                if sched > now:
+                    time.sleep(sched - now)
+                h = b.submit_ids(prompts[n_slots + i], max_new_tokens=max_new)
+                w = threading.Thread(target=wait_one, args=(i, h, sched))
+                w.start()
+                waiters.append(w)
+            for w in waiters:
+                w.join()
+            wall = time.perf_counter() - t0
+            done.set()
+        finally:
+            b.stop()
+        return {
+            "arrival": f"open@{qps}",
+            "requests": n_req,
+            "wall_s": round(wall, 2),
+            "achieved_qps": round(n_req / wall, 2),
+            "request_p50_ms": round(float(np.percentile(lat, 50)), 1),
+            "request_p95_ms": round(float(np.percentile(lat, 95)), 1),
+            "queue_depth_max": int(max(qd)) if qd else 0,
+            "note": "AFTER the trickle-admission fix (4-lane prefill shape)",
+        }
+
+    # 1a. 1.1B open-loop
+    try:
+        gen1 = GenerateEngine(
+            __import__("dataclasses").replace(dec_cfg, quantize_weights=True),
+            GenerateConfig(speculative_k=4, prefill_buckets=(128, 512)),
+        )
+        save("rag_load_open16", open_loop(gen1, 32, 16, 1024, 16, 96))
+        del gen1
+    except Exception as e:
+        log(f"1.1B open-loop failed: {e!r}")
+        save("rag_load_open16", {"error": repr(e)[:300]})
+    import gc
+
+    gc.collect()
+
+    # 1b. 7B open-loop
+    try:
+        from docqa_tpu.models.quant import init_quantized_decoder_params
+
+        cfg7 = DecoderConfig.mistral_7b()
+        params8 = init_quantized_decoder_params(
+            __import__("jax").random.PRNGKey(0), cfg7, host_init=True,
+            host_seed=0,
+        )
+        gen8 = GenerateEngine(
+            cfg7,
+            GenerateConfig(
+                max_new_tokens=64, prefill_buckets=(128, 512), speculative_k=8
+            ),
+            params=params8,
+        )
+        save("rag_load_7b_open16", open_loop(gen8, 32, 16, 1024, 16, 96))
+        del gen8
+    except Exception as e:
+        log(f"7B open-loop failed: {e!r}")
+        save("rag_load_7b_open16", {"error": repr(e)[:300]})
+    gc.collect()
+
+    # 2. int4 capability probe
+    try:
+        from docqa_tpu.models.quant import probe_int4_support
+
+        ok, why = probe_int4_support()
+        save("int4_probe", {"supported": bool(ok), "detail": str(why)[:200]})
+    except Exception as e:
+        save("int4_probe", {"error": repr(e)[:200]})
+
+    # 3. 7B bf16 decode (needs everything above freed)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from docqa_tpu.models.decoder import init_decoder_params
+
+        del params8
+        gc.collect()
+        cfg7 = DecoderConfig.mistral_7b()
+        params7 = init_decoder_params(
+            jax.random.PRNGKey(0), cfg7, param_dtype=jnp.bfloat16
+        )
+        gen7 = GenerateEngine(
+            cfg7,
+            GenerateConfig(max_new_tokens=64, prefill_buckets=(128,)),
+            params=params7,
+        )
+        gen7.generate_ids([[5, 9, 11]], max_new_tokens=64)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            gen7.generate_ids([[5, 9, 11]], max_new_tokens=64)
+        tok_s = 3 * 64 / (time.perf_counter() - t0)
+        save("decode_7b_bf16", {"tokens_per_s": round(tok_s, 1)})
+    except Exception as e:
+        log(f"bf16 decode failed: {e!r}")
+        save("decode_7b_bf16", {"error": repr(e)[:300]})
+
+
+if __name__ == "__main__":
+    main()
