@@ -381,6 +381,34 @@ class FlagsConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Failure-path policy (docqa_tpu/resilience/, docs/RESILIENCE.md).
+
+    The reference had none of this — services died on a missed call and
+    requests queued without bound (BENCH_r05: 7.9 s p95 at QPS 16)."""
+
+    # end-to-end /ask budget, stamped at admission and threaded through
+    # retrieval → dispatch → the continuous batcher; stages shed
+    # (504/degrade) instead of queueing past it.  0 disables deadlines.
+    request_deadline_s: float = 8.0
+    # below this remaining budget the QA path skips generation entirely
+    # and serves the degraded extractive answer (a decode round it cannot
+    # finish in time would only waste a batcher lane)
+    min_generate_budget_s: float = 0.5
+    # in-place retry policy (resilience/policy.py) wrapping broker
+    # publishes, checkpoint shard reads, and pipeline handlers
+    retry_attempts: int = 3
+    retry_base_delay_s: float = 0.05
+    retry_max_delay_s: float = 2.0
+    # per-dependency circuit breakers (resilience/breaker.py): trip after
+    # this many consecutive failures; probe again after the reset timeout
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
+    # cap on the degraded extractive answer built from retrieved chunks
+    degraded_max_chars: int = 600
+
+
+@dataclass(frozen=True)
 class GenerateConfig:
     """Decode-loop policy."""
 
@@ -423,6 +451,7 @@ class Config:
     service: ServiceConfig = field(default_factory=ServiceConfig)
     flags: FlagsConfig = field(default_factory=FlagsConfig)
     generate: GenerateConfig = field(default_factory=GenerateConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

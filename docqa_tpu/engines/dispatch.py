@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
+from docqa_tpu.resilience.deadline import Deadline
+
 
 def _is_deleted_buffer_error(e: Exception) -> bool:
     """True only for the use-after-donation failure mode (jax raises
@@ -30,6 +32,7 @@ def _is_deleted_buffer_error(e: Exception) -> bool:
 def dispatch_with_donation_retry(
     lock,
     snapshot_and_build: Callable[[], Tuple[Optional[Callable], Any]],
+    deadline: Optional[Deadline] = None,
 ):
     """Run ``fn(*args)`` from a consistent snapshot, compiling OUTSIDE the
     lock.
@@ -46,8 +49,15 @@ def dispatch_with_donation_retry(
     the lock.  Only the final attempt dispatches under the lock, which
     excludes adds entirely; reaching it twice through fresh donation
     races is vanishingly rare, and by then every shape in play has a
-    warm program.  ``lock`` must be re-entrant (the store's RLock)."""
+    warm program.  ``lock`` must be re-entrant (the store's RLock).
+
+    ``deadline`` (resilience/deadline.py) is checked before every
+    attempt: a request whose end-to-end budget is gone sheds HERE —
+    before a possibly multi-second trace+compile — instead of paying for
+    a dispatch whose answer nobody can use."""
     for unlocked_try in range(2):
+        if deadline is not None:
+            deadline.check("dispatch")
         fn, args = snapshot_and_build()
         if fn is None:
             return None
@@ -57,6 +67,8 @@ def dispatch_with_donation_retry(
             if not _is_deleted_buffer_error(e):
                 raise
     with lock:
+        if deadline is not None:
+            deadline.check("dispatch")
         fn, args = snapshot_and_build()
         if fn is None:
             return None

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax import shard_map
+from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
@@ -126,12 +126,15 @@ class FusedRetriever:
         texts: Sequence[str],
         k: Optional[int] = None,
         filters: Optional[Dict[str, Any]] = None,
+        deadline=None,  # resilience.Deadline: shed before marshal/dispatch
     ) -> List[List[SearchResult]]:
         """Same contract as ``store.search`` but from raw query texts."""
         store = self.store
         k = k or store.cfg.default_k
         if not len(texts):
             return []
+        if deadline is not None:
+            deadline.check("retrieve")
         n = len(texts)
         ids_p, len_p = marshal_texts(
             self.encoder.tokenizer,
@@ -168,7 +171,7 @@ class FusedRetriever:
 
         with span("fused_query", DEFAULT_REGISTRY):
             out = dispatch_with_donation_retry(
-                store._lock, snapshot_and_build
+                store._lock, snapshot_and_build, deadline=deadline
             )
         if out is None:  # empty store
             return [[] for _ in texts]
@@ -244,6 +247,7 @@ class FusedTieredRetriever:
         texts: Sequence[str],
         k: Optional[int] = None,
         filters: Optional[Dict[str, Any]] = None,
+        deadline=None,  # resilience.Deadline: shed before marshal/dispatch
     ) -> List[List[SearchResult]]:
         """Same contract as ``TieredIndex.search`` but from raw texts."""
         tiered = self.tiered
@@ -251,12 +255,16 @@ class FusedTieredRetriever:
         k = k or store.cfg.default_k
         if not len(texts):
             return []
+        if deadline is not None:
+            deadline.check("retrieve")
         tiered._maybe_background_rebuild()
         tier = tiered._tier  # one read: (ivf, covered) stay consistent
         if tier is None or filters:
             # pre-IVF or filtered: the (masked) exact fused path is the
             # right tool — identical policy to TieredIndex.search
-            return self._exact.search_texts(texts, k=k, filters=filters)
+            return self._exact.search_texts(
+                texts, k=k, filters=filters, deadline=deadline
+            )
         mesh = store.mesh
         if mesh is not None and (mesh.n_model > 1 or mesh.n_data > 1):
             # multi-device mesh: the IVF tier's cell tensors are built
@@ -265,6 +273,8 @@ class FusedTieredRetriever:
             # full-scan the store the operator configured tiered serving
             # to avoid).  The exact fused path composes with the mesh
             # (sharded_search); fusing the probe kernel is future work.
+            if deadline is not None:  # shed before three paid dispatches
+                deadline.check("retrieve_dispatch")
             emb = np.asarray(
                 self.encoder.encode_texts(texts), np.float32
             )
@@ -300,6 +310,8 @@ class FusedTieredRetriever:
         # The padded bucket size bounds top_k's k.
         k_tail = min(max(k_bulk, k), int(tail_dev.shape[0]))
         fn = self._get_fn(fetch, nprobe, k_tail)
+        if deadline is not None:  # marshal/rebuild may have eaten the budget
+            deadline.check("retrieve_dispatch")
         with span("fused_tiered_query", DEFAULT_REGISTRY):
             bulk_vals, bulk_ids, tail_vals, tail_ids = fn(
                 self.encoder.params,

@@ -56,6 +56,7 @@ from docqa_tpu.models.decoder import (
     init_kv_cache,
 )
 from docqa_tpu.ops.sampling import sample
+from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 from docqa_tpu.utils import pick_bucket, round_up
 
@@ -71,6 +72,10 @@ class _Request:
     error: Optional[BaseException] = None
     # notified whenever tokens grow or the request finishes (streaming)
     cv: threading.Condition = field(default_factory=threading.Condition)
+    # end-to-end budget stamped at HTTP admission (resilience/deadline.py);
+    # the worker sheds this request — from the queue or from a live slot —
+    # the moment the budget is gone, instead of decoding for nobody
+    deadline: Optional[Deadline] = None
 
 
 # One wait policy for every consumer of a Handle (qa /ask, summarize,
@@ -87,6 +92,19 @@ def _finish(req: _Request) -> None:
         req.cv.notify_all()
 
 
+class ResultTimeout(TimeoutError):
+    """``Handle.result()``/``iter_tokens()`` waited out its timeout while
+    the request was still decoding.  Typed (vs a bare TimeoutError) so
+    callers can distinguish *slow* from *shed* (``QueueFull``) and from a
+    budget shed (``DeadlineExceeded``) — three different operator
+    stories."""
+
+    def __init__(self, waited_s: Optional[float]) -> None:
+        self.waited_s = waited_s
+        detail = "" if waited_s is None else f" after {waited_s:.1f}s"
+        super().__init__(f"generation timed out{detail}")
+
+
 class Handle:
     """Future-like result for a submitted request."""
 
@@ -96,8 +114,18 @@ class Handle:
     def result(
         self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
     ) -> List[int]:
+        # a request-scoped deadline bounds the wait below any caller
+        # timeout: waiting past it can only ever produce a late answer
+        dl = self._req.deadline
+        if dl is not None:
+            timeout = dl.bound(timeout)
         if not self._req.done.wait(timeout):
-            raise TimeoutError("generation timed out")
+            if dl is not None and dl.expired:
+                # the deadline was the binding constraint: report the
+                # budget shed, not a generic slow-decode timeout (the
+                # worker's own shed may still be a chunk round away)
+                raise DeadlineExceeded("serve_result", -dl.remaining())
+            raise ResultTimeout(timeout)
         if self._req.error is not None:
             raise self._req.error
         return list(self._req.tokens)
@@ -115,6 +143,16 @@ class Handle:
         TimeoutError) instead of returning partial output silently."""
         req = self._req
         sent = 0
+        if req.deadline is not None:
+            timeout = req.deadline.bound(timeout)
+
+        def _timed_out():
+            if req.deadline is not None and req.deadline.expired:
+                raise DeadlineExceeded(
+                    "serve_result", -req.deadline.remaining()
+                )
+            raise ResultTimeout(timeout)
+
         deadline = (
             None if timeout is None else time_monotonic() + timeout
         )
@@ -127,9 +165,9 @@ class Handle:
                         else deadline - time_monotonic()
                     )
                     if remaining is not None and remaining <= 0:
-                        raise TimeoutError("generation timed out")
+                        _timed_out()
                     if not req.cv.wait(remaining):
-                        raise TimeoutError("generation timed out")
+                        _timed_out()
                 fresh = list(req.tokens[sent:])
             sent += len(fresh)
             for t in fresh:
@@ -143,7 +181,25 @@ class Handle:
 class QueueFull(RuntimeError):
     """Admission control: the wait queue is at capacity.  The HTTP layer
     maps this to 503 — bounded queueing beats unbounded latency growth
-    when arrival rate exceeds decode throughput."""
+    when arrival rate exceeds decode throughput.
+
+    Carries the load snapshot at rejection time (``n_queued`` /
+    ``n_active``) so callers — and the 503 body — can say HOW overloaded
+    the batcher was, not just that it shed."""
+
+    def __init__(
+        self,
+        message: str,
+        n_queued: Optional[int] = None,
+        n_active: Optional[int] = None,
+    ) -> None:
+        self.n_queued = n_queued
+        self.n_active = n_active
+        if n_queued is not None or n_active is not None:
+            message = (
+                f"{message} (queued={n_queued}, active={n_active})"
+            )
+        super().__init__(message)
 
 
 class ContinuousBatcher:
@@ -424,10 +480,18 @@ class ContinuousBatcher:
     # ---- public API ----------------------------------------------------------
 
     def submit_ids(
-        self, prompt_ids: Sequence[int], max_new_tokens: Optional[int] = None
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
     ) -> Handle:
         max_new = max_new_tokens or self.gen.max_new_tokens
-        req = _Request(list(prompt_ids), max_new)
+        if deadline is not None and deadline.expired:
+            # admission is the cheapest place to shed: a request that
+            # arrives already out of budget must not take a queue slot
+            DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+            deadline.check("serve_submit")
+        req = _Request(list(prompt_ids), max_new, deadline=deadline)
         with self._cv:
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
@@ -437,7 +501,9 @@ class ContinuousBatcher:
             ):
                 DEFAULT_REGISTRY.counter("serve_shed").inc()
                 raise QueueFull(
-                    f"generation queue at capacity ({self.max_queue})"
+                    f"generation queue at capacity ({self.max_queue})",
+                    n_queued=len(self._queue),
+                    n_active=sum(1 for r in self._slot_req if r is not None),
                 )
             self._queue.append(req)
             self._cv.notify_all()
@@ -445,7 +511,10 @@ class ContinuousBatcher:
         return Handle(req)
 
     def submit_text(
-        self, prompt: str, max_new_tokens: Optional[int] = None
+        self,
+        prompt: str,
+        max_new_tokens: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
     ) -> Handle:
         # same text entry contract as GenerateEngine.generate_texts: the
         # configured chat template wraps here too (template-aware
@@ -453,7 +522,9 @@ class ContinuousBatcher:
         # from a batcher match solo-engine answers token-for-token
         usable = self.cache_len - 2 - self.spec_k
         return self.submit_ids(
-            self.engine.encode_prompt(prompt, usable), max_new_tokens
+            self.engine.encode_prompt(prompt, usable),
+            max_new_tokens,
+            deadline=deadline,
         )
 
     def generate_texts(
@@ -530,6 +601,16 @@ class ContinuousBatcher:
         good: List[Tuple[int, "_Request", List[int]]] = []
         longest = 1
         for slot, req in pairs:
+            if req.deadline is not None and req.deadline.expired:
+                # the budget lapsed between queue pop and this round
+                # (e.g. while the previous chunk drained) — shed before
+                # the prefill spends a lane on it
+                req.error = DeadlineExceeded(
+                    "serve_admit", -req.deadline.remaining()
+                )
+                DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+                _finish(req)
+                continue
             try:
                 ids = [int(t) for t in req.prompt_ids][-usable:] or [
                     self.gen.pad_id
@@ -725,10 +806,27 @@ class ContinuousBatcher:
             if len(req.tokens) > before:  # wake streamers per chunk
                 with req.cv:
                     req.cv.notify_all()
-            if (
+            # early-retire a lane whose budget ran out mid-decode: nobody
+            # is waiting for the rest of its tokens, and the freed slot
+            # admits queued work a whole chunk sooner.  Only STILL-RUNNING
+            # lanes shed — a request that completed (EOS / token budget)
+            # in this same chunk has a full answer, and marking it failed
+            # would discard finished work for nothing.
+            finished = (
                 not active_h[slot]
                 or len(req.tokens) >= self._slot_budget[slot]
-            ):
+            )
+            expired = (
+                not finished
+                and req.deadline is not None
+                and req.deadline.expired
+            )
+            if expired:
+                req.error = DeadlineExceeded(
+                    "serve_decode", -req.deadline.remaining()
+                )
+                DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+            if finished or expired:
                 deactivate.append(slot)
                 self._retire(slot)
         # tokens delivered per dispatch: with speculation this exceeds
@@ -746,13 +844,29 @@ class ContinuousBatcher:
         self, pairs: List[Tuple[int, "_Request"]]
     ) -> None:
         """Fill every free slot from the queue into ``pairs`` (the ONE
-        admission-selection policy; caller holds ``self._cv``)."""
+        admission-selection policy; caller holds ``self._cv``).
+
+        Requests whose deadline lapsed *while queued* are failed here —
+        never admitted: prefilling them would spend a batched forward on
+        answers nobody is waiting for (the BENCH_r05 pile-up)."""
         taken = {s for s, _ in pairs}
         for slot in range(self.n_slots):
-            if not self._queue:
+            if self._slot_req[slot] is not None or slot in taken:
+                continue
+            filled = False
+            while self._queue and not filled:
+                req = self._queue.popleft()
+                if req.deadline is not None and req.deadline.expired:
+                    req.error = DeadlineExceeded(
+                        "serve_queue", -req.deadline.remaining()
+                    )
+                    DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+                    _finish(req)
+                    continue
+                pairs.append((slot, req))
+                filled = True
+            if not self._queue and not filled:
                 break
-            if self._slot_req[slot] is None and slot not in taken:
-                pairs.append((slot, self._queue.popleft()))
 
     def _run(self) -> None:
         # The one dispatched-but-unprocessed decode chunk: (packed device

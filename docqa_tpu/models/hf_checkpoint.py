@@ -24,6 +24,44 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 from docqa_tpu.config import DecoderConfig, EncoderConfig, Seq2SeqConfig
+from docqa_tpu.resilience import faults
+from docqa_tpu.resilience.breaker import CircuitBreaker
+from docqa_tpu.resilience.policy import RetryPolicy
+
+# Weight-shard reads ride network filesystems in real deployments (GCS
+# fuse, NFS): transient IO errors get retried with backoff; deterministic
+# failures (corrupt safetensors) are not retried but still feed the
+# breaker, so repeated IN-PROCESS load attempts (a reload endpoint, a
+# runtime rebuild loop) fail fast after two exhausted loads instead of
+# re-reading multi-GB shards forever.  (Breaker state is per-process: a
+# supervisor restart-looping the whole process starts fresh each time —
+# that loop needs supervisor-side backoff, not this breaker.)
+_LOAD_RETRY = RetryPolicy(
+    max_attempts=3,
+    base_delay_s=0.2,
+    max_delay_s=2.0,
+    retry_on=(OSError, faults.InjectedFault),
+)
+# threshold is TWO fully-exhausted loads (2 x max_attempts): one bad
+# checkpoint dir must not block a subsequent load of a healthy one.
+# DocQARuntime adopts this breaker onto its BreakerBoard so the state is
+# visible on /api/status like every other dependency's.
+_LOAD_BREAKER = CircuitBreaker(
+    "checkpoint", failure_threshold=6, reset_timeout_s=60.0
+)
+
+
+def _load_weights(loader, *args):
+    """One retried, breaker-guarded weight read (resilience_site:
+    checkpoint.load)."""
+
+    def attempt():
+        faults.perturb("checkpoint.load")
+        return loader(*args)
+
+    return _LOAD_RETRY.call(
+        attempt, name="checkpoint_load", breaker=_LOAD_BREAKER
+    )
 
 
 def _find_tokenizer(path: str) -> Optional[str]:
@@ -176,7 +214,7 @@ def load_checkpoint_dir(
         cfg = _decoder_config(hf, tok)
         if keep:
             cfg = dataclasses.replace(cfg, **keep)
-        return cfg, load_hf_llama_weights(shards, cfg), tok
+        return cfg, _load_weights(load_hf_llama_weights, shards, cfg), tok
     if len(shards) > 1:
         # the bart/bert mappers take one file; their real checkpoints
         # (bart-large-cnn, MiniLM) ship single-shard — fail actionably
@@ -192,13 +230,13 @@ def load_checkpoint_dir(
         cfg = _seq2seq_config(hf, tok)
         if keep:
             cfg = dataclasses.replace(cfg, **keep)
-        return cfg, load_hf_bart_weights(shards[0], cfg), tok
+        return cfg, _load_weights(load_hf_bart_weights, shards[0], cfg), tok
     from docqa_tpu.models.encoder import load_hf_bert_weights
 
     cfg = _encoder_config(hf, tok)
     if keep:
         cfg = dataclasses.replace(cfg, **keep)
-    return cfg, load_hf_bert_weights(shards[0], cfg), tok
+    return cfg, _load_weights(load_hf_bert_weights, shards[0], cfg), tok
 
 
 def generate_engine_from_dir(

@@ -1,0 +1,234 @@
+"""Deterministic seeded fault injection.
+
+Every resilience behavior in this repo (retries, breakers, deadline
+shedding, degraded-mode QA, broker redelivery) is verified by *injecting*
+the failure it handles — at chosen, reproducible steps, not by monkey-
+patching internals per test.  Production code calls
+:func:`perturb(site)` at its instrumented points; with no active plan
+that is one global read and an immediate return.
+
+Instrumented sites (grep ``resilience_site:`` to enumerate):
+
+==================  ========================================================
+``broker.publish``  ``MemoryBroker.publish`` / ``AmqpBroker.publish`` —
+                    raising here simulates a dropped broker connection
+``extract``         ``DocumentPipeline.ingest_document``, before extraction
+``deid``            ``DocumentPipeline._deid_handler``, before the NER batch
+``index``           ``DocumentPipeline._index_handler``, before encoding
+``decoder``         ``QAService`` generation submission — a raise here is a
+                    decoder outage (the degraded-mode trigger)
+``checkpoint.load`` ``models/hf_checkpoint.load_checkpoint_dir`` weight read
+==================  ========================================================
+
+A :class:`FaultPlan` is a list of :class:`FaultRule`; each rule matches a
+site and fires either at explicit call indices (``at_steps``) or with
+probability ``p`` drawn from a ``random.Random`` seeded by
+``(plan.seed, site, call_index)`` — the same plan + seed always perturbs
+the same calls.  Rules can raise (:class:`InjectedFault`), sleep
+(``delay_s`` — a slow stage), or both.
+
+Activation:
+
+* context manager — ``with FaultPlan([...]):`` (tests);
+* environment — ``FaultPlan.from_env()`` parses ``DOCQA_FAULTS`` (spec
+  below) and ``DOCQA_FAULTS_SEED``; ``DocQARuntime`` installs it at boot
+  when set, so chaos drills run against the real service with zero code.
+
+``DOCQA_FAULTS`` spec: semicolon-separated rules,
+``site[:key=value]*`` with keys ``p`` (probability), ``delay`` (seconds),
+``steps`` (comma-separated call indices), ``times`` (max fires).  E.g.::
+
+    DOCQA_FAULTS="broker.publish:p=0.2;deid:delay=0.5:p=0.3;decoder:p=1"
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
+
+log = get_logger("docqa.faults")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected failure (never raised in production unless
+    an operator installed a fault plan)."""
+
+    def __init__(self, site: str, step: int) -> None:
+        self.site = site
+        self.step = step
+        super().__init__(f"injected fault at {site} (call #{step})")
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    site: str
+    p: float = 0.0  # per-call probability of firing
+    at_steps: Tuple[int, ...] = ()  # 0-based call indices that always fire
+    delay_s: float = 0.0  # sleep this long when firing (slow stage)
+    raise_error: bool = True  # raise InjectedFault when firing
+    times: Optional[int] = None  # stop firing after this many hits
+
+    def __post_init__(self):
+        if not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"p must be in [0,1], got {self.p}")
+
+
+class FaultPlan:
+    """A deterministic set of fault rules, installable as the process-wide
+    active plan (context manager) — one plan at a time, by design: chaos
+    tests compose rules into one plan rather than nesting plans."""
+
+    def __init__(self, rules: Sequence[FaultRule], seed: int = 0) -> None:
+        self.rules = list(rules)
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}  # per-site call counter
+        self._fires: Dict[int, int] = {}  # per-rule fire counter
+        self.log: List[Tuple[str, int]] = []  # (site, step) of every fire
+
+    # ---- construction --------------------------------------------------------
+
+    @classmethod
+    def from_env(
+        cls, env: Optional[Mapping[str, str]] = None
+    ) -> Optional["FaultPlan"]:
+        """Parse ``DOCQA_FAULTS`` / ``DOCQA_FAULTS_SEED``; None when
+        unset/empty (the production default)."""
+        env = os.environ if env is None else env
+        spec = (env.get("DOCQA_FAULTS") or "").strip()
+        if not spec:
+            return None
+        seed = int(env.get("DOCQA_FAULTS_SEED", "0"))
+        rules = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            tokens = part.split(":")
+            site, kv = tokens[0].strip(), tokens[1:]
+            kwargs: Dict[str, object] = {}
+            for tok in kv:
+                key, _, value = tok.partition("=")
+                key = key.strip()
+                if key == "p":
+                    kwargs["p"] = float(value)
+                elif key == "delay":
+                    kwargs["delay_s"] = float(value)
+                elif key == "steps":
+                    kwargs["at_steps"] = tuple(
+                        int(s) for s in value.split(",") if s
+                    )
+                elif key == "times":
+                    kwargs["times"] = int(value)
+                elif key == "noerror":
+                    kwargs["raise_error"] = False
+                else:
+                    raise ValueError(
+                        f"unknown DOCQA_FAULTS key {key!r} in {part!r}"
+                    )
+            rules.append(FaultRule(site, **kwargs))
+        return cls(rules, seed=seed)
+
+    @classmethod
+    def seeded_chaos(
+        cls,
+        seed: int,
+        sites: Sequence[str] = ("broker.publish", "deid", "index"),
+        p: float = 0.25,
+        delay_s: float = 0.0,
+    ) -> "FaultPlan":
+        """A random-but-seeded plan over ``sites`` (chaos_smoke's diet)."""
+        return cls(
+            [FaultRule(site, p=p, delay_s=delay_s) for site in sites],
+            seed=seed,
+        )
+
+    # ---- activation ----------------------------------------------------------
+
+    def __enter__(self) -> "FaultPlan":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        uninstall(self)
+
+    # ---- the hook ------------------------------------------------------------
+
+    def perturb(self, site: str, sleep=time.sleep) -> None:
+        """Called by instrumented code: maybe delay, maybe raise."""
+        with self._lock:
+            step = self._calls.get(site, 0)
+            self._calls[site] = step + 1
+            firing: List[Tuple[int, FaultRule]] = []
+            for ri, rule in enumerate(self.rules):
+                if rule.site != site:
+                    continue
+                if rule.times is not None and self._fires.get(ri, 0) >= rule.times:
+                    continue
+                hit = step in rule.at_steps
+                if not hit and rule.p > 0.0:
+                    # crc32, not hash(): str hashes are randomized per
+                    # interpreter run, and the plan must replay across runs
+                    rng = random.Random(
+                        (self.seed * 1_000_003 + step)
+                        ^ zlib.crc32(site.encode())
+                        ^ (ri << 16)
+                    )
+                    hit = rng.random() < rule.p
+                if hit:
+                    self._fires[ri] = self._fires.get(ri, 0) + 1
+                    firing.append((ri, rule))
+            if firing:
+                self.log.append((site, step))
+        for _ri, rule in firing:
+            DEFAULT_REGISTRY.counter(f"faults_{site}").inc()
+            if rule.delay_s > 0.0:
+                log.info(
+                    "injected %.0f ms stall at %s (call #%d)",
+                    rule.delay_s * 1000, site, step,
+                )
+                sleep(rule.delay_s)
+            if rule.raise_error:
+                log.info("injected fault at %s (call #%d)", site, step)
+                raise InjectedFault(site, step)
+
+
+# ---- process-wide active plan ----------------------------------------------
+
+_active_lock = threading.Lock()
+_active: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> None:
+    global _active
+    with _active_lock:
+        if _active is not None and _active is not plan:
+            raise RuntimeError(
+                "a FaultPlan is already active; compose rules into one plan"
+            )
+        _active = plan
+
+
+def uninstall(plan: FaultPlan) -> None:
+    global _active
+    with _active_lock:
+        if _active is plan:
+            _active = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def perturb(site: str) -> None:
+    """The production-code hook: near-zero cost when no plan is active."""
+    plan = _active
+    if plan is not None:
+        plan.perturb(site)

@@ -53,6 +53,24 @@ class Counter:
             return self._value
 
 
+class Gauge:
+    """A settable point-in-time value (breaker states, queue depths)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
 class Histogram:
     """Sorted-sample histogram with exact percentiles.
 
@@ -111,6 +129,7 @@ class Histogram:
 class MetricsRegistry:
     counters: Dict[str, Counter] = field(default_factory=dict)
     histograms: Dict[str, Histogram] = field(default_factory=dict)
+    gauges: Dict[str, Gauge] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def counter(self, name: str) -> Counter:
@@ -125,13 +144,21 @@ class MetricsRegistry:
                 self.histograms[name] = Histogram(name)
             return self.histograms[name]
 
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self.gauges:
+                self.gauges[name] = Gauge(name)
+            return self.gauges[name]
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             counters = dict(self.counters)
             histograms = dict(self.histograms)
+            gauges = dict(self.gauges)
         return {
             "counters": {k: c.value for k, c in counters.items()},
             "histograms": {k: h.summary() for k, h in histograms.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
         }
 
 

@@ -33,6 +33,9 @@ from typing import Optional
 
 from docqa_tpu.config import Config, load_config
 from docqa_tpu.engines.serve import QueueFull
+from docqa_tpu.resilience import BreakerBoard, FaultPlan
+from docqa_tpu.resilience import faults as _faults
+from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
 from docqa_tpu.service.broker import make_broker
 from docqa_tpu.service.pipeline import DocumentPipeline
@@ -67,6 +70,28 @@ class DocQARuntime:
         from docqa_tpu.runtime.mesh import make_mesh, multihost_init
 
         self.cfg = cfg or load_config()
+        # failure-path plumbing first: every dependency below is wrapped
+        # by a breaker from this board (docs/RESILIENCE.md), and a
+        # DOCQA_FAULTS env plan makes chaos drills run against the real
+        # service with zero code changes
+        self.breakers = BreakerBoard(
+            failure_threshold=self.cfg.resilience.breaker_failure_threshold,
+            reset_timeout_s=self.cfg.resilience.breaker_reset_s,
+        )
+        from docqa_tpu.models import hf_checkpoint as _hf_checkpoint
+
+        # module-level singleton (checkpoint loads happen before/outside
+        # the runtime too) — adopted so /api/status shows its state
+        self.breakers.adopt(_hf_checkpoint._LOAD_BREAKER)
+        self._fault_plan = FaultPlan.from_env()
+        if self._fault_plan is not None:
+            _faults.install(self._fault_plan)
+            log.warning(
+                "fault-injection plan ACTIVE (%d rule(s), seed %d) — "
+                "chaos drill mode",
+                len(self._fault_plan.rules),
+                self._fault_plan.seed,
+            )
         multihost_init()
         self.mesh = make_mesh(self.cfg.mesh) if jax.device_count() > 1 else None
 
@@ -318,6 +343,7 @@ class DocQARuntime:
             self.store,
             http_extractor=http_extractor,
             on_indexed=self._on_indexed,
+            breakers=self.breakers,
             # generator tokens at index time feed the single-sync fused
             # RAG path when the sidecar is enabled (engines/rag_fused.py)
             prompt_tokenizer=(
@@ -421,6 +447,8 @@ class DocQARuntime:
             batcher=self.batcher,
             retriever=retriever,
             fused_rag=fused_rag,
+            breakers=self.breakers,
+            resilience=self.cfg.resilience,
         )
         if self.cfg.flags.use_fake_retrieval:
             # standalone/dev parity with the reference's USE_FAKE_RETRIEVAL
@@ -436,7 +464,27 @@ class DocQARuntime:
 
     def start(self) -> "DocQARuntime":
         self.pipeline.start()
+        if self.batcher is not None:
+            # warm the decode programs off the request path: the first
+            # trace+compile costs tens of seconds on a real chip, and a
+            # cold-start /ask would burn its whole request deadline
+            # (resilience.request_deadline_s) inside the compiler —
+            # showing up as a phantom decoder outage on every deploy
+            import threading as _threading
+
+            _threading.Thread(
+                target=self._warmup_decode, daemon=True, name="warmup"
+            ).start()
         return self
+
+    def _warmup_decode(self) -> None:
+        try:
+            self.batcher.submit_ids(
+                [1, 2, 3], max_new_tokens=2
+            ).result(timeout=600)
+            log.info("decode programs warm")
+        except Exception:
+            log.exception("decode warmup failed (serving continues cold)")
 
     # ---- persistence hooks ---------------------------------------------------
 
@@ -511,6 +559,8 @@ class DocQARuntime:
         self._snapshot()
         self.broker.close()
         self.registry.close()
+        if self._fault_plan is not None:
+            _faults.uninstall(self._fault_plan)
 
 
 # ---------------------------------------------------------------------------
@@ -584,6 +634,9 @@ def make_app(rt: DocQARuntime):
                 "dead_letters": {
                     q: len(rt.broker.dead_letters(q)) for q in queues
                 },
+                # per-dependency breaker states (closed/half_open/open):
+                # an "open" here is WHY /ask answers are degraded right now
+                "breakers": rt.breakers.states(),
             }
         )
 
@@ -665,9 +718,14 @@ def make_app(rt: DocQARuntime):
 
     async def _ask_preamble(req):
         """Shared /ask admission: parse → 422, empty index → 503, submit
-        on the device lane → QueueFull 503.  Returns (pending, None) or
-        (None, error-response) so both the blocking and streaming handlers
-        admit identically."""
+        on the device lane → QueueFull 503, budget gone → 504.  Returns
+        (pending, None) or (None, error-response) so both the blocking and
+        streaming handlers admit identically.
+
+        The request's end-to-end :class:`Deadline` is stamped HERE — the
+        one admission point — and threaded through retrieval, dispatch and
+        the batcher (docs/RESILIENCE.md); every later stage sheds instead
+        of queueing past it."""
         try:
             q = Query(**await req.json())
         except Exception as e:
@@ -678,10 +736,20 @@ def make_app(rt: DocQARuntime):
             return None, json_error(
                 503, "index is empty; ingest documents first"
             )
+        budget = rt.cfg.resilience.request_deadline_s
+        deadline = Deadline.after(budget) if budget > 0 else None
         try:
-            pending = await on_device(rt.qa.ask_submit, q.question)
+            pending = await on_device(
+                rt.qa.ask_submit, q.question, deadline=deadline
+            )
         except QueueFull as e:
             return None, json_error(503, str(e))
+        except DeadlineExceeded as e:
+            # shed before any answer material existed (admission or
+            # retrieval) — 504 distinguishes "out of time" from the
+            # QueueFull 503 "out of capacity"
+            DEFAULT_REGISTRY.counter("qa_deadline_shed").inc()
+            return None, json_error(504, str(e))
         return pending, None
 
     async def ask(req):
@@ -691,7 +759,13 @@ def make_app(rt: DocQARuntime):
         pending, err = await _ask_preamble(req)
         if err is not None:
             return err
-        result = await on_gen(pending.resolve)
+        try:
+            result = await on_gen(pending.resolve)
+        except DeadlineExceeded as e:
+            # resolve() degrades whenever it has chunks to degrade to, so
+            # reaching here means even the fallback was impossible
+            DEFAULT_REGISTRY.counter("qa_deadline_shed").inc()
+            return json_error(504, str(e))
         DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
             (time.perf_counter() - t0) * 1000
         )

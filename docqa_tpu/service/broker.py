@@ -31,6 +31,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from docqa_tpu.config import BrokerConfig
+from docqa_tpu.resilience import faults
 from docqa_tpu.runtime.metrics import get_logger
 
 log = get_logger("docqa.broker")
@@ -137,6 +138,10 @@ class MemoryBroker:
     # ---- core API ------------------------------------------------------------
 
     def publish(self, queue: str, body: Dict[str, Any]) -> int:
+        # resilience_site: broker.publish — an injected raise HERE (before
+        # the journal write) models a dropped broker connection: nothing
+        # was enqueued, the caller's RetryPolicy re-publishes
+        faults.perturb("broker.publish")
         with self._cv:
             tag = self._next_tag
             self._next_tag += 1
@@ -274,6 +279,17 @@ class Consumer(threading.Thread):
     raising.  Otherwise the individual retry would replay the already
     side-effected prefix (duplicate publishes / duplicate vectors).
 
+    Resilience (docs/RESILIENCE.md): an optional ``retry``
+    (:class:`~docqa_tpu.resilience.policy.RetryPolicy`) retries the handler
+    *in place* with jittered backoff before any nack — transient failures
+    (device busy, downstream hiccup) never touch the redelivery budget.
+    The same handler contract makes this safe.  An optional ``breaker``
+    (:class:`~docqa_tpu.resilience.breaker.CircuitBreaker`) is fed every
+    outcome; while OPEN the consumer *pauses pulling* — messages wait in
+    the queue for the dependency's recovery window instead of burning
+    their redelivery attempts into the DLQ (the pre-resilience behavior:
+    nack-until-dead-letter was the ONLY failure path).
+
     When a message is finally dead-lettered, ``on_dead`` fires so the owner
     can record a terminal error status.  Replaces the reference's per-service
     ``start_consuming`` loops with their reconnect boilerplate
@@ -288,6 +304,8 @@ class Consumer(threading.Thread):
         poll_s: float = 0.1,
         name: Optional[str] = None,
         on_dead: Optional[Callable[[Dict[str, Any]], None]] = None,
+        retry=None,  # resilience.RetryPolicy: in-place handler retries
+        breaker=None,  # resilience.CircuitBreaker: pause pulls while open
     ) -> None:
         super().__init__(daemon=True, name=name or f"consumer-{queue}")
         self.broker = broker
@@ -296,6 +314,8 @@ class Consumer(threading.Thread):
         self.batch = batch
         self.poll_s = poll_s
         self.on_dead = on_dead
+        self.retry = retry
+        self.breaker = breaker
         self._stopped = threading.Event()
 
     def stop(self, join: bool = True) -> None:
@@ -310,13 +330,61 @@ class Consumer(threading.Thread):
             except Exception:
                 log.exception("on_dead callback failed for %s", self.queue)
 
+    def _handle(
+        self, bodies: List[Dict[str, Any]], use_breaker: bool = True
+    ) -> None:
+        """One handler invocation under the retry policy (+ breaker).
+
+        The breaker wraps the WHOLE retried invocation, not each inner
+        attempt: one batch delivery records one failure.  The one-by-one
+        isolation replay then refines it with per-MESSAGE outcomes (fed
+        directly in ``run``): a poison message in a healthy batch records
+        one failure surrounded by successes — consecutive count resets,
+        the circuit never trips — while an outage fails every message and
+        crosses the threshold within the first round or two of batches.
+        A queue receiving only single-message deliveries is the
+        fundamentally ambiguous case (one failure per round looks
+        identical for poison and outage); there the DLQ path still
+        terminates poison, and the breaker engages only for outages that
+        outlast several deliveries.
+
+        ``use_breaker=False`` is the poison-isolation mode: the replay
+        must not GATE on the circuit (an open breaker must not nack the
+        healthy batch-mates with BreakerOpen, burning their redelivery
+        budget)."""
+
+        def attempt() -> None:
+            if self.retry is not None:
+                self.retry.call(
+                    lambda: self.handler(bodies),
+                    name=f"consumer_{self.queue}",
+                )
+            else:
+                self.handler(bodies)
+
+        if use_breaker and self.breaker is not None:
+            self.breaker.call(attempt)
+        else:
+            attempt()
+
     def run(self) -> None:
+        from docqa_tpu.resilience import breaker as _breaker
+
         while not self._stopped.is_set():
+            if (
+                self.breaker is not None
+                and self.breaker.state == _breaker.OPEN
+            ):
+                # dependency is in its recovery window: let messages WAIT
+                # (they keep their redelivery budget) instead of pulling
+                # them into guaranteed failures
+                self._stopped.wait(self.poll_s)
+                continue
             deliveries = self.broker.get_many(self.queue, self.batch, self.poll_s)
             if not deliveries:
                 continue
             try:
-                self.handler([d.body for d in deliveries])
+                self._handle([d.body for d in deliveries])
             except Exception:
                 log.exception(
                     "batch handler failed on %s (%d msgs); isolating",
@@ -326,13 +394,21 @@ class Consumer(threading.Thread):
                 if len(deliveries) == 1:
                     self._nack(deliveries[0])
                     continue
-                # retry one-by-one so only the poison message pays
+                # retry one-by-one so only the poison message pays — the
+                # breaker never GATES here (see _handle), but it does see
+                # per-message outcomes: successes reset the consecutive
+                # count (poison in a healthy batch can't trip it), while
+                # an outage failing every message crosses the threshold
                 for d in deliveries:
                     try:
-                        self.handler([d.body])
+                        self._handle([d.body], use_breaker=False)
                     except Exception:
+                        if self.breaker is not None:
+                            self.breaker.record_failure()
                         self._nack(d)
                     else:
+                        if self.breaker is not None:
+                            self.breaker.record_success()
                         self.broker.ack(d)
             else:
                 for d in deliveries:
@@ -413,6 +489,7 @@ class AmqpBroker:
         )
 
     def publish(self, queue: str, body: Dict[str, Any]) -> int:
+        faults.perturb("broker.publish")  # resilience_site: broker.publish
         with self._lock:
             self._publish_locked(queue, body, 0)
             self._n_published += 1

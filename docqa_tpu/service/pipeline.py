@@ -27,6 +27,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from docqa_tpu.config import Config
+from docqa_tpu.resilience import faults
+from docqa_tpu.resilience.policy import RetryPolicy
 from docqa_tpu.service import registry as reg
 from docqa_tpu.service.broker import Consumer, MemoryBroker
 from docqa_tpu.service.extract import extract_text_ex
@@ -53,6 +55,7 @@ class DocumentPipeline:
         prompt_tokenizer=None,  # generator tokenizer: fills the token
         # sidecar (store.cfg.token_width) at index time for the
         # single-sync fused RAG path (engines/rag_fused.py)
+        breakers=None,  # resilience.BreakerBoard: broker/deid/index circuits
     ) -> None:
         self.cfg = cfg
         self.broker = broker
@@ -63,6 +66,39 @@ class DocumentPipeline:
         self.http_extractor = http_extractor
         self.on_indexed = on_indexed
         self.prompt_tokenizer = prompt_tokenizer
+        self.breakers = breakers
+        res = cfg.resilience
+        # in-place publish retries: a transient broker hiccup must not
+        # turn into ERROR_QUEUE (ingest) or a redelivery burn (deid) — the
+        # pre-resilience behavior had exactly one failure path, the DLQ
+        self._retry = RetryPolicy(
+            max_attempts=res.retry_attempts,
+            base_delay_s=res.retry_base_delay_s,
+            max_delay_s=res.retry_max_delay_s,
+        )
+        # extraction: only IO-class failures retry — a corrupt upload
+        # fails identically every attempt, and re-parsing it three times
+        # just delays its terminal ERROR_EXTRACTION
+        import dataclasses as _dc
+
+        self._io_retry = _dc.replace(
+            self._retry, retry_on=(OSError, faults.InjectedFault)
+        )
+        # consumer handlers: retry transient classes only (IO, device /
+        # broker RuntimeErrors — InjectedFault included) so a poison
+        # message's deterministic KeyError/TypeError goes straight to the
+        # nack path instead of re-running a full NER batch three times
+        self._consumer_retry = _dc.replace(
+            self._retry, retry_on=(OSError, RuntimeError)
+        )
+        self._broker_breaker = (
+            breakers.get("broker") if breakers is not None else None
+        )
+        # signaled on every terminal status write (INDEXED / ERROR_*) so
+        # wait_indexed() blocks on a Condition instead of polling
+        self._done_cv = threading.Condition()
+        self._started = False
+        self._stopped = False
         # Replay idempotence: a crash between store snapshot and queue ack
         # redelivers an already-indexed message on restart (at-least-once);
         # seeding from the restored store and checking before store.add
@@ -82,6 +118,13 @@ class DocumentPipeline:
         # dropped) or the add completes first (delete_docs tombstones them).
         self._suppressed_doc_ids: set = set()
         self._suppress_lock = threading.Lock()
+        def _dead(body, status):
+            self.registry.set_status_unless_deleted(body["doc_id"], status)
+            self._notify_done()
+
+        # per-stage breakers: while a stage's circuit is open its consumer
+        # pauses pulling (messages keep their redelivery budget); the
+        # retry policy absorbs transient failures before any nack
         self._consumers = [
             Consumer(
                 broker,
@@ -89,9 +132,9 @@ class DocumentPipeline:
                 self._deid_handler,
                 batch=cfg.broker.prefetch,
                 name="deid-worker",
-                on_dead=lambda body: self.registry.set_status_unless_deleted(
-                    body["doc_id"], reg.ERROR_DEID
-                ),
+                on_dead=lambda body: _dead(body, reg.ERROR_DEID),
+                retry=self._consumer_retry,
+                breaker=breakers.get("deid") if breakers else None,
             ),
             Consumer(
                 broker,
@@ -99,9 +142,9 @@ class DocumentPipeline:
                 self._index_handler,
                 batch=cfg.broker.prefetch,
                 name="index-worker",
-                on_dead=lambda body: self.registry.set_status_unless_deleted(
-                    body["doc_id"], reg.ERROR_INDEXING
-                ),
+                on_dead=lambda body: _dead(body, reg.ERROR_INDEXING),
+                retry=self._consumer_retry,
+                breaker=breakers.get("index") if breakers else None,
             ),
         ]
 
@@ -118,12 +161,26 @@ class DocumentPipeline:
     # ---- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        self._started = True
+        self._stopped = False
         for c in self._consumers:
             c.start()
 
     def stop(self) -> None:
+        """Idempotent: a double-stop (runtime.stop() + a supervisor's
+        shutdown hook) must not try to join consumer threads that already
+        exited — Thread.join on a dead thread is safe, but stop() also
+        must not block a second caller behind the first's join timeout."""
+        if self._stopped:
+            return
+        self._stopped = True
         for c in self._consumers:
             c.stop()
+        self._notify_done()  # release any wait_indexed() blocked at stop
+
+    def _notify_done(self) -> None:
+        with self._done_cv:
+            self._done_cv.notify_all()
 
     # ---- ingest (sync stage) -------------------------------------------------
 
@@ -139,8 +196,19 @@ class DocumentPipeline:
         metadata row first, then extract, then queue; every failure mode gets
         a distinct terminal status."""
         record = self.registry.create(filename, doc_type, patient_id, doc_date)
+
+        def _extract():
+            faults.perturb("extract")  # resilience_site: extract
+            return extract_text_ex(data, filename, self.http_extractor)
+
         with span("extract", DEFAULT_REGISTRY):
-            text, why = extract_text_ex(data, filename, self.http_extractor)
+            try:
+                # retried in place: a flaky HTTP extractor (or an injected
+                # fault) gets retry_attempts before the terminal status
+                text, why = self._io_retry.call(_extract, name="extract")
+            except Exception:
+                log.exception("extraction failed for %s", filename)
+                text, why = None, "extractor_error"
         if text is None or not text.strip():
             # precise, actionable failure (VERDICT r4 item 7): the row says
             # WHY ("pdf_scanned_image_only", "legacy_ole2_document", ...)
@@ -151,9 +219,10 @@ class DocumentPipeline:
                 reg.ERROR_EXTRACTION,
                 detail=why or "empty_text",
             )
+            self._notify_done()
             return self.registry.get(record.doc_id)
         try:
-            self.broker.publish(
+            self._publish(
                 self.cfg.broker.raw_queue,
                 {
                     "doc_id": record.doc_id,
@@ -169,9 +238,33 @@ class DocumentPipeline:
         except Exception:
             log.exception("queue publish failed")
             self.registry.set_status(record.doc_id, reg.ERROR_QUEUE)
+            self._notify_done()
             return self.registry.get(record.doc_id)
         self.registry.set_status(record.doc_id, reg.PROCESSED)
         return self.registry.get(record.doc_id)
+
+    def _publish(self, queue: str, body: Dict[str, Any]) -> None:
+        """Broker publish under the retry policy — a transient broker
+        failure is retried with backoff instead of immediately becoming a
+        terminal ERROR_QUEUE/ERROR_DEID.
+
+        The broker breaker OBSERVES (one outcome per publish, feeding
+        /api/status) but does not gate: a publish has no queue to wait
+        in — ingest is synchronous HTTP — so failing fast during the
+        reset window would turn a recovered broker into 30 s of terminal
+        document errors.  Hold-and-retry is strictly better here."""
+        br = self._broker_breaker
+        try:
+            self._retry.call(
+                lambda: self.broker.publish(queue, body),
+                name="broker_publish",
+            )
+        except Exception:
+            if br is not None:
+                br.record_failure()
+            raise
+        if br is not None:
+            br.record_success()
 
     def ingest_text(self, text: str, **kw):
         """Convenience for pre-extracted text (tests, CSV bootstrap)."""
@@ -181,7 +274,9 @@ class DocumentPipeline:
 
     def _deid_handler(self, bodies: List[Dict[str, Any]]) -> None:
         # Pure phase first — a raise here is side-effect-free, so the
-        # Consumer's one-by-one poison isolation may safely replay the batch.
+        # Consumer's one-by-one poison isolation (and its in-place retry
+        # policy) may safely replay the batch.
+        faults.perturb("deid")  # resilience_site: deid (slow-stage/outage)
         texts = [b["text"] for b in bodies]
         with span("deid_batch", DEFAULT_REGISTRY):
             masked = self.deid.deidentify_batch(texts)
@@ -228,7 +323,7 @@ class DocumentPipeline:
                         "dropping deleted doc %s at deid stage", body["doc_id"]
                     )
                     continue
-                self.broker.publish(
+                self._publish(
                     self.cfg.broker.clean_queue,
                     {
                         "doc_id": body["doc_id"],
@@ -243,10 +338,14 @@ class DocumentPipeline:
                     self.registry.set_status_unless_deleted(
                         body["doc_id"], reg.ERROR_DEID
                     )
+                    self._notify_done()
                 except Exception:
                     log.exception("status write failed for %s", body["doc_id"])
 
     def _index_handler(self, bodies: List[Dict[str, Any]]) -> None:
+        # before any side effect: an injected raise here replays the whole
+        # batch safely (resilience_site: index)
+        faults.perturb("index")
         all_chunks: List[str] = []
         all_meta: List[Dict[str, Any]] = []
         per_doc: List[tuple] = []
@@ -391,21 +490,35 @@ class DocumentPipeline:
                     self.registry.set_status_unless_deleted(doc_id, reg.INDEXED)
             except Exception:
                 log.exception("status write failed for %s", doc_id)
+        if per_doc or replayed:  # wake wait_indexed() blockers
+            self._notify_done()
 
     # ---- completion signal ---------------------------------------------------
 
+    _TERMINAL = (
+        reg.INDEXED,
+        reg.ERROR_EXTRACTION,
+        reg.ERROR_QUEUE,
+        reg.ERROR_DEID,
+        reg.ERROR_INDEXING,
+        reg.DELETED,
+    )
+
     def wait_indexed(self, doc_id: str, timeout: float = 30.0) -> bool:
-        """Real completion signal (vs the reference's 5 s guess)."""
+        """Real completion signal (vs the reference's 5 s guess).
+
+        Blocks on a Condition signaled by every terminal status write
+        (``_index_handler``, error paths, dead-letter callbacks) — no
+        10 ms registry poll per waiting upload.  The wait is still capped
+        (1 s) per cycle: in multi-process registry deployments (Postgres)
+        a FOREIGN process's status write can't notify this Condition."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            record = self.registry.get(doc_id)
-            if record is not None and record.status in (
-                reg.INDEXED,
-                reg.ERROR_EXTRACTION,
-                reg.ERROR_QUEUE,
-                reg.ERROR_DEID,
-                reg.ERROR_INDEXING,
-            ):
-                return record.status == reg.INDEXED
-            time.sleep(0.01)
-        return False
+        with self._done_cv:
+            while True:
+                record = self.registry.get(doc_id)
+                if record is not None and record.status in self._TERMINAL:
+                    return record.status == reg.INDEXED
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped:
+                    return False
+                self._done_cv.wait(min(remaining, 1.0))

@@ -17,11 +17,15 @@ never provided (SURVEY §1 "aspirational API layer"):
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT
-from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT, QueueFull
+from docqa_tpu.resilience import faults
+from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+
+log = get_logger("docqa.qa")
 
 # Our own QA template; same *shape* as the reference's French TCM-expert
 # prompt with score-ranking instructions (``llm-qa/main.py:71-93``) without
@@ -36,25 +40,85 @@ QA_TEMPLATE = (
 )
 
 
+def extractive_answer(chunks: List[str], max_chars: int = 600) -> str:
+    """The degraded-mode answer: the top-k retrieved chunks verbatim.
+
+    Retrieval stays up when generation is down — serving the evidence
+    beats serving a 500.  Deterministic and model-free by construction."""
+    text = "\n\n".join(c for c in chunks if c).strip()
+    if not text:
+        return "Aucun contexte trouvé."
+    return text[:max_chars]
+
+
 @dataclass
 class PendingAnswer:
     """An in-flight ``/ask`` answer: retrieval is done, generation may still
     be decoding in the continuous batcher.  ``resolve()`` blocks for the
     tokens (host-side wait — the caller must NOT hold the device executor,
-    that's the whole point of the split)."""
+    that's the whole point of the split).
+
+    Degraded mode: when generation fails or times out AND the retrieved
+    chunks are on hand (``chunks``), ``resolve()`` falls back to the
+    extractive answer instead of raising — the response carries
+    ``degraded: true`` plus the reason, and ``qa_degraded`` counts it.
+    A submit-time degrade (breaker open / budget too small) arrives here
+    with ``answer`` already set and ``degraded=True``."""
 
     sources: List[str]
     answer: Optional[str] = None  # already final (fake mode / inline path)
     handle: Optional[Any] = None  # engines.serve.Handle when batched
     tokenizer: Optional[Any] = None
+    chunks: List[str] = field(default_factory=list)  # retrieved texts
+    degraded: bool = False
+    degrade_reason: Optional[str] = None
+    breaker: Optional[Any] = None  # decoder CircuitBreaker (outcome sink)
+    degraded_max_chars: int = 600
+
+    def _result(self, answer: str) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"answer": answer, "sources": self.sources}
+        if self.degraded:
+            # key present ONLY on degraded responses: the normal contract
+            # stays exactly {"answer", "sources"} (reference parity)
+            out["degraded"] = True
+            out["degrade_reason"] = self.degrade_reason
+        return out
+
+    def _degrade(self, reason: str) -> Dict[str, Any]:
+        self.degraded = True
+        self.degrade_reason = reason
+        DEFAULT_REGISTRY.counter("qa_degraded").inc()
+        return self._result(
+            extractive_answer(self.chunks, self.degraded_max_chars)
+        )
 
     def resolve(
         self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
     ) -> Dict[str, Any]:
-        answer = self.answer
-        if answer is None:
+        if self.answer is not None:
+            return self._result(self.answer)
+        try:
             answer = self.handle.text(self.tokenizer, timeout)
-        return {"answer": answer, "sources": self.sources}
+        except DeadlineExceeded:
+            # the batcher shed it (queued or mid-decode) — the budget is
+            # (nearly) gone, but the extractive answer is free: serve it.
+            # Not a decoder fault: release any reserved probe instead of
+            # recording an outcome
+            if self.breaker is not None:
+                self.breaker.release_probe()
+            return self._degrade("deadline")
+        except TimeoutError:  # ResultTimeout: slow, possibly hung decode
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            return self._degrade("decode_timeout")
+        except Exception as e:  # decode failed on device
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            log.warning("generation failed; serving degraded answer: %r", e)
+            return self._degrade("decoder_error")
+        if self.breaker is not None:
+            self.breaker.record_success()
+        return self._result(answer)
 
     def iter_text(self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT):
         """Yield answer text incrementally as decode chunks land (SSE
@@ -68,12 +132,26 @@ class PendingAnswer:
             return
         ids: list = []
         emitted = 0
-        for tok in self.handle.iter_tokens(timeout):
-            ids.append(tok)
-            decoded = self.tokenizer.decode_ids(ids)
-            if len(decoded) > emitted:
-                yield decoded[emitted:]
-                emitted = len(decoded)
+        try:
+            for tok in self.handle.iter_tokens(timeout):
+                ids.append(tok)
+                decoded = self.tokenizer.decode_ids(ids)
+                if len(decoded) > emitted:
+                    yield decoded[emitted:]
+                    emitted = len(decoded)
+        except (DeadlineExceeded, GeneratorExit):
+            # budget shed / client disconnect: neither is a decoder
+            # outcome — but the probe slot allow() may have reserved
+            # must come back
+            if self.breaker is not None:
+                self.breaker.release_probe()
+            raise
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            self.breaker.record_success()
 
 
 class QAService:
@@ -88,6 +166,8 @@ class QAService:
         batcher=None,  # ContinuousBatcher: concurrent /ask share decode slots
         retriever=None,  # FusedRetriever: encode+search in one dispatch
         fused_rag=None,  # FusedRAG: single-sync retrieval->prompt->decode
+        breakers=None,  # resilience.BreakerBoard: "decoder" gates generation
+        resilience=None,  # ResilienceConfig: degrade thresholds
     ) -> None:
         self.encoder = encoder
         self.store = store
@@ -98,48 +178,137 @@ class QAService:
         self.batcher = batcher
         self.retriever = retriever
         self.fused_rag = fused_rag
+        self.decoder_breaker = (
+            breakers.get("decoder") if breakers is not None else None
+        )
+        self.min_generate_budget_s = (
+            resilience.min_generate_budget_s if resilience is not None else 0.5
+        )
+        self.degraded_max_chars = (
+            resilience.degraded_max_chars if resilience is not None else 600
+        )
 
-    def _retrieve(self, text: str, k: int, filters=None):
+    def _retrieve(self, text: str, k: int, filters=None, deadline=None):
         """One fused dispatch when a retriever is wired (encoder forward +
         store top-k in a single XLA program — half the tunnel round-trips);
         otherwise the classic encode-then-search pair."""
         if self.retriever is not None:
-            return self.retriever.search_texts([text], k=k, filters=filters)[0]
+            return self.retriever.search_texts(
+                [text], k=k, filters=filters, deadline=deadline
+            )[0]
+        if deadline is not None:
+            deadline.check("retrieve")
         emb = self.encoder.encode_texts([text])
         return self.store.search(emb, k=k, filters=filters)[0]
 
     # ---- /ask/ ---------------------------------------------------------------
 
-    def ask_submit(self, question: str, k: Optional[int] = None) -> PendingAnswer:
+    def _degraded_pending(
+        self, sources: List[str], chunks: List[str], reason: str
+    ) -> PendingAnswer:
+        DEFAULT_REGISTRY.counter("qa_degraded").inc()
+        return PendingAnswer(
+            sources=sources,
+            answer=extractive_answer(chunks, self.degraded_max_chars),
+            chunks=chunks,
+            degraded=True,
+            degrade_reason=reason,
+        )
+
+    def ask_submit(
+        self,
+        question: str,
+        k: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
+    ) -> PendingAnswer:
         """Retrieval + prompt assembly + generation *submission*.
 
         With a batcher, returns immediately after enqueueing the decode —
         concurrent questions ride separate slots of one decode program
         (BASELINE config 5) instead of serializing whole-request (the round-1
         flaw: ``make_app``'s 1-worker device executor made QPS-16 impossible).
-        """
+
+        Failure policy (docs/RESILIENCE.md): retrieval failures propagate
+        (no context, nothing to degrade to); once retrieval has produced
+        chunks, a decoder problem — breaker open, too little budget left
+        for a decode round, or the submission itself failing — serves the
+        *degraded* extractive answer instead of an error.  ``QueueFull``
+        still propagates: an overloaded-but-healthy decoder is admission
+        control (503 + retry), not an outage."""
+        if deadline is not None:
+            deadline.check("qa_admission")
         with span("qa_retrieve", DEFAULT_REGISTRY):
-            hits = self._retrieve(question, k=k or self.k)
-        context = "\n\n".join(
+            hits = self._retrieve(question, k=k or self.k, deadline=deadline)
+        chunks = [
             h.metadata.get("text_content", h.metadata.get("source", ""))
             for h in hits
-        )
+        ]
+        context = "\n\n".join(chunks)
         prompt = QA_TEMPLATE.format(context=context, question=question)
         sources = [h.metadata.get("source", "") for h in hits]
         if self.use_fake_llm:
             answer = context[:500] if context else "Aucun contexte trouvé."
             return PendingAnswer(sources=sources, answer=answer)
-        if self.batcher is not None:
-            return PendingAnswer(
-                sources=sources,
-                handle=self.batcher.submit_text(prompt),
-                tokenizer=self.batcher.engine.tokenizer,
+        if (
+            deadline is not None
+            and deadline.remaining() < self.min_generate_budget_s
+        ):
+            # a decode round it cannot finish would only waste a lane —
+            # checked BEFORE the breaker so a budget shed never consumes
+            # a half-open probe slot
+            return self._degraded_pending(
+                sources, chunks, "insufficient_budget"
             )
-        return PendingAnswer(
-            sources=sources, answer=self.generator.generate_texts([prompt])[0]
-        )
+        breaker = self.decoder_breaker
+        if breaker is not None and not breaker.allow():
+            return self._degraded_pending(
+                sources, chunks, "decoder_breaker_open"
+            )
+        try:
+            faults.perturb("decoder")  # resilience_site: decoder
+            if self.batcher is not None:
+                # deadline passed only when set: batcher stand-ins (tests,
+                # alternative schedulers) need not know the kwarg
+                kw = {} if deadline is None else {"deadline": deadline}
+                return PendingAnswer(
+                    sources=sources,
+                    handle=self.batcher.submit_text(prompt, **kw),
+                    tokenizer=self.batcher.engine.tokenizer,
+                    chunks=chunks,
+                    breaker=breaker,
+                    degraded_max_chars=self.degraded_max_chars,
+                )
+            answer = self.generator.generate_texts([prompt])[0]
+            if breaker is not None:
+                breaker.record_success()
+            return PendingAnswer(
+                sources=sources, answer=answer, chunks=chunks
+            )
+        except QueueFull:
+            # overload ≠ outage: the 503 + client retry is correct.  The
+            # shed never reached the decoder — hand back any half-open
+            # probe slot allow() reserved, or the breaker wedges
+            if breaker is not None:
+                breaker.release_probe()
+            raise
+        except DeadlineExceeded:
+            if breaker is not None:
+                breaker.release_probe()
+            return self._degraded_pending(sources, chunks, "deadline")
+        except Exception as e:
+            if breaker is not None:
+                breaker.record_failure()
+            log.warning(
+                "generation submission failed; serving degraded answer: %r", e
+            )
+            return self._degraded_pending(sources, chunks, "decoder_error")
 
-    def ask(self, question: str, k: Optional[int] = None) -> Dict[str, Any]:
+    def ask(
+        self,
+        question: str,
+        k: Optional[int] = None,
+        deadline: Optional[Deadline] = None,
+    ) -> Dict[str, Any]:
         """Returns the reference's response contract
         ``{"answer": ..., "sources": [...]}`` (``llm-qa/main.py:119-122``).
 
@@ -149,6 +318,8 @@ class QAService:
         Under load (busy batcher) requests keep riding the shared decode
         slots, where throughput beats solo latency; streaming always uses
         the batcher (the fused chain has no incremental fetch)."""
+        if deadline is not None:
+            deadline.check("qa_admission")
         if (
             self.fused_rag is not None
             and (k is None or k == self.k)
@@ -175,7 +346,7 @@ class QAService:
                 )
                 self.fused_rag = None
         with span("qa_e2e", DEFAULT_REGISTRY):
-            return self.ask_submit(question, k).resolve()
+            return self.ask_submit(question, k, deadline=deadline).resolve()
 
     # ---- /api/search/patient-snippets ---------------------------------------
 

@@ -1,0 +1,35 @@
+"""Cross-version jax compatibility shims.
+
+The framework is written against the current jax API; serving containers
+often pin older releases, and an ImportError at module load takes the
+whole service down (the failure mode this repo's resilience layer exists
+to prevent — a version skew should degrade to the equivalent older API,
+not kill the process).  One symbol is shimmed today:
+
+``shard_map`` — newer jax exports it at top level and calls its
+replication-check knob ``check_vma``; older releases ship it under
+``jax.experimental.shard_map`` with the knob named ``check_rep``.  The
+wrapper resolves the import once and renames the knob to whatever the
+resolved function actually accepts.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: public top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg normalized
+    (``check_vma`` <-> ``check_rep``) to the resolved jax version."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)

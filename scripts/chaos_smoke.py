@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Chaos smoke: the in-memory pipeline under a random-but-seeded FaultPlan.
+
+Runs ingest → deid → index end to end while injecting broker publish
+drops, slow/failing deid batches, and index-stage failures at seeded
+random call sites (docs/RESILIENCE.md §5), then asserts **zero lost
+documents**: every ingested document must end in a terminal state —
+INDEXED (its chunks present in the store), or a terminal ERROR_* status
+(dead-lettered / failed at ingest after retries).  Nothing silently
+dropped, nothing stuck in flight, no queue residue.
+
+Deterministic: the same --seed perturbs the same calls every run, so a
+failure here is replayable with the printed command line.
+
+    python scripts/chaos_smoke.py --seed 7 --docs 24
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--docs", type=int, default=16)
+    ap.add_argument("--publish-p", type=float, default=0.25,
+                    help="probability a broker publish drops (per call)")
+    ap.add_argument("--deid-p", type=float, default=0.25,
+                    help="probability a deid batch fails (per call)")
+    ap.add_argument("--slow-deid-s", type=float, default=0.05,
+                    help="stall injected before each failing deid batch")
+    ap.add_argument("--index-p", type=float, default=0.2,
+                    help="probability an index batch fails (per call)")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from docqa_tpu.config import load_config
+    from docqa_tpu.deid.engine import DeidEngine
+    from docqa_tpu.engines.encoder import HashEncoder
+    from docqa_tpu.index.store import VectorStore
+    from docqa_tpu.resilience import BreakerBoard, FaultPlan, FaultRule
+    from docqa_tpu.service import registry as reg
+    from docqa_tpu.service.broker import MemoryBroker
+    from docqa_tpu.service.pipeline import DocumentPipeline
+    from docqa_tpu.service.registry import DocumentRegistry
+
+    cfg = load_config(env={}, overrides={
+        "encoder.embed_dim": 64,
+        "store.dim": 64,
+        "store.shard_capacity": 512,
+        "ner.hidden_dim": 32,
+        "ner.num_layers": 1,
+        "ner.num_heads": 2,
+        "ner.mlp_dim": 64,
+        "ner.train_steps": 0,  # plumbing-mode tagger: chaos targets the
+        # pipeline's failure paths, not deid quality
+        "flags.use_fake_encoder": True,
+        "broker.retry_backoff_s": 0.02,
+        "broker.max_redelivery": 3,
+        "resilience.retry_base_delay_s": 0.01,
+        "resilience.retry_max_delay_s": 0.1,
+        "resilience.breaker_reset_s": 0.2,  # fast recovery window so an
+        # opened circuit re-probes within the smoke's budget
+    })
+
+    broker = MemoryBroker(cfg.broker)
+    registry = DocumentRegistry()
+    breakers = BreakerBoard(
+        failure_threshold=cfg.resilience.breaker_failure_threshold,
+        reset_timeout_s=cfg.resilience.breaker_reset_s,
+    )
+    pipeline = DocumentPipeline(
+        cfg, broker, registry,
+        DeidEngine(cfg.ner),
+        HashEncoder(cfg.encoder),
+        VectorStore(cfg.store),
+        breakers=breakers,
+    )
+
+    plan = FaultPlan(
+        [
+            FaultRule("broker.publish", p=args.publish_p),
+            FaultRule("deid", p=args.deid_p, delay_s=args.slow_deid_s),
+            FaultRule("index", p=args.index_p),
+        ],
+        seed=args.seed,
+    )
+
+    pipeline.start()
+    doc_ids = []
+    t0 = time.monotonic()
+    try:
+        with plan:
+            for i in range(args.docs):
+                rec = pipeline.ingest_document(
+                    f"chaos_{i}.txt",
+                    (
+                        f"Patient p{i} on drug-{i} {10 * (i + 1)} mg daily. "
+                        "BP 120/80. Follow-up scheduled."
+                    ).encode(),
+                    patient_id=f"p{i}",
+                )
+                doc_ids.append(rec.doc_id)
+            # quiescence: every doc terminal, both queues drained
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                statuses = {d: registry.get(d).status for d in doc_ids}
+                if all(
+                    s in DocumentPipeline._TERMINAL for s in statuses.values()
+                ) and broker.drain(cfg.broker.raw_queue, 0.1) and broker.drain(
+                    cfg.broker.clean_queue, 0.1
+                ):
+                    break
+                time.sleep(0.05)
+    finally:
+        pipeline.stop()
+
+    statuses = {d: registry.get(d).status for d in doc_ids}
+    indexed = [d for d, s in statuses.items() if s == reg.INDEXED]
+    errored = [d for d, s in statuses.items() if s.startswith("ERROR")]
+    stuck = [
+        d for d, s in statuses.items()
+        if s not in DocumentPipeline._TERMINAL
+    ]
+    store_docs = {
+        md.get("doc_id") for md in pipeline.store.metadata_rows()
+    }
+    missing_vectors = [d for d in indexed if d not in store_docs]
+    dead = sum(
+        len(broker.dead_letters(q))
+        for q in (cfg.broker.raw_queue, cfg.broker.clean_queue)
+    )
+    residue = sum(
+        broker.depth(q) + broker.in_flight(q)
+        for q in (cfg.broker.raw_queue, cfg.broker.clean_queue)
+    )
+
+    print(
+        f"chaos_smoke seed={args.seed} docs={args.docs} "
+        f"faults_fired={len(plan.log)} elapsed={time.monotonic() - t0:.1f}s\n"
+        f"  indexed={len(indexed)} errored={len(errored)} "
+        f"dead_letters={dead} stuck={len(stuck)} "
+        f"queue_residue={residue} missing_vectors={len(missing_vectors)}"
+    )
+    lost = stuck or missing_vectors or residue
+    if lost:
+        print(f"LOST DOCUMENTS: stuck={stuck} missing={missing_vectors} "
+              f"residue={residue}", file=sys.stderr)
+        return 1
+    print("zero lost documents — every doc acked, dead-lettered, or indexed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,8 @@ def _batch(b=4, s=16, seed=0):
     return ids, lengths
 
 
+@pytest.mark.slow  # full Orbax save/restore/resume cycle (~17 s); see
+# the tier-1 budget note in tests/test_ner_training.py
 def test_save_restore_resume(tmp_path):
     opt = default_optimizer()
     state, opt = init_train_state(jax.random.PRNGKey(0), CFG, opt)

@@ -41,6 +41,8 @@ def _retrieval_acc(params, tokenizer, pairs):
     return float(np.mean(pred == np.arange(len(pairs))))
 
 
+@pytest.mark.slow  # real contrastive training runs (~35 s); see the
+# tier-1 budget note in tests/test_ner_training.py
 class TestContrastiveTraining:
     def test_loss_decreases_and_retrieval_improves(self):
         tokenizer = default_tokenizer(CFG.vocab_size)

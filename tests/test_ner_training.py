@@ -105,8 +105,15 @@ class TestDatagen:
             assert not overlap, (key, overlap)
 
 
+@pytest.mark.slow
 class TestContextualPHI:
-    """VERDICT round-1 item 2's acceptance criteria."""
+    """VERDICT round-1 item 2's acceptance criteria.
+
+    Marked ``slow``: the shared ``engine`` fixture trains a real tagger
+    (~2 min on the CPU test mesh), which alone blows most of the tier-1
+    870 s budget now that the whole suite actually runs (these tests were
+    collection errors before the jax shard_map compat shim).  Full deid
+    quality still runs via ``pytest -m slow`` / an unfiltered run."""
 
     def test_unseen_person_location_no_title_cue(self, engine):
         assert engine.anonymize("John Smith from Boston") == "<PERSON> from <LOCATION>"
@@ -257,6 +264,8 @@ class TestContextualPHI:
             assert leak not in out, out
 
 
+@pytest.mark.slow  # shares TestContextualPHI's trained_params fixture —
+# see that class's note; any one of these triggers the ~2 min training
 class TestPersistence:
     def test_save_load_roundtrip(self, trained_params, tmp_path):
         from docqa_tpu.training.ner import load_ner_params, save_ner_params

@@ -59,6 +59,8 @@ def _wait_health(deadline_s=120):
     return False
 
 
+@pytest.mark.slow  # boots + kills + reboots a real server subprocess
+# (~30 s); tier-1's 870 s budget is tight now that the full suite runs
 def test_supervisor_restarts_after_kill(tmp_path):
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(json.dumps(TINY))
