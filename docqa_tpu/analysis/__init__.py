@@ -1,0 +1,23 @@
+"""docqa-lint: AST invariant analysis for the docqa_tpu tree.
+
+Four project-specific checkers (docs/STATIC_ANALYSIS.md):
+
+* ``deadline-flow``   — request deadlines thread through; waits clamp.
+* ``jit-purity``      — no side effects / host syncs in traced code.
+* ``lock-discipline`` — one lock order; no blocking I/O under a lock.
+* ``phi-taint``       — raw pre-deid text never reaches logs/metrics/
+  external payloads.
+
+Entry points: ``scripts/lint.py`` (CLI) and ``pytest -m lint``
+(tier-1 gate, tests/test_analysis.py).
+"""
+
+from docqa_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Package,
+    all_checkers,
+    analyze_paths,
+    default_baseline_path,
+    run,
+)

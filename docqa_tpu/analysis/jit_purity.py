@@ -1,0 +1,300 @@
+"""jit-purity: no Python side effects or host syncs inside traced code.
+
+A function compiled via ``jax.jit`` / ``pjit`` / ``shard_map`` runs its
+Python body ONCE, at trace time; any side effect in it (metrics counter,
+lock acquisition, ``print``, ``time.*``) silently executes on the wrong
+schedule — once per compile instead of once per call — and any host sync
+(``np.asarray``/``.item()``/``float(tracer)``) either fails under tracing
+or forces a device round-trip that defeats the compiled pipeline.
+
+The checker finds traced **roots**:
+
+* ``@jax.jit`` / ``@functools.partial(jax.jit, …)`` decorators,
+* ``jax.jit(f)`` / ``pjit(f)`` / ``shard_map(f, …)`` call sites, where
+  ``f`` is a bare name (module or nested function), ``self.method``,
+  ``functools.partial(g, …)`` (recursing to ``g``), or a ``lambda``
+  (its body is scanned in place, and package calls inside it widen the
+  closure),
+
+then takes the transitive closure over package-resolvable calls (a
+function *called from* traced code is traced too), and flags in every
+traced function:
+
+* ``print(…)``, ``log.…``/``logging.…`` calls, ``span(…)``;
+* ``time.…`` calls (through import aliases);
+* metrics-registry traffic (any call chain through a ``…registry…``
+  object, or ``.inc(…)``/``.observe(…)``);
+* lock traffic: ``with`` on / ``.acquire()`` of a lock-ish attribute
+  (``…_lock``/``…_cv``/``…lock``);
+* host-sync escapes: ``np.asarray``/``np.array``/``np.copy``,
+  ``.item()``/``.tolist()``, ``jax.device_get``, and ``float()``/
+  ``int()``/``bool()`` applied directly to a traced-function parameter;
+* ``faults.perturb(…)`` (fault injection is host-side by definition);
+* ``open(…)`` and ``global`` statements (IO / mutable-global capture).
+
+Findings attribute the side effect to the function it appears in; when
+that function was reached transitively the message names the jit root.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Package,
+    call_name,
+    dotted_name as call_name_of,
+)
+
+JIT_WRAPPERS = frozenset({"jit", "pjit", "shard_map"})
+LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|cv|mutex|rlock)$|_lock$|_cv$")
+REGISTRY_RE = re.compile(r"registry", re.IGNORECASE)
+HOST_SYNC_CALLS = frozenset(
+    {
+        "numpy.asarray",
+        "numpy.array",
+        "numpy.copy",
+        "jax.device_get",
+    }
+)
+
+
+def _is_jit_wrapper(module, node: ast.AST) -> bool:
+    """True for expressions naming jax.jit / pjit / shard_map (through
+    import aliases), including ``functools.partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name.rsplit(".", 1)[-1] == "partial" and node.args:
+            return _is_jit_wrapper(module, node.args[0])
+        return False
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        dotted = module.resolve_alias(call_name_of(node))
+        return dotted.rsplit(".", 1)[-1] in JIT_WRAPPERS
+    return False
+
+
+class JitPurityChecker:
+    rule = "jit-purity"
+
+    def check(self, package: Package) -> List[Finding]:
+        # function identity -> reason text ("" for direct roots)
+        traced: Dict[int, Tuple[FunctionInfo, str]] = {}
+        lambdas: List[Tuple[FunctionInfo, ast.Lambda, str]] = []
+
+        def mark(fn: Optional[FunctionInfo], via: str) -> None:
+            if fn is None or id(fn.node) in traced:
+                return
+            traced[id(fn.node)] = (fn, via)
+
+        # -- pass 1: roots ----------------------------------------------------
+        for fn in package.functions:
+            node = fn.node
+            for dec in getattr(node, "decorator_list", ()):
+                if _is_jit_wrapper(fn.module, dec) or (
+                    isinstance(dec, ast.Call)
+                    and _is_jit_wrapper(fn.module, dec.func)
+                ):
+                    mark(fn, "")
+        for fn in package.functions:
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                tail = fn.module.resolve_alias(name).rsplit(".", 1)[-1]
+                if tail not in JIT_WRAPPERS or not node.args:
+                    continue
+                self._mark_target(
+                    package, fn, node.args[0], mark, lambdas, via=""
+                )
+        # module-level jit call sites (fn = jax.jit(kernel) at top level)
+        for module in package.modules:
+            scope = FunctionInfo(
+                module=module, node=module.tree, qualname="<module>",
+                class_name=None,
+            )
+            stack = list(ast.iter_child_nodes(module.tree))
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue  # per-function pass covers these
+                stack.extend(ast.iter_child_nodes(node))
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                tail = module.resolve_alias(name).rsplit(".", 1)[-1]
+                if tail not in JIT_WRAPPERS or not node.args:
+                    continue
+                self._mark_target(
+                    package, scope, node.args[0], mark, lambdas, via=""
+                )
+
+        # -- pass 2: transitive closure over package calls --------------------
+        # lambdas participate: their bodies resolve in the enclosing
+        # function's scope, including `name = functools.partial(f, …)`
+        # local aliases (the GenerateEngine spec-decode idiom)
+        frontier: List[Tuple[FunctionInfo, str, ast.AST]] = [
+            (fn, via, fn.node) for fn, via in traced.values()
+        ]
+        frontier.extend(
+            (fn, via or f"{fn.qualname}.<lambda>", lam)
+            for fn, lam, via in lambdas
+        )
+        while frontier:
+            fn, via, body = frontier.pop()
+            root = via or fn.qualname
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                tail = fn.module.resolve_alias(name).rsplit(".", 1)[-1]
+                if tail in JIT_WRAPPERS:
+                    continue  # jit wrapper calls inside traced code
+                callee = package.resolve_call(fn, node)
+                if callee is None and name and "." not in name:
+                    callee = self._partial_alias(package, fn, name)
+                if callee is not None and id(callee.node) not in traced:
+                    traced[id(callee.node)] = (callee, root)
+                    frontier.append((callee, root, callee.node))
+
+        # -- pass 3: scan every traced body -----------------------------------
+        out: List[Finding] = []
+        for fn, via in traced.values():
+            out.extend(self._scan(fn, fn.node, via))
+        for fn, lam, via in lambdas:
+            out.extend(self._scan(fn, lam, via or f"{fn.qualname}.<lambda>"))
+        return out
+
+    def _partial_alias(self, package, fn, name: str):
+        """Resolve a bare call through a local ``name = functools.partial(
+        target, …)`` (or ``name = target``) assignment in the caller."""
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            ):
+                continue
+            value = node.value
+            if (
+                isinstance(value, ast.Call)
+                and call_name(value).rsplit(".", 1)[-1] == "partial"
+                and value.args
+            ):
+                value = value.args[0]
+            fake = ast.Call(func=value, args=[], keywords=[])
+            ast.copy_location(fake, value)
+            resolved = package.resolve_call(fn, fake)
+            if resolved is not None:
+                return resolved
+        return None
+
+    def _mark_target(
+        self, package, fn, target, mark, lambdas, via: str
+    ) -> None:
+        """Resolve the first argument of a jit/shard_map call."""
+        if isinstance(target, ast.Lambda):
+            lambdas.append((fn, target, via))
+            return
+        if isinstance(target, ast.Call):
+            name = call_name(target)
+            if name.rsplit(".", 1)[-1] == "partial" and target.args:
+                self._mark_target(
+                    package, fn, target.args[0], mark, lambdas, via
+                )
+            elif name.rsplit(".", 1)[-1] in JIT_WRAPPERS and target.args:
+                # jax.jit(shard_map(body, ...))
+                self._mark_target(
+                    package, fn, target.args[0], mark, lambdas, via
+                )
+            return
+        fake_call = ast.Call(func=target, args=[], keywords=[])
+        ast.copy_location(fake_call, target)
+        mark(package.resolve_call(fn, fake_call), via)
+
+    # -- body scan ------------------------------------------------------------
+
+    def _scan(self, fn: FunctionInfo, body: ast.AST, via: str) -> List[Finding]:
+        module = fn.module
+        out: List[Finding] = []
+        suffix = f" [traced via {via}]" if via else ""
+        if isinstance(body, ast.Lambda):
+            params = {a.arg for a in body.args.args}
+        elif hasattr(fn.node, "args"):
+            params = set(fn.params)
+        else:  # module-scope pseudo-function
+            params = set()
+
+        def add(node: ast.AST, what: str) -> None:
+            out.append(
+                Finding(
+                    self.rule,
+                    module.relpath,
+                    getattr(node, "lineno", 1),
+                    fn.qualname,
+                    f"{what} inside jit-traced code{suffix}",
+                )
+            )
+
+        # don't descend into nested defs/lambdas here: nested defs inside a
+        # traced function ARE traced (closure), so do descend — but a
+        # nested def containing its own jit wrapping was marked already.
+        for node in ast.walk(body):
+            if isinstance(node, ast.Global):
+                add(node, "global-statement (mutable global capture)")
+                continue
+            if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+                for item in node.items:
+                    text = call_name_of(item.context_expr)
+                    if not text and isinstance(item.context_expr, ast.Call):
+                        text = call_name(item.context_expr)
+                    attr = text.rsplit(".", 1)[-1] if text else ""
+                    if attr and LOCKISH_RE.search(attr):
+                        add(node, f"lock acquisition ('with {text}')")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name:
+                continue
+            resolved = module.resolve_alias(name)
+            head = resolved.split(".")[0]
+            attr = name.rsplit(".", 1)[-1]
+            if name == "print":
+                add(node, "print()")
+            elif head == "time" and "." in resolved:
+                add(node, f"{resolved}() (host clock/sleep)")
+            elif head == "logging" or name.split(".")[0] in ("log", "logger"):
+                add(node, f"logging call {name}()")
+            elif attr == "perturb":
+                add(node, "faults.perturb() (fault-injection hook)")
+            elif name == "span" or resolved.endswith("metrics.span"):
+                add(node, "span() (metrics/tracing context)")
+            elif attr in ("inc", "observe") or (
+                "." in name
+                and REGISTRY_RE.search(name.rsplit(".", 1)[0])
+                and attr in ("counter", "histogram", "gauge")
+            ):
+                add(node, f"metrics call {name}()")
+            elif attr == "acquire" and LOCKISH_RE.search(
+                name.rsplit(".", 2)[-2] if name.count(".") >= 1 else ""
+            ):
+                add(node, f"lock acquisition ({name}())")
+            elif resolved in HOST_SYNC_CALLS or attr in ("item", "tolist"):
+                add(node, f"host-sync escape {name}()")
+            elif name == "open":
+                add(node, "open() (file IO)")
+            elif name in ("float", "int", "bool") and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Name) and a.id in params:
+                    add(
+                        node,
+                        f"{name}() on a traced argument (host-sync escape)",
+                    )
+        return out

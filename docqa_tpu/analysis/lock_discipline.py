@@ -1,0 +1,337 @@
+"""lock-discipline: consistent acquisition order, no blocking I/O held.
+
+The serving process holds ~a dozen ``threading.Lock``/``Condition``
+instances (batcher CV, broker CV, store RLock, registry lock, pipeline
+suppress lock, tiered rebuild lock, metrics locks).  Two classes of bug
+regress silently:
+
+* **inconsistent ordering** — thread 1 acquires A then B, thread 2
+  acquires B then A: a deadlock that only fires under load.  The checker
+  discovers lock attributes (``self.X = threading.Lock()/RLock()/
+  Condition()``, plus module-level ones), builds the acquisition graph
+  (edges from every held lock to each lock acquired under it, including
+  one level through package-resolvable calls), and flags every 2-cycle.
+  Lock identity is ``Class.attr`` for ``self`` attributes and the
+  receiver text otherwise — an approximation without types, so two
+  *instances* of one class's lock are one node (conservative: flags the
+  pattern, which is what ordering discipline is about).
+* **blocking while holding a lock** — broker publishes, journal fsyncs,
+  registry/DB writes, checkpoint loads, thread joins, sleeps, decode
+  waits performed inside a critical section stall every other thread
+  contending for that lock.  Blocking-ness propagates through
+  package-resolvable calls (``publish`` under a lock is flagged even when
+  the fsync lives two calls down).  ``cv.wait(…)`` on the *held*
+  condition is the one legitimate blocking-under-lock (it releases), and
+  is exempt.
+
+Both sub-rules are per-site findings; deliberate exceptions (e.g. the
+broker's journal write, whose ordering IS the lock's job) belong in the
+baseline with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Package,
+    call_name,
+    stmt_walk as _stmt_walk,
+)
+
+LOCK_FACTORY_RE = re.compile(
+    r"threading\.(?:Lock|RLock|Condition)\b|multiprocessing\.Lock\b"
+)
+LOCKISH_ATTR_RE = re.compile(r"(?:^|_)(?:lock|cv|mutex|rlock)$|_lock$|_cv$")
+
+# Attribute names whose calls block the calling thread.  Deliberately
+# curated for this codebase (broker publishes, registry writes, journal
+# fsync, decode waits); generic DB cursor traffic (``execute``/``commit``)
+# is excluded — the registry's lock exists precisely to serialize its
+# connection, and flagging its own design would be noise.
+BLOCKING_ATTRS = frozenset(
+    {
+        "sleep",
+        "publish",
+        "get_many",
+        "communicate",
+        "urlopen",
+        "fsync",
+        "result",
+        "drain",
+        "wait",
+        "set_status",
+        "set_status_unless_deleted",
+        "list_documents",
+        "encode_texts",
+        "deidentify_batch",
+        "extract_text_ex",
+        "load_checkpoint_dir",
+    }
+)
+
+# ``.join`` is blocking only on thread-like receivers — ``str.join`` /
+# ``os.path.join`` share the attribute name.
+THREADISH_RE = re.compile(r"worker|thread|proc|consumer", re.IGNORECASE)
+
+
+def _is_blocking_call(module, node: ast.Call) -> Optional[str]:
+    """Blocking description for this call, or None."""
+    name = call_name(node)
+    if not name:
+        return None
+    attr = name.rsplit(".", 1)[-1]
+    receiver = name.rsplit(".", 1)[0] if "." in name else ""
+    resolved = module.resolve_alias(name)
+    if attr in BLOCKING_ATTRS:
+        return name
+    if resolved == "time.sleep" or resolved == "os.fsync":
+        return resolved
+    if attr == "join" and (
+        THREADISH_RE.search(receiver)
+        or any(kw.arg == "timeout" for kw in node.keywords)
+    ):
+        return name
+    return None
+
+
+class LockDisciplineChecker:
+    rule = "lock-discipline"
+
+    # -- lock discovery -------------------------------------------------------
+
+    def _discover_locks(self, package: Package) -> Set[str]:
+        """Attribute/variable names assigned a threading primitive."""
+        names: Set[str] = set()
+        for module in package.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = getattr(node, "value", None)
+                if value is None:
+                    continue
+                text = ""
+                try:
+                    text = ast.unparse(value)
+                except Exception:
+                    pass
+                if not LOCK_FACTORY_RE.search(text):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    def _lock_id(
+        self, fn: FunctionInfo, expr_text: str
+    ) -> str:
+        """Stable identity: Class.attr for self attrs, receiver text else."""
+        attr = expr_text.rsplit(".", 1)[-1]
+        if expr_text.startswith("self.") and fn.class_name:
+            return f"{fn.class_name}.{attr}"
+        return expr_text
+
+    def _is_lock_expr(self, text: str, known: Set[str]) -> bool:
+        if not text:
+            return False
+        attr = text.rsplit(".", 1)[-1]
+        return attr in known or bool(LOCKISH_ATTR_RE.search(attr))
+
+    # -- blocking propagation -------------------------------------------------
+
+    def _direct_blocking(
+        self, fn: FunctionInfo
+    ) -> List[Tuple[ast.Call, str]]:
+        out = []
+        for node in _stmt_walk(fn.node):
+            if isinstance(node, ast.Call):
+                desc = _is_blocking_call(fn.module, node)
+                if desc is not None:
+                    out.append((node, desc))
+        return out
+
+    def _blocking_closure(
+        self, package: Package
+    ) -> Dict[int, Set[str]]:
+        """fn-node-id -> set of blocking descriptions reachable from it."""
+        blocking: Dict[int, Set[str]] = {}
+        for fn in package.functions:
+            direct = {
+                name for _node, name in self._direct_blocking(fn)
+            }
+            if direct:
+                blocking[id(fn.node)] = direct
+        changed = True
+        while changed:
+            changed = False
+            for fn in package.functions:
+                for node in _stmt_walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = package.resolve_call(fn, node)
+                    if callee is None:
+                        continue
+                    sub = blocking.get(id(callee.node))
+                    if not sub:
+                        continue
+                    cur = blocking.setdefault(id(fn.node), set())
+                    # propagate the callee NAME only (bounded strings)
+                    tag = f"{call_name(node)}()"
+                    if tag not in cur:
+                        cur.add(tag)
+                        changed = True
+        return blocking
+
+    # -- main -----------------------------------------------------------------
+
+    def check(self, package: Package) -> List[Finding]:
+        known_locks = self._discover_locks(package)
+        blocking = self._blocking_closure(package)
+        out: List[Finding] = []
+        # acquisition-order edges: (A, B) -> first example site
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        for fn in package.functions:
+            self._check_fn(package, fn, known_locks, blocking, edges, out)
+
+        # 2-cycles in the acquisition graph
+        reported: Set[frozenset] = set()
+        for (a, b), (path, line, sym) in sorted(edges.items()):
+            if (b, a) in edges and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                p2, l2, s2 = edges[(b, a)]
+                out.append(
+                    Finding(
+                        self.rule,
+                        path,
+                        line,
+                        sym,
+                        f"inconsistent lock order: {a} -> {b} here but "
+                        f"{b} -> {a} in {s2} ({p2}:{l2})",
+                    )
+                )
+        return out
+
+    def _check_fn(
+        self,
+        package: Package,
+        fn: FunctionInfo,
+        known_locks: Set[str],
+        blocking: Dict[int, Set[str]],
+        edges: Dict,
+        out: List[Finding],
+    ) -> None:
+        module = fn.module
+
+        def visit(node: ast.AST, held: List[Tuple[str, str]]) -> None:
+            # held: list of (lock_id, receiver_text)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    acquired: List[Tuple[str, str]] = []
+                    for item in child.items:
+                        try:
+                            text = ast.unparse(item.context_expr)
+                        except Exception:
+                            text = ""
+                        if isinstance(item.context_expr, ast.Call):
+                            continue  # with span(...), with open(...) ...
+                        if self._is_lock_expr(text, known_locks):
+                            lock = self._lock_id(fn, text)
+                            # edges from every already-held lock AND from
+                            # earlier items of this same with-statement
+                            # (`with a, b:` acquires a then b — the
+                            # canonical deadlock pair against
+                            # `with b: with a:` elsewhere)
+                            for h, _r in held + acquired:
+                                if h != lock:
+                                    edges.setdefault(
+                                        (h, lock),
+                                        (module.relpath, child.lineno,
+                                         fn.qualname),
+                                    )
+                            acquired.append((lock, text))
+                    visit(child, held + acquired)
+                    continue
+                if isinstance(child, ast.Call) and held:
+                    name = call_name(child)
+                    attr = name.rsplit(".", 1)[-1] if name else ""
+                    receiver = name.rsplit(".", 1)[0] if "." in name else ""
+                    held_receivers = {r for _h, r in held}
+                    if attr in ("wait", "notify", "notify_all") and (
+                        receiver in held_receivers
+                    ):
+                        pass  # cv ops on the held lock are the pattern
+                    elif _is_blocking_call(module, child) is not None:
+                        out.append(
+                            Finding(
+                                self.rule,
+                                module.relpath,
+                                child.lineno,
+                                fn.qualname,
+                                f"blocking call {name}() while holding "
+                                f"{held[-1][0]}",
+                            )
+                        )
+                    else:
+                        callee = package.resolve_call(fn, child)
+                        if callee is not None:
+                            sub = blocking.get(id(callee.node))
+                            if sub:
+                                out.append(
+                                    Finding(
+                                        self.rule,
+                                        module.relpath,
+                                        child.lineno,
+                                        fn.qualname,
+                                        f"call {name}() blocks (via "
+                                        f"{sorted(sub)[0]}) while holding "
+                                        f"{held[-1][0]}",
+                                    )
+                                )
+                            # cross-call lock-order edges
+                            for lock in self._locks_acquired(
+                                callee, known_locks
+                            ):
+                                for h, _r in held:
+                                    if h != lock:
+                                        edges.setdefault(
+                                            (h, lock),
+                                            (
+                                                module.relpath,
+                                                child.lineno,
+                                                fn.qualname,
+                                            ),
+                                        )
+                visit(child, held)
+
+        visit(fn.node, [])
+
+    def _locks_acquired(
+        self, fn: FunctionInfo, known_locks: Set[str]
+    ) -> Set[str]:
+        out: Set[str] = set()
+        for node in _stmt_walk(fn.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        continue
+                    try:
+                        text = ast.unparse(item.context_expr)
+                    except Exception:
+                        continue
+                    if self._is_lock_expr(text, known_locks):
+                        out.add(self._lock_id(fn, text))
+        return out

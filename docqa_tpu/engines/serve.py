@@ -534,23 +534,40 @@ class ContinuousBatcher:
         any N.  Backpressure (``max_queue``) is an admission-control signal
         for ONLINE callers; a bulk batch instead waits for the queue to
         drain — shedding mid-batch would abandon already-admitted work.
-        The wait is bounded (``DEFAULT_RESULT_TIMEOUT``), and a batcher
-        with queueing disabled outright (``max_queue=0``) fails fast."""
-        import time as _time
-
+        The whole call is bounded end to end (``DEFAULT_RESULT_TIMEOUT``
+        as a :class:`Deadline` threaded through every submit and wait),
+        and a batcher with queueing disabled outright (``max_queue=0``)
+        fails fast.  Queue-full waits ride the batcher's condition
+        variable — ``_pop_free_slots`` notifies as admissions drain the
+        queue — instead of sleep-polling the serving path."""
         if self.max_queue == 0:
             raise QueueFull("batcher has queueing disabled (max_queue=0)")
-        deadline = _time.monotonic() + DEFAULT_RESULT_TIMEOUT
+        deadline = Deadline.after(DEFAULT_RESULT_TIMEOUT)
         handles = []
         for p in prompts:
             while True:
                 try:
-                    handles.append(self.submit_text(p, max_new_tokens))
+                    handles.append(
+                        self.submit_text(p, max_new_tokens, deadline=deadline)
+                    )
                     break
+                except DeadlineExceeded as e:
+                    # the bulk budget lapsed between the capacity wait and
+                    # this resubmit (admission sheds expired deadlines) —
+                    # keep the method's documented failure mode
+                    raise QueueFull(
+                        "generation queue stayed full past the bulk "
+                        f"budget ({e})",
+                        n_queued=self.n_queued,
+                        n_active=self.n_active,
+                    ) from e
                 except QueueFull:
-                    if _time.monotonic() > deadline:
+                    if deadline.expired:
                         raise
-                    _time.sleep(0.005)  # the queue drains at decode pace
+                    # woken when an admission round frees queue space; the
+                    # 50 ms cap bounds the wait against a stalled worker
+                    with self._cv:
+                        self._cv.wait(deadline.bound(0.05))
         return [h.text(self.engine.tokenizer) for h in handles]
 
     def stop(self) -> None:
@@ -850,12 +867,14 @@ class ContinuousBatcher:
         never admitted: prefilling them would spend a batched forward on
         answers nobody is waiting for (the BENCH_r05 pile-up)."""
         taken = {s for s, _ in pairs}
+        drained = False
         for slot in range(self.n_slots):
             if self._slot_req[slot] is not None or slot in taken:
                 continue
             filled = False
             while self._queue and not filled:
                 req = self._queue.popleft()
+                drained = True
                 if req.deadline is not None and req.deadline.expired:
                     req.error = DeadlineExceeded(
                         "serve_queue", -req.deadline.remaining()
@@ -867,6 +886,10 @@ class ContinuousBatcher:
                 filled = True
             if not self._queue and not filled:
                 break
+        if drained:
+            # wake bulk submitters blocked on queue capacity
+            # (generate_texts waits on this condition, not a sleep poll)
+            self._cv.notify_all()
 
     def _run(self) -> None:
         # The one dispatched-but-unprocessed decode chunk: (packed device

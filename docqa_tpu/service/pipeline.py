@@ -152,9 +152,12 @@ class DocumentPipeline:
         """Never index this document, even if its pipeline message is still
         queued or replays later — the deletion path calls this so a DELETE
         racing the async pipeline cannot resurrect the document.  Blocks
-        while an index-worker batch is inside its add/status critical
+        while an index-worker batch is inside its store-add critical
         section: on return, the doc's chunks are either dropped or already
-        in the store where the caller's ``delete_docs`` will find them."""
+        in the store where the caller's ``delete_docs`` will find them.
+        (Registry status writes run OUTSIDE the lock; the DELETED status
+        the caller writes afterwards wins either way because the worker's
+        writes are conditional at the database.)"""
         with self._suppress_lock:
             self._suppressed_doc_ids.add(doc_id)
 
@@ -286,38 +289,40 @@ class DocumentPipeline:
             try:
                 # deleted docs stop HERE, not just at the index worker: a
                 # DEIDENTIFIED overwrite of DELETED would advertise an
-                # erased doc as alive, and the clean-queue publish would
-                # re-arm its resurrection across a restart (the replayed
-                # message would pass the index worker's DELETED check
-                # because this very write changed the status)
+                # erased doc as alive.  The suppress lock covers ONLY the
+                # set membership read — registry I/O (SQLite/Postgres
+                # writes) must not run inside it, or every DELETE blocks
+                # behind this worker's database round-trips
+                # (docqa-lint: lock-discipline).  Correctness without the
+                # wider section: the status write is conditional AT the
+                # database (UPDATE ... WHERE status != DELETED), so a
+                # DELETE committing first makes this write refuse, and a
+                # DELETE committing after overwrites DEIDENTIFIED with
+                # DELETED — either order ends DELETED, and the index
+                # worker re-checks both the registry and the suppression
+                # set before touching the store.
                 with self._suppress_lock:
                     suppressed = body["doc_id"] in self._suppressed_doc_ids
-                    if not suppressed:
-                        # status BEFORE publish (and inside the lock, so an
-                        # in-process DELETE either lands before this check
-                        # or writes DELETED after us): once the message is
-                        # on the clean queue the index worker may race us
-                        # to INDEXED, which must not be overwritten by a
-                        # late DEIDENTIFIED.  The conditional write also
-                        # refuses atomically if a FOREIGN process committed
-                        # DELETED — a read-then-write pair would leave a
-                        # resurrection window between the two statements.
-                        if not self.registry.set_status_unless_deleted(
-                            body["doc_id"], reg.DEIDENTIFIED
-                        ):
-                            # rowcount 0 is ambiguous: DELETED row, or no
-                            # row at all (registry restored from an older
-                            # snapshot / out-of-band enqueue).  Only a
-                            # DELETED row suppresses; an absent row keeps
-                            # the message flowing (prior behavior).
-                            record = self.registry.get(body["doc_id"])
-                            suppressed = record is not None
-                            if record is None:
-                                log.warning(
-                                    "doc %s not in registry; processing "
-                                    "anyway",
-                                    body["doc_id"],
-                                )
+                if not suppressed:
+                    # status BEFORE publish: once the message is on the
+                    # clean queue the index worker may race us to INDEXED,
+                    # which must not be overwritten by a late DEIDENTIFIED
+                    if not self.registry.set_status_unless_deleted(
+                        body["doc_id"], reg.DEIDENTIFIED
+                    ):
+                        # rowcount 0 is ambiguous: DELETED row, or no
+                        # row at all (registry restored from an older
+                        # snapshot / out-of-band enqueue).  Only a
+                        # DELETED row suppresses; an absent row keeps
+                        # the message flowing (prior behavior).
+                        record = self.registry.get(body["doc_id"])
+                        suppressed = record is not None
+                        if record is None:
+                            log.warning(
+                                "doc %s not in registry; processing "
+                                "anyway",
+                                body["doc_id"],
+                            )
                 if suppressed:
                     log.info(
                         "dropping deleted doc %s at deid stage", body["doc_id"]
@@ -456,38 +461,45 @@ class DocumentPipeline:
                 log.exception("on_indexed hook failed")
         for doc_id, n in per_doc:
             try:
+                # a DELETE between store.add and here already wrote (or
+                # is about to write) DELETED; an INDEXED overwrite would
+                # advertise a doc whose vectors are tombstoned.  The
+                # suppress lock covers ONLY the set read — the registry
+                # write (database I/O) runs outside it so DELETEs never
+                # queue behind this worker's commits (docqa-lint:
+                # lock-discipline).  Races stay closed without the wider
+                # section: the write is conditional AT the database
+                # (UPDATE ... WHERE status != DELETED), atomic against
+                # both an in-process DELETE (which writes DELETED after
+                # its suppress_doc, overwriting any INDEXED that slipped
+                # in between) and a foreign process's DELETE committing
+                # mid-loop (Postgres multi-process mode).  (Cross-process
+                # deletes still cannot drop this process's in-flight
+                # vectors; those rows stay tombstone-filtered at query
+                # time once the deleter's delete_docs reaches the store
+                # snapshot — see docs/OPERATIONS.md.)
                 with self._suppress_lock:
-                    # a DELETE between store.add and here already wrote (or
-                    # is about to write) DELETED; an INDEXED overwrite would
-                    # advertise a doc whose vectors are tombstoned.  The
-                    # in-process suppression set only sees DELETEs handled by
-                    # THIS process — in multi-process registry mode (Postgres)
-                    # another service process writes DELETED straight to the
-                    # shared registry, so the write is conditional AT the
-                    # database (UPDATE ... WHERE status != DELETED): atomic
-                    # even against a foreign DELETE committing mid-loop.
-                    # (Cross-process deletes still cannot drop this process's
-                    # in-flight vectors; those rows stay tombstone-filtered at
-                    # query time once the deleter's delete_docs reaches the
-                    # store snapshot — see docs/OPERATIONS.md.)
-                    if doc_id in self._suppressed_doc_ids:
-                        continue
-                    self.registry.set_status_unless_deleted(
-                        doc_id, reg.INDEXED, n_chunks=n
-                    )
+                    skip = doc_id in self._suppressed_doc_ids
+                if skip:
+                    continue
+                self.registry.set_status_unless_deleted(
+                    doc_id, reg.INDEXED, n_chunks=n
+                )
             except Exception:
                 log.exception("status write failed for %s", doc_id)
         for doc_id in replayed:
             # the crash the replay recovers from may have hit between the
             # snapshot and the status write — make the registry agree with
             # the vectors it already has (idempotent overwrite).  Same
-            # guard as the per_doc loop: a DELETE that landed while this
-            # batch was in the encoder must not be overwritten by INDEXED.
+            # guard and same narrow locking as the per_doc loop: a DELETE
+            # that landed while this batch was in the encoder must not be
+            # overwritten by INDEXED.
             try:
                 with self._suppress_lock:
-                    if doc_id in self._suppressed_doc_ids:
-                        continue
-                    self.registry.set_status_unless_deleted(doc_id, reg.INDEXED)
+                    skip = doc_id in self._suppressed_doc_ids
+                if skip:
+                    continue
+                self.registry.set_status_unless_deleted(doc_id, reg.INDEXED)
             except Exception:
                 log.exception("status write failed for %s", doc_id)
         if per_doc or replayed:  # wake wait_indexed() blockers

@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""docqa-lint CLI: run the AST invariant checkers over a tree.
+
+Usage:
+    python scripts/lint.py docqa_tpu               # full gate (exit 1 on new)
+    python scripts/lint.py docqa_tpu --rules jit-purity,phi-taint
+    python scripts/lint.py docqa_tpu --update-baseline   # accept current
+    python scripts/lint.py docqa_tpu --no-baseline       # raw findings
+    python scripts/lint.py docqa_tpu --format json
+
+The gate fails (exit 1) on any finding not in the baseline AND on any
+stale baseline entry (accepted finding that no longer fires) — the
+checked-in ledger must match the tree exactly.  Per-line suppressions
+(``# docqa-lint: disable=<rule>``) are applied before baselining.
+See docs/STATIC_ANALYSIS.md for the rule set and workflows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from docqa_tpu.analysis import (  # noqa: E402
+    Baseline,
+    all_checkers,
+    analyze_paths,
+    default_baseline_path,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["docqa_tpu"],
+        help="package directories (or single files) to analyze",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help=f"comma-separated subset of: {', '.join(sorted(all_checkers()))}",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline JSON path (default: <repo>/lint_baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: report every finding and exit 1 on any",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to accept every current finding "
+        "(justifications in existing entries are preserved)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    args = parser.parse_args(argv)
+
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    paths = args.paths or ["docqa_tpu"]
+    # one parse pass yields both the findings and the run's scope: a
+    # --rules or sub-path invocation must neither report out-of-scope
+    # baseline entries as stale nor (on update) destroy them
+    findings, analyzed = analyze_paths(paths, rules=rules)
+    active_rules = set(rules) if rules else set(all_checkers())
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.no_baseline:
+        new, matched, stale = findings, [], []
+    else:
+        baseline = Baseline.load(baseline_path)
+        new, matched, stale = baseline.split(findings)
+        stale = [
+            e
+            for e in stale
+            if e.get("rule") in active_rules and e.get("path") in analyzed
+        ]
+
+    if args.update_baseline:
+        updated = Baseline.load(baseline_path).updated(
+            findings, active_rules, analyzed
+        )
+        updated.save(baseline_path)
+        print(
+            f"baseline updated: {len(updated.entries)} entrie(s) -> "
+            f"{baseline_path}"
+        )
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [f.__dict__ for f in new],
+                    "baselined": [f.__dict__ for f in matched],
+                    "stale_baseline_entries": stale,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.format())
+        for e in stale:
+            print(
+                f"STALE baseline entry (no longer fires): [{e.get('rule')}] "
+                f"{e.get('path')} {e.get('symbol')}: {e.get('message')}"
+            )
+        print(
+            f"docqa-lint: {len(new)} new finding(s), {len(matched)} "
+            f"baselined, {len(stale)} stale baseline entrie(s)"
+        )
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,9 +43,21 @@ def main() -> None:
     arr = jax.make_array_from_process_local_data(
         ctx.row_sharded, local, (jax.device_count(),)
     )
-    total = jax.jit(
-        jnp.sum, out_shardings=NamedSharding(ctx.mesh, P())
-    )(arr)
+    try:
+        total = jax.jit(
+            jnp.sum, out_shardings=NamedSharding(ctx.mesh, P())
+        )(arr)
+    except Exception as e:  # noqa: BLE001 - classified below, re-raised else
+        # Some jaxlib builds implement the distributed RUNTIME (init,
+        # process discovery, global mesh — all asserted above) but not
+        # multiprocess COLLECTIVES on the CPU backend.  That is an
+        # environment limitation, not a framework regression: report it
+        # distinctly (rc=3) so the test can skip instead of fail, without
+        # masking real crashes (any other failure still exits nonzero).
+        if "aren't implemented on the CPU backend" in str(e):
+            print("MULTIHOST_UNSUPPORTED cpu-collectives", flush=True)
+            sys.exit(3)
+        raise
     print(f"MULTIHOST_OK {float(total)}", flush=True)
 
 
