@@ -1,15 +1,24 @@
 """docqa-lint: AST invariant analysis for the docqa_tpu tree.
 
-Four project-specific checkers (docs/STATIC_ANALYSIS.md):
+Seven project-specific checkers (docs/STATIC_ANALYSIS.md):
 
 * ``deadline-flow``   — request deadlines thread through; waits clamp.
+* ``donation``        — buffers donated to jitted calls aren't read after.
 * ``jit-purity``      — no side effects / host syncs in traced code.
 * ``lock-discipline`` — one lock order; no blocking I/O under a lock.
+* ``mesh-axes``       — sharding/collective axis names resolve to the
+  declared mesh; collectives stay inside their ``shard_map``.
 * ``phi-taint``       — raw pre-deid text never reaches logs/metrics/
   external payloads.
+* ``spec-shape``      — PartitionSpec arity matches the annotated rank.
 
-Entry points: ``scripts/lint.py`` (CLI) and ``pytest -m lint``
-(tier-1 gate, tests/test_analysis.py).
+Tier B lives in ``analysis/shard_audit.py`` (docs/SHARDING.md): lower the
+device-plane programs on virtual meshes and hold their collective counts
+to the checked-in ``shard_budget.json``.
+
+Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` (CLIs) and
+``pytest -m lint`` (tier-1 gate, tests/test_analysis.py,
+tests/test_shardcheck.py, tests/test_shard_audit.py).
 """
 
 from docqa_tpu.analysis.core import (  # noqa: F401

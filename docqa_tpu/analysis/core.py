@@ -487,15 +487,21 @@ class Baseline:
 def all_checkers() -> Dict[str, object]:
     """Rule name -> checker instance (import here to avoid cycles)."""
     from docqa_tpu.analysis.deadline_flow import DeadlineFlowChecker
+    from docqa_tpu.analysis.donation import DonationChecker
     from docqa_tpu.analysis.jit_purity import JitPurityChecker
     from docqa_tpu.analysis.lock_discipline import LockDisciplineChecker
+    from docqa_tpu.analysis.mesh_axes import MeshAxesChecker
     from docqa_tpu.analysis.phi_taint import PhiTaintChecker
+    from docqa_tpu.analysis.spec_shape import SpecShapeChecker
 
     checkers = [
         DeadlineFlowChecker(),
+        DonationChecker(),
         JitPurityChecker(),
         LockDisciplineChecker(),
+        MeshAxesChecker(),
         PhiTaintChecker(),
+        SpecShapeChecker(),
     ]
     return {c.rule: c for c in checkers}
 

@@ -77,62 +77,81 @@ def _is_jit_wrapper(module, node: ast.AST) -> bool:
     return False
 
 
+def discover_jit_roots(
+    package: Package,
+) -> Tuple[Dict[int, Tuple[FunctionInfo, str]], List[Tuple[FunctionInfo, ast.Lambda, str]]]:
+    """Direct traced roots of a package: functions/lambdas handed to
+    ``jax.jit``/``pjit``/``shard_map`` (decorators, call sites, partials,
+    builder returns, module-level assignments).  Returns ``(roots,
+    lambdas)`` keyed/labelled exactly the way :class:`JitPurityChecker`
+    consumes them; the sharding audit (``analysis/shard_audit.py``) reuses
+    this enumeration so its ``shard_budget.json`` root ledger can never
+    drift from what jit-purity considers traced."""
+    checker = JitPurityChecker()
+    traced: Dict[int, Tuple[FunctionInfo, str]] = {}
+    lambdas: List[Tuple[FunctionInfo, ast.Lambda, str]] = []
+
+    def mark(fn: Optional[FunctionInfo], via: str) -> None:
+        if fn is None or id(fn.node) in traced:
+            return
+        traced[id(fn.node)] = (fn, via)
+
+    for fn in package.functions:
+        node = fn.node
+        for dec in getattr(node, "decorator_list", ()):
+            if _is_jit_wrapper(fn.module, dec) or (
+                isinstance(dec, ast.Call)
+                and _is_jit_wrapper(fn.module, dec.func)
+            ):
+                mark(fn, "")
+    for fn in package.functions:
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            tail = fn.module.resolve_alias(name).rsplit(".", 1)[-1]
+            if tail not in JIT_WRAPPERS or not node.args:
+                continue
+            checker._mark_target(
+                package, fn, node.args[0], mark, lambdas, via=""
+            )
+    # module-level jit call sites (fn = jax.jit(kernel) at top level)
+    for module in package.modules:
+        scope = FunctionInfo(
+            module=module, node=module.tree, qualname="<module>",
+            class_name=None,
+        )
+        stack = list(ast.iter_child_nodes(module.tree))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # per-function pass covers these
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            tail = module.resolve_alias(name).rsplit(".", 1)[-1]
+            if tail not in JIT_WRAPPERS or not node.args:
+                continue
+            checker._mark_target(
+                package, scope, node.args[0], mark, lambdas, via=""
+            )
+    return traced, lambdas
+
+
 class JitPurityChecker:
     rule = "jit-purity"
 
     def check(self, package: Package) -> List[Finding]:
         # function identity -> reason text ("" for direct roots)
-        traced: Dict[int, Tuple[FunctionInfo, str]] = {}
-        lambdas: List[Tuple[FunctionInfo, ast.Lambda, str]] = []
+        traced, lambdas = discover_jit_roots(package)
 
         def mark(fn: Optional[FunctionInfo], via: str) -> None:
             if fn is None or id(fn.node) in traced:
                 return
             traced[id(fn.node)] = (fn, via)
-
-        # -- pass 1: roots ----------------------------------------------------
-        for fn in package.functions:
-            node = fn.node
-            for dec in getattr(node, "decorator_list", ()):
-                if _is_jit_wrapper(fn.module, dec) or (
-                    isinstance(dec, ast.Call)
-                    and _is_jit_wrapper(fn.module, dec.func)
-                ):
-                    mark(fn, "")
-        for fn in package.functions:
-            for node in ast.walk(fn.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = call_name(node)
-                tail = fn.module.resolve_alias(name).rsplit(".", 1)[-1]
-                if tail not in JIT_WRAPPERS or not node.args:
-                    continue
-                self._mark_target(
-                    package, fn, node.args[0], mark, lambdas, via=""
-                )
-        # module-level jit call sites (fn = jax.jit(kernel) at top level)
-        for module in package.modules:
-            scope = FunctionInfo(
-                module=module, node=module.tree, qualname="<module>",
-                class_name=None,
-            )
-            stack = list(ast.iter_child_nodes(module.tree))
-            while stack:
-                node = stack.pop()
-                if isinstance(
-                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-                ):
-                    continue  # per-function pass covers these
-                stack.extend(ast.iter_child_nodes(node))
-                if not isinstance(node, ast.Call):
-                    continue
-                name = call_name(node)
-                tail = module.resolve_alias(name).rsplit(".", 1)[-1]
-                if tail not in JIT_WRAPPERS or not node.args:
-                    continue
-                self._mark_target(
-                    package, scope, node.args[0], mark, lambdas, via=""
-                )
 
         # -- pass 2: transitive closure over package calls --------------------
         # lambdas participate: their bodies resolve in the enclosing
@@ -196,9 +215,11 @@ class JitPurityChecker:
         return None
 
     def _mark_target(
-        self, package, fn, target, mark, lambdas, via: str
+        self, package, fn, target, mark, lambdas, via: str, depth: int = 0
     ) -> None:
         """Resolve the first argument of a jit/shard_map call."""
+        if depth > 6:
+            return
         if isinstance(target, ast.Lambda):
             lambdas.append((fn, target, via))
             return
@@ -206,13 +227,34 @@ class JitPurityChecker:
             name = call_name(target)
             if name.rsplit(".", 1)[-1] == "partial" and target.args:
                 self._mark_target(
-                    package, fn, target.args[0], mark, lambdas, via
+                    package, fn, target.args[0], mark, lambdas, via,
+                    depth + 1,
                 )
             elif name.rsplit(".", 1)[-1] in JIT_WRAPPERS and target.args:
                 # jax.jit(shard_map(body, ...))
                 self._mark_target(
-                    package, fn, target.args[0], mark, lambdas, via
+                    package, fn, target.args[0], mark, lambdas, via,
+                    depth + 1,
                 )
+            else:
+                # jax.jit(build_x_program(...)): a package builder whose
+                # RETURN VALUE is the traced callable — mark every nested
+                # def/lambda its OWN return statements hand back
+                # (stmt_walk: returns of helpers nested in the builder
+                # belong to those helpers, not to the builder)
+                builder = package.resolve_call(fn, target)
+                if builder is not None:
+                    from docqa_tpu.analysis.core import stmt_walk
+
+                    for stmt in stmt_walk(builder.node):
+                        if isinstance(stmt, ast.Return) and (
+                            stmt.value is not None
+                        ):
+                            self._mark_target(
+                                package, builder, stmt.value, mark,
+                                lambdas, via or builder.qualname,
+                                depth + 1,
+                            )
             return
         fake_call = ast.Call(func=target, args=[], keywords=[])
         ast.copy_location(fake_call, target)

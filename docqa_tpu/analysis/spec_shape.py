@@ -1,0 +1,217 @@
+"""spec-shape: a PartitionSpec's axis count must match the array's rank.
+
+A ``PartitionSpec`` with k entries annotates exactly a rank-k array; GSPMD
+rejects a mismatch only at lowering time, on whatever mesh first compiles
+the spec — which for the literal-shaped parameter tables means a broken
+spec edit sits undetected until the next sharded run (and on a 1x1 dev
+box, forever).  The shapes and the specs live in DIFFERENT modules by
+design (``models/decoder.py`` owns ``decoder_param_schema``;
+``parallel/sharding.py`` owns ``decoder_param_pspecs``/``cache_pspecs``),
+so nothing structural keeps them in sync — this rule does.
+
+Resolution model: the checker cross-references two kinds of package-wide
+**name-template facts** (f-string names are normalized, ``f"l{i}_wq"`` ->
+``l{}_wq``, so schema and spec rows written as parallel f-strings match):
+
+* **rank facts** — ``(name, ..., (shape, tuple), ...)`` rows yielded by
+  ``*schema*`` generator functions (the shape is the unique literal-tuple
+  element), and ``d[f"k{i}"] = jnp.zeros(shape, ...)`` subscript stores
+  whose shape resolves to a literal tuple (directly or through one local
+  assignment).
+* **spec facts** — dict-literal entries, ``dict.update({...})`` rows and
+  subscript stores whose value is a ``PartitionSpec``/``P`` call (or a
+  local name assigned from one): the fact is ``len(args)``.
+
+A template with consistent rank facts and a spec fact of a different
+arity flags at the spec site.  Templates with conflicting rank facts
+(same name, different literal ranks anywhere in the package) are dropped
+— ambiguity never guesses.  ``P()`` (fully replicated) matches any rank.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Package,
+    call_name,
+)
+
+
+def _name_template(node: ast.AST) -> Optional[str]:
+    """Literal or f-string key -> template ("l{}_wq"); None otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                parts.append("{}")
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def _is_pspec_call(fn: FunctionInfo, node: ast.AST) -> Optional[ast.Call]:
+    if not isinstance(node, ast.Call):
+        return None
+    resolved = fn.module.resolve_alias(call_name(node))
+    if resolved.rsplit(".", 1)[-1] == "PartitionSpec":
+        return node
+    return None
+
+
+def _spec_arity(call: ast.Call) -> Optional[int]:
+    """len(P(...)) — None for P(*xs) or P() (replicated matches any)."""
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return None
+    if call.keywords or not call.args:
+        return None
+    return len(call.args)
+
+
+_SHAPED_CTORS = frozenset({"zeros", "ones", "full", "empty", "normal"})
+
+
+class SpecShapeChecker:
+    rule = "spec-shape"
+
+    def check(self, package: Package) -> List[Finding]:
+        ranks = self._rank_facts(package)
+        out: List[Finding] = []
+        for fn in package.functions:
+            for template, arity, node in self._spec_facts(fn):
+                rank = ranks.get(template)
+                if rank is None or arity is None or rank < 0:
+                    continue
+                if rank != arity:
+                    out.append(
+                        Finding(
+                            self.rule,
+                            fn.module.relpath,
+                            getattr(node, "lineno", 1),
+                            fn.qualname,
+                            f"PartitionSpec for '{template}' has {arity} "
+                            f"entries but the array is rank {rank} "
+                            f"(shape declared elsewhere in the package)",
+                        )
+                    )
+        return out
+
+    # -- rank facts -----------------------------------------------------------
+
+    def _rank_facts(self, package: Package) -> Dict[str, int]:
+        """template -> rank; conflicting templates collapse to -1."""
+        ranks: Dict[str, int] = {}
+
+        def record(template: Optional[str], rank: Optional[int]) -> None:
+            if template is None or rank is None:
+                return
+            old = ranks.get(template)
+            if old is None:
+                ranks[template] = rank
+            elif old != rank:
+                ranks[template] = -1  # ambiguous: never checked
+
+        for fn in package.functions:
+            lits = self._literal_tuples(fn.node)
+            for node in ast.walk(fn.node):
+                # schema rows: yield (name, ..., (a, b), ...)
+                if isinstance(node, ast.Yield) and isinstance(
+                    node.value, ast.Tuple
+                ):
+                    elts = node.value.elts
+                    template = _name_template(elts[0]) if elts else None
+                    tuples = [
+                        e for e in elts[1:] if isinstance(e, ast.Tuple)
+                    ]
+                    if template is not None and len(tuples) == 1:
+                        record(template, len(tuples[0].elts))
+                # d[f"k{i}"] = jnp.zeros(shape, ...)
+                elif isinstance(node, ast.Assign) and len(
+                    node.targets
+                ) == 1 and isinstance(node.targets[0], ast.Subscript):
+                    template = _name_template(node.targets[0].slice)
+                    rank = self._ctor_rank(node.value, lits)
+                    record(template, rank)
+        return ranks
+
+    def _ctor_rank(
+        self, value: ast.AST, lits: Dict[str, int]
+    ) -> Optional[int]:
+        if not isinstance(value, ast.Call):
+            return None
+        tail = call_name(value).rsplit(".", 1)[-1]
+        if tail not in _SHAPED_CTORS:
+            return None
+        shape = value.args[0] if value.args else None
+        if isinstance(shape, ast.Tuple):
+            if any(isinstance(e, ast.Starred) for e in shape.elts):
+                return None
+            return len(shape.elts)
+        if isinstance(shape, ast.Name):
+            return lits.get(shape.id)
+        return None
+
+    @staticmethod
+    def _literal_tuples(scope: ast.AST) -> Dict[str, int]:
+        """name -> rank for ``shape = (a, b, c)`` local assignments."""
+        out: Dict[str, int] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Tuple
+            ) and not any(
+                isinstance(e, ast.Starred) for e in node.value.elts
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = len(node.value.elts)
+        return out
+
+    # -- spec facts -----------------------------------------------------------
+
+    def _spec_facts(self, fn: FunctionInfo):
+        """Yield (template, arity, site-node) for every name -> P(...)
+        association in ``fn``."""
+        # local names bound to a P(...) call: spec = P(a, None, b, None)
+        local_specs: Dict[str, int] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                call = _is_pspec_call(fn, node.value)
+                if call is not None:
+                    arity = _spec_arity(call)
+                    if arity is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_specs[t.id] = arity
+
+        def value_arity(value: ast.AST) -> Optional[int]:
+            call = _is_pspec_call(fn, value)
+            if call is not None:
+                return _spec_arity(call)
+            if isinstance(value, ast.Name):
+                return local_specs.get(value.id)
+            return None
+
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if k is None:
+                        continue
+                    template = _name_template(k)
+                    arity = value_arity(v)
+                    if template is not None and arity is not None:
+                        yield template, arity, k
+            elif isinstance(node, ast.Assign) and len(
+                node.targets
+            ) == 1 and isinstance(node.targets[0], ast.Subscript):
+                template = _name_template(node.targets[0].slice)
+                arity = value_arity(node.value)
+                if template is not None and arity is not None:
+                    yield template, arity, node

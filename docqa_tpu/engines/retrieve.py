@@ -72,6 +72,37 @@ def sharded_search(store_mesh, emb, buf, count, mask, k: int):
     )(*args)
 
 
+def build_fused_search_program(enc_cfg, mesh, k: int, masked: bool):
+    """The single-dispatch retrieve program: encoder forward -> L2
+    normalize -> exact top-k (sharded kernel when the store mesh has
+    model parallelism).  Returns the un-jitted callable — arity 6 when
+    ``masked``, 5 otherwise — so both :class:`FusedRetriever` (which jits
+    it per cache key) and the sharding audit
+    (``docqa_tpu/analysis/shard_audit.py``, which lowers it on virtual
+    meshes to count its collectives against ``shard_budget.json``) build
+    the exact same program."""
+    sharded = mesh is not None and mesh.n_model > 1
+
+    def program(enc_params, ids, lengths, buf, count, mask):
+        emb = encode_batch(enc_params, enc_cfg, ids, lengths)
+        # store.search L2-normalizes queries unconditionally (scores
+        # are cosine); match it even when the encoder config skips
+        # its own normalize — idempotent when it doesn't
+        emb = emb / jnp.maximum(
+            jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+        )
+        q = emb.astype(buf.dtype)
+        if sharded:
+            vals, row_ids = sharded_search(mesh, q, buf, count, mask, k)
+        else:
+            vals, row_ids = _search_single(buf, q, count, mask, k)
+        return vals, row_ids, emb
+
+    if masked:
+        return program
+    return lambda p, i, l, b, c: program(p, i, l, b, c, None)
+
+
 class FusedRetriever:
     """Text-in, ranked-rows-out retrieval in a single dispatch.
 
@@ -91,33 +122,11 @@ class FusedRetriever:
         key = (k, masked)
         fn = self._fns.get(key)
         if fn is None:
-            enc_cfg = self.encoder.cfg
-            mesh = self.store.mesh
-            sharded = mesh is not None and mesh.n_model > 1
-
-            def program(enc_params, ids, lengths, buf, count, mask):
-                emb = encode_batch(enc_params, enc_cfg, ids, lengths)
-                # store.search L2-normalizes queries unconditionally (scores
-                # are cosine); match it even when the encoder config skips
-                # its own normalize — idempotent when it doesn't
-                emb = emb / jnp.maximum(
-                    jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+            fn = jax.jit(
+                build_fused_search_program(
+                    self.encoder.cfg, self.store.mesh, k, masked
                 )
-                q = emb.astype(buf.dtype)
-                if sharded:
-                    vals, row_ids = sharded_search(
-                        mesh, q, buf, count, mask, k
-                    )
-                else:
-                    vals, row_ids = _search_single(buf, q, count, mask, k)
-                return vals, row_ids, emb
-
-            if masked:
-                fn = jax.jit(program)
-            else:
-                fn = jax.jit(
-                    lambda p, i, l, b, c: program(p, i, l, b, c, None)
-                )
+            )
             self._fns[key] = fn
         return fn
 

@@ -84,11 +84,11 @@ def ring_attention_local(
     # device i holds the block that originated on device (i - t) mod n
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(t, carry):
+    def merge(t, kc, vc, acc, m, l):
+        """Online-softmax merge of the kv block held at ring step ``t``."""
         # GQA kv shards circulate at their native head count; expansion to q
         # heads happens transiently inside the step so the ppermute (ICI
         # bytes) and the loop carry stay O(hkv), not O(hq)
-        kc, vc, acc, m, l = carry
         ke = jnp.repeat(kc, groups, axis=2) if groups > 1 else kc
         ve = jnp.repeat(vc, groups, axis=2) if groups > 1 else vc
         src = (idx - t) % n
@@ -120,15 +120,27 @@ def ring_attention_local(
             preferred_element_type=jnp.float32,
         )
         acc = acc * alpha.transpose(0, 2, 1, 3) + pv
+        return acc, m_new, l
 
+    def step(t, carry):
+        kc, vc, acc, m, l = carry
+        acc, m, l = merge(t, kc, vc, acc, m, l)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return kc, vc, acc, m_new, l
+        return kc, vc, acc, m, l
 
     acc0 = jnp.zeros((b, s_loc, hq, d), jnp.float32)
     m0 = jnp.full((b, hq, s_loc, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hq, s_loc, 1), jnp.float32)
-    _, _, acc, _, l = jax.lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
+    # n-1 rotation rounds, not n: after round n-2 every device holds the
+    # block it still needs for the final merge, and the n-th ppermute would
+    # only return shards to their origin — pure wasted ICI bytes.  The
+    # collective budget (shard_budget.json, scripts/shard_audit.py) pins
+    # this: ring rounds == ring_size - 1.
+    kc, vc, acc, m, l = jax.lax.fori_loop(
+        0, n - 1, step, (k, v, acc0, m0, l0)
+    )
+    acc, _, l = merge(n - 1, kc, vc, acc, m, l)
 
     denom = jnp.maximum(l.transpose(0, 2, 1, 3), 1e-30)  # [b,sq,h,1]
     out = acc / denom

@@ -3,14 +3,20 @@ pick a mesh, annotate param/activation shardings, let GSPMD insert the
 collectives.  No hand-written NCCL-style calls (the reference had no device
 parallelism at all — SURVEY §2c).
 
-Megatron-style layout per block:
+Megatron-style layout per layer (two Megatron blocks — attention, MLP):
   * wq/wk/wv: output (head) dim sharded       → column parallel
   * wo:       input (head) dim sharded        → row parallel, psum after
   * w_gate/w_up: output dim sharded           → column parallel
   * w_down:   input dim sharded               → row parallel, psum after
   * lm_head:  vocab dim sharded               → logits sharded, argmax local
   * KV cache: kv-heads dim sharded            → decode attention stays local
-GSPMD derives exactly one all-reduce per block from these specs.
+GSPMD derives exactly ONE all-reduce per Megatron block (after each
+row-parallel projection: two per layer) and no other collective from
+these specs.  That contract is no longer a comment: scripts/shard_audit.py
+lowers a decoder step on virtual 1x1/2x4/1x8 meshes every CI run and
+holds the partitioned HLO's collective counts to shard_budget.json
+(docs/SHARDING.md); a spec edit that inserts an all-gather or drops a
+psum fails the gate, not the next pod benchmark.
 """
 
 from __future__ import annotations

@@ -13,6 +13,13 @@ abstraction is identical, only device enumeration changes.
 
 Tests run this on a virtual CPU mesh via
 ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (see tests/conftest.py).
+
+The axis NAMES declared here (``MeshConfig.data_axis``/``model_axis``
+defaults) are the ground truth the ``mesh-axes`` lint rule checks every
+``PartitionSpec``/collective axis string against — a misspelled axis
+means silent replication, so it fails the gate instead of compiling
+(docs/STATIC_ANALYSIS.md); the collectives GSPMD derives from them are
+budgeted by ``scripts/shard_audit.py`` (docs/SHARDING.md).
 """
 
 from __future__ import annotations

@@ -2,7 +2,8 @@
 """docqa-lint CLI: run the AST invariant checkers over a tree.
 
 Usage:
-    python scripts/lint.py docqa_tpu               # full gate (exit 1 on new)
+    python scripts/lint.py                         # full gate: docqa_tpu +
+                                                   # scripts (exit 1 on new)
     python scripts/lint.py docqa_tpu --rules jit-purity,phi-taint
     python scripts/lint.py docqa_tpu --update-baseline   # accept current
     python scripts/lint.py docqa_tpu --no-baseline       # raw findings
@@ -22,7 +23,8 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 from docqa_tpu.analysis import (  # noqa: E402
     Baseline,
@@ -31,14 +33,25 @@ from docqa_tpu.analysis import (  # noqa: E402
     default_baseline_path,
 )
 
+# the gate's scope: the package AND the operational scripts (chaos_smoke,
+# soak, ... run against production; deadline-flow/phi-taint apply there
+# too).  Repo-root-anchored so the zero-argument gate works from any CWD;
+# fingerprint paths stay stable either way (Package.load normalizes to
+# the package root).
+DEFAULT_PATHS = [
+    os.path.join(_REPO, "docqa_tpu"),
+    os.path.join(_REPO, "scripts"),
+]
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
         "paths",
         nargs="*",
-        default=["docqa_tpu"],
-        help="package directories (or single files) to analyze",
+        default=DEFAULT_PATHS,
+        help="package directories (or single files) to analyze "
+        "(default: docqa_tpu + scripts)",
     )
     parser.add_argument(
         "--rules",
@@ -71,7 +84,7 @@ def main(argv=None) -> int:
         if args.rules
         else None
     )
-    paths = args.paths or ["docqa_tpu"]
+    paths = args.paths or DEFAULT_PATHS
     # one parse pass yields both the findings and the run's scope: a
     # --rules or sub-path invocation must neither report out-of-scope
     # baseline entries as stale nor (on update) destroy them

@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""docqa-shardcheck CLI: lower the device-plane programs on virtual CPU
+meshes and hold their collective counts to shard_budget.json.
+
+Usage:
+    python scripts/shard_audit.py                      # gate (exit 1 on drift)
+    python scripts/shard_audit.py --report out.json    # also write the
+                                                       # CI trend artifact
+    python scripts/shard_audit.py --write-budget       # accept measured
+                                                       # counts (jit-root
+                                                       # reasons preserved;
+                                                       # new roots get a
+                                                       # TODO the gate then
+                                                       # rejects until
+                                                       # justified)
+    python scripts/shard_audit.py --programs ring_attention,retrieve_fused
+    python scripts/shard_audit.py --meshes 2x4
+
+Requires 8 virtual CPU devices; this launcher forces
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` and
+``JAX_PLATFORMS=cpu`` BEFORE the first jax import, so it works from a
+bare shell and in CI alike.  See docs/SHARDING.md for the budget format
+and the Megatron/ring/retrieve contracts it enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from docqa_tpu.analysis import shard_audit  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="budget JSON path (default: <repo>/shard_budget.json)",
+    )
+    parser.add_argument(
+        "--report",
+        default=None,
+        help="write the measured report (counts + roots) to this path "
+        "(the CI collective-count trend artifact)",
+    )
+    parser.add_argument(
+        "--write-budget",
+        action="store_true",
+        help="rewrite the budget from the measured counts "
+        "(jit-root coverage/waiver reasons are preserved)",
+    )
+    parser.add_argument(
+        "--programs",
+        default=None,
+        help="comma-separated subset of: "
+        + ", ".join(shard_audit.AUDIT_PROGRAMS),
+    )
+    parser.add_argument(
+        "--meshes",
+        default=None,
+        help="comma-separated subset of: "
+        + ", ".join(shard_audit.MESH_SHAPES),
+    )
+    args = parser.parse_args(argv)
+
+    programs = (
+        [p.strip() for p in args.programs.split(",") if p.strip()]
+        if args.programs
+        else None
+    )
+    meshes = (
+        [m.strip() for m in args.meshes.split(",") if m.strip()]
+        if args.meshes
+        else None
+    )
+    for name in programs or ():
+        if name not in shard_audit.AUDIT_PROGRAMS:
+            parser.error(f"unknown program '{name}'")
+    for name in meshes or ():
+        if name not in shard_audit.MESH_SHAPES:
+            parser.error(f"unknown mesh '{name}'")
+
+    report = shard_audit.run_audit(mesh_names=meshes, programs=programs)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"report -> {args.report}")
+
+    if args.write_budget:
+        if programs or meshes:
+            parser.error("--write-budget needs a full run (no --programs/"
+                         "--meshes): a partial budget would be stale")
+        budget = shard_audit.write_budget(report, args.budget)
+        todo = [
+            s for s, r in budget["jit_roots"].items() if "TODO" in str(r)
+        ]
+        print(
+            f"budget updated -> "
+            f"{args.budget or shard_audit.default_budget_path()}"
+        )
+        if todo:
+            print(
+                f"{len(todo)} jit root(s) need a coverage/waiver reason "
+                f"before the gate passes:"
+            )
+            for s in todo:
+                print(f"  {s}")
+        return 0
+
+    budget_path = args.budget or shard_audit.default_budget_path()
+    if not os.path.exists(budget_path):
+        print(
+            f"no budget at {budget_path}; run --write-budget first",
+            file=sys.stderr,
+        )
+        return 1
+    budget = shard_audit.load_budget(budget_path)
+    if programs or meshes:
+        # scoped runs compare only what they measured
+        budget = dict(budget)
+        budget["programs"] = {
+            k: (
+                {**v, "per_mesh": {
+                    m: c for m, c in v.get("per_mesh", {}).items()
+                    if not meshes or m in meshes
+                }}
+            )
+            for k, v in budget.get("programs", {}).items()
+            if not programs or k in programs
+        }
+    violations = shard_audit.compare_budget(report, budget)
+
+    for prog_name, prog in sorted(report["programs"].items()):
+        for mesh_name, counts in sorted(prog["per_mesh"].items()):
+            shown = {
+                k: v
+                for k, v in counts.items()
+                if k in shard_audit.HLO_COLLECTIVES and v
+            }
+            extra = {
+                k: v
+                for k, v in counts.items()
+                if k not in shard_audit.HLO_COLLECTIVES
+            }
+            print(
+                f"{prog_name:20s} {mesh_name:4s} "
+                f"{shown if shown else 'collective-free'} {extra}"
+            )
+    if violations:
+        print(f"\nshard-audit: {len(violations)} violation(s):")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("\nshard-audit: budget satisfied")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""docqa-shardcheck Tier B: the compile audit against shard_budget.json.
+
+The gate half of the acceptance contract: the clean tree lowers every
+audited program on the 1x1 / 2x4 / 1x8 virtual meshes with collective
+counts exactly matching the checked-in budget — one all-reduce per
+Megatron block, n-1 ppermute rounds per ring step, exactly the top-k
+merge's all-gather pair on the retrieve path.  The mutation half: a
+budget-exceeding spec edit (replicating a row-parallel weight) flips the
+gate red without touching the real layout, via the audit's pspec
+override hook.
+"""
+
+import json
+
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from docqa_tpu.analysis import shard_audit
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.fixture(scope="module")
+def report():
+    """One full audit for the whole module (every program, every mesh)."""
+    return shard_audit.run_audit()
+
+
+@pytest.fixture(scope="module")
+def budget():
+    return shard_audit.load_budget()
+
+
+class TestBudgetGate:
+    def test_tree_satisfies_budget(self, report, budget):
+        violations = shard_audit.compare_budget(report, budget)
+        assert not violations, "shard-audit violations:\n" + "\n".join(
+            f"  - {v}" for v in violations
+        )
+
+    def test_one_all_reduce_per_megatron_block_on_2x4(self, report):
+        """The acceptance contract, read off the lowered HLO: exactly one
+        all-reduce per Megatron block (2 per decoder layer) on the 2x4
+        mesh, and zero all-gathers."""
+        prog = report["programs"]["decoder_decode"]
+        counts = prog["per_mesh"]["2x4"]
+        blocks = prog["meta"]["megatron_blocks"]
+        assert blocks == 2 * prog["meta"]["num_layers"]
+        assert counts["all-reduce"] == blocks
+        assert counts["all-gather"] == 0
+        assert counts["collective-permute"] == 0
+
+    def test_ring_runs_n_minus_1_rounds(self, report):
+        for mesh_name, n in (("2x4", 4), ("1x8", 8)):
+            counts = report["programs"]["ring_attention"]["per_mesh"][
+                mesh_name
+            ]
+            assert counts["ring_size"] == n
+            assert counts["ring_rounds"] == n - 1
+            assert counts["collective-permute"] == 2  # K and V per round
+
+    def test_retrieve_path_gathers_only_the_merge(self, report):
+        counts = report["programs"]["retrieve_fused"]["per_mesh"]["2x4"]
+        assert counts["all-gather"] == 2  # top-k vals + ids
+        assert counts["all-reduce"] == 0
+        assert counts["all-to-all"] == 0
+
+    def test_single_device_mesh_is_collective_free(self, report):
+        for name, prog in report["programs"].items():
+            counts = prog["per_mesh"]["1x1"]
+            for op in shard_audit.HLO_COLLECTIVES:
+                assert counts[op] == 0, (name, op, counts)
+
+
+class TestJitRootLedger:
+    def test_ledger_in_sync_with_discovery(self, report, budget):
+        discovered = set(report["jit_roots"]["discovered"])
+        ledger = set(budget["jit_roots"])
+        assert discovered == ledger, (
+            "new roots (add coverage/waiver to shard_budget.json): "
+            f"{sorted(discovered - ledger)}; stale ledger entries: "
+            f"{sorted(ledger - discovered)}"
+        )
+
+    def test_every_root_justified(self, budget):
+        for symbol, reason in budget["jit_roots"].items():
+            assert reason and "TODO" not in str(reason), (
+                f"jit root without a real coverage/waiver reason: {symbol}"
+            )
+
+    def test_audit_references_resolve(self, budget):
+        """'audit:<name>' coverage claims must name real audit programs."""
+        for symbol, reason in budget["jit_roots"].items():
+            if str(reason).startswith("audit:"):
+                name = str(reason).split(":", 1)[1].split()[0]
+                assert name in shard_audit.AUDIT_PROGRAMS, (symbol, name)
+
+
+class TestMutations:
+    def test_budget_exceeding_spec_edit_flags(self):
+        """Replicating the row-parallel wo (the classic 'simplify the
+        specs' regression) must flip the gate red: the Megatron contract
+        loses its attention all-reduces and gains all-gathers."""
+        from docqa_tpu.parallel.sharding import decoder_param_pspecs
+
+        def mutated(cfg, model_axis):
+            specs = decoder_param_pspecs(cfg, model_axis)
+            for i in range(cfg.num_layers):
+                specs[f"l{i}_wo"] = P(None, None)
+            return specs
+
+        counts, meta = shard_audit._audit_decoder(
+            "2x4", prefill=False, pspec_fn=mutated
+        )
+        entry = dict(counts)
+        entry["model_parallel"] = meta.pop("model_parallel")
+        mutated_report = {
+            "programs": {
+                "decoder_decode": {"meta": meta, "per_mesh": {"2x4": entry}}
+            }
+        }
+        violations = shard_audit.semantic_violations(mutated_report)
+        assert violations, (
+            f"replicated wo lowered to the same collectives: {counts}"
+        )
+        assert any("decoder_decode/2x4" in v for v in violations)
+
+    def test_budget_file_edit_cannot_relax_semantics(self, report, budget):
+        """Even a budget regenerated from a broken measurement fails: the
+        semantic invariants check the MEASUREMENT, not the ledger."""
+        broken = json.loads(json.dumps(report))  # deep copy
+        entry = broken["programs"]["ring_attention"]["per_mesh"]["2x4"]
+        entry["ring_rounds"] = entry["ring_size"]  # the pre-fix n rounds
+        violations = shard_audit.semantic_violations(broken)
+        assert any("n-1" in v for v in violations)
