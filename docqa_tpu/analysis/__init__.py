@@ -1,24 +1,35 @@
 """docqa-lint: AST invariant analysis for the docqa_tpu tree.
 
-Seven project-specific checkers (docs/STATIC_ANALYSIS.md):
+Ten project-specific checkers (docs/STATIC_ANALYSIS.md):
 
 * ``deadline-flow``   — request deadlines thread through; waits clamp.
 * ``donation``        — buffers donated to jitted calls aren't read after.
+* ``dtype-flow``      — bf16/int8 matmuls accumulate f32; bf16 reductions
+  upcast; no float64 / silent widening in device code.
+* ``host-sync``       — no blocking device→host syncs on the /ask path
+  outside jit (jit-purity's deliberate blind spot).
 * ``jit-purity``      — no side effects / host syncs in traced code.
 * ``lock-discipline`` — one lock order; no blocking I/O under a lock.
 * ``mesh-axes``       — sharding/collective axis names resolve to the
   declared mesh; collectives stay inside their ``shard_map``.
 * ``phi-taint``       — raw pre-deid text never reaches logs/metrics/
   external payloads.
+* ``retrace-hazard``  — jit wrappers are built once and reused; static
+  arguments stay hashable and stable.
 * ``spec-shape``      — PartitionSpec arity matches the annotated rank.
 
-Tier B lives in ``analysis/shard_audit.py`` (docs/SHARDING.md): lower the
-device-plane programs on virtual meshes and hold their collective counts
-to the checked-in ``shard_budget.json``.
+Tier B lives in ``analysis/shard_audit.py`` (docs/SHARDING.md) — lower
+the device-plane programs on virtual meshes, hold their collective counts
+to the checked-in ``shard_budget.json`` — and in
+``analysis/compile_audit.py``: drive the canonical serving workloads
+under compile counting, AOT-measure each root's ``memory_analysis()``
+bytes, and hold both to ``compile_budget.json`` (zero steady-state
+retraces, per-root HBM ceilings).
 
-Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` (CLIs) and
-``pytest -m lint`` (tier-1 gate, tests/test_analysis.py,
-tests/test_shardcheck.py, tests/test_shard_audit.py).
+Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` /
+``scripts/compile_audit.py`` (CLIs) and ``pytest -m lint`` (tier-1 gate,
+tests/test_analysis.py, tests/test_numcheck.py, tests/test_shardcheck.py,
+tests/test_shard_audit.py, tests/test_compile_audit.py).
 """
 
 from docqa_tpu.analysis.core import (  # noqa: F401

@@ -1,0 +1,619 @@
+"""docqa-numcheck Tier B: drive the canonical serving workloads under a
+compile-counting hook, AOT-measure every root's HBM footprint, and hold
+both to a checked-in budget.
+
+The shard audit (``analysis/shard_audit.py``) proves each program's
+COLLECTIVE content; this module proves two different compilation-class
+contracts the ROADMAP previously enforced only by convention:
+
+* **compile counts** — every jit root's admitted shape set is warmed
+  ahead of the serving path, and a repeated steady-state round performs
+  ZERO retraces.  The batcher's two-shape admission policy
+  (``serve._admit_round``: 4-lane trickle + full ``n_slots`` per prompt
+  bucket) is driven explicitly, so the exact compile count per root is a
+  checked-in number (``compile_budget.json``) and a new shape sneaking
+  into the serving path flips CI red instead of adding a silent
+  multi-second compile to someone's request.
+* **HBM budgets** — each root is AOT-lowered (``lower().compile()``)
+  and its ``memory_analysis()`` bytes (argument/output/temp/generated
+  code) recorded; per-root peak bytes gate against a budget CEILING.
+  ``--write-budget`` preserves an existing ceiling when the measurement
+  still fits and stamps any GROWTH with a ``TODO`` note the gate rejects
+  until a human justifies it — regeneration cannot launder a memory
+  regression, mirroring ``shard_audit``'s semantic-invariant design.
+
+Workloads (tiny configs, CPU-lowerable in seconds):
+
+* ``serve``          — decoder prefill across every admitted shape
+  (both batch families x every bucket) + the decode chunk, through a
+  real :class:`~docqa_tpu.engines.serve.ContinuousBatcher` (warmup, then
+  a trickle round and a full round as the steady state);
+* ``generate``       — the solo engine's fused prefill+decode program;
+* ``retrieve_fused`` — the single-dispatch text→top-k program;
+* ``seq2seq``        — the BART-class summarize program;
+* ``encoder``        — the batched document/query encoder.
+
+The budget also carries the same **jit-root ledger** as the shard
+budget (enumerated by jit-purity's discovery pass): every traced root
+must be covered by a workload or waived with a reason, so a new
+``jax.jit`` site fails the gate until its compile story is stated.
+
+Violations are re-derived from the MEASUREMENT (``semantic_violations``)
+so an "accept whatever it prints" budget update still cannot admit a
+steady-state retrace, a missing shape family, or a trickle shape that
+stopped being cheaper than the full width.
+
+Entry points: ``scripts/compile_audit.py`` (CLI; CI uploads its
+``--report`` JSON as the compile/HBM trend artifact) and ``pytest -m
+lint`` (tests/test_compile_audit.py).  docs/STATIC_ANALYSIS.md documents
+the budget format and amendment workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+# one byte-accounting implementation, shared with the serving layer
+# (GenerateEngine.decode_memory_analysis) — it lives in utils because
+# engines must never import the lint tree
+from docqa_tpu.utils import compiled_memory_stats as memory_of
+
+WORKLOADS = ("serve", "generate", "retrieve_fused", "seq2seq", "encoder")
+
+# headroom factor applied when a ceiling must grow (or is first written):
+# measured bytes wobble a few percent across jaxlib versions; a regression
+# worth gating is a structural one (a materialized tree, a doubled cache)
+CEILING_HEADROOM = 1.25
+
+
+def default_budget_path() -> str:
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_dir), "compile_budget.json")
+
+
+# ---------------------------------------------------------------------------
+# counting + memory helpers
+# ---------------------------------------------------------------------------
+
+
+def jit_cache_size(fn) -> int:
+    """Compiled-specialization count of a ``jax.jit`` wrapper — the
+    compile-counting hook.  One entry per traced (shape, dtype, sharding,
+    static-args) signature, so a steady-state round that grows it by N
+    performed exactly N retraces."""
+    size = getattr(fn, "_cache_size", None)
+    if size is None:  # pragma: no cover - jax pinned in CI
+        raise RuntimeError(
+            "jax.jit wrapper has no _cache_size(); the compile audit "
+            "needs it (jax>=0.4.31)"
+        )
+    return int(size())
+
+
+def lowered_memory(fn, *args, **kwargs) -> Optional[Dict[str, int]]:
+    try:
+        return memory_of(fn.lower(*args, **kwargs).compile())
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# audit configs (tiny: every workload lowers AND runs in seconds on CPU)
+# ---------------------------------------------------------------------------
+
+
+def _audit_decoder_cfg():
+    from docqa_tpu.config import DecoderConfig
+
+    return DecoderConfig(
+        vocab_size=64,
+        hidden_dim=32,
+        num_layers=2,
+        num_heads=2,
+        num_kv_heads=2,
+        head_dim=16,
+        mlp_dim=64,
+        max_seq_len=128,
+    )
+
+
+def _audit_gen_cfg():
+    from docqa_tpu.config import GenerateConfig
+
+    return GenerateConfig(
+        max_new_tokens=4,
+        prefill_buckets=(16, 32),
+        decode_chunk=4,
+        max_concurrent=8,
+    )
+
+
+def _audit_encoder_cfg():
+    from docqa_tpu.config import EncoderConfig
+
+    return EncoderConfig(
+        vocab_size=64,
+        hidden_dim=32,
+        num_layers=1,
+        num_heads=2,
+        mlp_dim=64,
+        max_seq_len=16,
+        embed_dim=32,
+        dtype="float32",
+    )
+
+
+# ---------------------------------------------------------------------------
+# workloads
+# ---------------------------------------------------------------------------
+
+
+def _audit_serve() -> Dict[str, Any]:
+    """Decoder prefill across ALL admitted shapes — both batch families
+    (4-lane trickle + full n_slots) x every prefill bucket — plus the
+    decode chunk, through a real batcher.  Steady state = one trickle
+    round and one full round AFTER warmup; both must hit warm programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.engines.serve import ContinuousBatcher
+
+    cfg, gen = _audit_decoder_cfg(), _audit_gen_cfg()
+    engine = GenerateEngine(cfg, gen)
+    batcher = ContinuousBatcher(engine, n_slots=8, chunk=4, cache_len=64)
+    try:
+        batcher.warmup()
+        prefill_fn = batcher._get_prefill_fn()
+        decode_fn = batcher._get_decode_fn()
+        warm_prefill = jit_cache_size(prefill_fn)
+        warm_decode = jit_cache_size(decode_fn)
+
+        # steady state: a trickle round (1 request) and a full round
+        # (n_slots requests) against warm programs
+        batcher.submit_ids([1] * 10, max_new_tokens=3).result(timeout=120)
+        handles = [
+            batcher.submit_ids([1] * 10, max_new_tokens=3)
+            for _ in range(batcher.n_slots)
+        ]
+        for h in handles:
+            h.result(timeout=120)
+        retrace_prefill = jit_cache_size(prefill_fn) - warm_prefill
+        retrace_decode = jit_cache_size(decode_fn) - warm_decode
+
+        # AOT memory per shape family at the largest bucket (counting is
+        # done — lowering can no longer pollute the numbers)
+        # mirror warmup()'s bucket derivation EXACTLY (clamp, dedupe) so
+        # expected_shapes can never drift from what warmup compiles
+        usable = batcher.cache_len - 2 - batcher.spec_k
+        buckets = sorted({min(b, usable) for b in gen.prefill_buckets})
+        bucket = max(buckets)
+        cache_struct = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+            for k, v in batcher._cache.items()
+        }
+        rng = jax.random.PRNGKey(0)
+
+        def prefill_mem(B: int):
+            return lowered_memory(
+                prefill_fn,
+                engine.params,
+                cache_struct,
+                jax.ShapeDtypeStruct((B, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                rng,
+            )
+
+        per_shape = {
+            "trickle": prefill_mem(4),
+            "full": prefill_mem(batcher.n_slots),
+        }
+        decode_mem = lowered_memory(
+            decode_fn,
+            engine.params,
+            cache_struct,
+            jax.ShapeDtypeStruct((batcher.n_slots,), jnp.int32),
+            jax.ShapeDtypeStruct((batcher.n_slots,), jnp.int32),
+            jax.ShapeDtypeStruct((batcher.n_slots,), jnp.bool_),
+            rng,
+        )
+        n_widths = 2 if batcher.n_slots > 4 else 1
+        return {
+            "meta": {
+                "n_slots": batcher.n_slots,
+                "buckets": buckets,
+                "shape_families": n_widths,
+            },
+            "roots": {
+                "serve_prefill": {
+                    "compiles": warm_prefill,
+                    "expected_shapes": n_widths * len(buckets),
+                    "steady_state_retraces": retrace_prefill,
+                    "per_shape": per_shape,
+                    "peak_bytes": max(
+                        (m or {}).get("peak_bytes", 0)
+                        for m in per_shape.values()
+                    ),
+                },
+                "serve_decode": {
+                    "compiles": warm_decode,
+                    "expected_shapes": 1,
+                    "steady_state_retraces": retrace_decode,
+                    "memory": decode_mem,
+                    "peak_bytes": (decode_mem or {}).get("peak_bytes", 0),
+                },
+            },
+        }
+    finally:
+        batcher.stop()
+
+
+def _audit_generate() -> Dict[str, Any]:
+    from docqa_tpu.engines.generate import GenerateEngine
+
+    cfg, gen = _audit_decoder_cfg(), _audit_gen_cfg()
+    engine = GenerateEngine(cfg, gen)
+    engine.generate_ids([[1, 2, 3]], max_new_tokens=4)
+    warm = sum(jit_cache_size(fn) for fn in engine._fns.values())
+    engine.generate_ids([[1, 2, 3]], max_new_tokens=4)
+    after = sum(jit_cache_size(fn) for fn in engine._fns.values())
+    mem = engine.decode_memory_analysis(prompt_len=3, max_new_tokens=4)
+    return {
+        "meta": {"programs": len(engine._fns)},
+        "roots": {
+            "generate_decode": {
+                "compiles": warm,
+                "expected_shapes": 1,
+                "steady_state_retraces": after - warm,
+                "memory": mem,
+                "peak_bytes": (mem or {}).get("peak_bytes", 0),
+            }
+        },
+    }
+
+
+def _audit_retrieve() -> Dict[str, Any]:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from docqa_tpu.config import StoreConfig
+    from docqa_tpu.engines.encoder import EncoderEngine
+    from docqa_tpu.engines.retrieve import (
+        FusedRetriever,
+        build_fused_search_program,
+    )
+    from docqa_tpu.index.store import VectorStore
+
+    enc_cfg = _audit_encoder_cfg()
+    encoder = EncoderEngine(enc_cfg)
+    store = VectorStore(
+        StoreConfig(dim=enc_cfg.embed_dim, shard_capacity=64)
+    )
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((8, enc_cfg.embed_dim)).astype(np.float32)
+    store.add(vecs, [{"doc_id": f"d{i}"} for i in range(len(vecs))])
+
+    retriever = FusedRetriever(encoder, store)
+    retriever.search_texts(["alpha beta"], k=3)
+    warm = sum(jit_cache_size(fn) for fn in retriever._fns.values())
+    retriever.search_texts(["gamma delta"], k=3)
+    after = sum(jit_cache_size(fn) for fn in retriever._fns.values())
+
+    # canonical-program memory at controlled shapes (the same program the
+    # shard audit lowers, single-shard here)
+    program = jax.jit(build_fused_search_program(
+        enc_cfg, None, k=3, masked=False
+    ))
+    batch, capacity = 1, 64
+    mem = lowered_memory(
+        program,
+        encoder.params,
+        jax.ShapeDtypeStruct((batch, enc_cfg.max_seq_len), jnp.int32),
+        jax.ShapeDtypeStruct((batch,), jnp.int32),
+        jax.ShapeDtypeStruct(
+            (capacity, enc_cfg.embed_dim),
+            jnp.dtype(store.cfg.dtype),
+        ),
+        jax.ShapeDtypeStruct((), jnp.int32),
+    )
+    return {
+        "meta": {"programs": len(retriever._fns)},
+        "roots": {
+            "retrieve_fused": {
+                "compiles": warm,
+                "expected_shapes": 1,
+                "steady_state_retraces": after - warm,
+                "memory": mem,
+                "peak_bytes": (mem or {}).get("peak_bytes", 0),
+            }
+        },
+    }
+
+
+def _audit_seq2seq() -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from docqa_tpu.config import Seq2SeqConfig
+    from docqa_tpu.engines.seq2seq import Seq2SeqEngine
+
+    engine = Seq2SeqEngine(Seq2SeqConfig())
+    engine.generate_ids([[5, 9, 11]], max_new_tokens=4)
+    warm = sum(jit_cache_size(fn) for fn in engine._fns.values())
+    engine.generate_ids([[5, 9, 11]], max_new_tokens=4)
+    after = sum(jit_cache_size(fn) for fn in engine._fns.values())
+    fn = engine._get_fn(4)
+    mem = lowered_memory(
+        fn,
+        engine.params,
+        src_ids=jax.ShapeDtypeStruct((1, 64), jnp.int32),
+        src_lengths=jax.ShapeDtypeStruct((1,), jnp.int32),
+    )
+    return {
+        "meta": {"programs": len(engine._fns)},
+        "roots": {
+            "seq2seq_summarize": {
+                "compiles": warm,
+                "expected_shapes": 1,
+                "steady_state_retraces": after - warm,
+                "memory": mem,
+                "peak_bytes": (mem or {}).get("peak_bytes", 0),
+            }
+        },
+    }
+
+
+def _audit_encoder() -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from docqa_tpu.engines.encoder import EncoderEngine
+
+    enc_cfg = _audit_encoder_cfg()
+    engine = EncoderEngine(enc_cfg)
+    engine.encode_texts(["alpha beta"])
+    warm = jit_cache_size(engine._encode)
+    engine.encode_texts(["gamma delta"])
+    after = jit_cache_size(engine._encode)
+    mem = lowered_memory(
+        engine._encode,
+        params=engine.params,
+        ids=jax.ShapeDtypeStruct((8, enc_cfg.max_seq_len), jnp.int32),
+        lengths=jax.ShapeDtypeStruct((8,), jnp.int32),
+    )
+    return {
+        "meta": {},
+        "roots": {
+            "encoder_encode": {
+                "compiles": warm,
+                "expected_shapes": 1,
+                "steady_state_retraces": after - warm,
+                "memory": mem,
+                "peak_bytes": (mem or {}).get("peak_bytes", 0),
+            }
+        },
+    }
+
+
+_AUDITS = {
+    "serve": _audit_serve,
+    "generate": _audit_generate,
+    "retrieve_fused": _audit_retrieve,
+    "seq2seq": _audit_seq2seq,
+    "encoder": _audit_encoder,
+}
+
+
+# ---------------------------------------------------------------------------
+# run + compare
+# ---------------------------------------------------------------------------
+
+
+def run_audit(
+    workloads: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Drive every workload; returns the report (the CI artifact)."""
+    from docqa_tpu.analysis.shard_audit import enumerate_jit_roots
+
+    names = list(workloads or WORKLOADS)
+    report: Dict[str, Any] = {"workloads": {}}
+    for name in names:
+        report["workloads"][name] = _AUDITS[name]()
+    report["jit_roots"] = {"discovered": enumerate_jit_roots()}
+    return report
+
+
+def _iter_roots(section: Dict[str, Any]):
+    for wname, wl in section.get("workloads", {}).items():
+        for rname, root in wl.get("roots", {}).items():
+            yield wname, rname, root
+
+
+def semantic_violations(report: Dict[str, Any]) -> List[str]:
+    """Invariants checked against the MEASUREMENT, so regenerating the
+    budget from a broken run still fails the gate."""
+    out: List[str] = []
+    for wname, rname, root in _iter_roots(report):
+        retraces = root.get("steady_state_retraces")
+        if retraces != 0:
+            out.append(
+                f"{wname}/{rname}: {retraces} steady-state retrace(s) — "
+                "every admitted shape must be compiled at warmup, never "
+                "inside a serving round"
+            )
+        expected = root.get("expected_shapes")
+        if expected is not None and root.get("compiles") != expected:
+            out.append(
+                f"{wname}/{rname}: {root.get('compiles')} compiled "
+                f"specialization(s) for {expected} admitted shape(s) — "
+                "the warmed shape set drifted from the admission policy"
+            )
+        if not root.get("peak_bytes"):
+            out.append(
+                f"{wname}/{rname}: no memory_analysis measurement — the "
+                "HBM gate cannot be satisfied by an empty measurement"
+            )
+    serve = report.get("workloads", {}).get("serve", {})
+    prefill = serve.get("roots", {}).get("serve_prefill", {})
+    shapes = prefill.get("per_shape") or {}
+    trickle = (shapes.get("trickle") or {}).get("peak_bytes")
+    full = (shapes.get("full") or {}).get("peak_bytes")
+    if trickle is not None and full is not None and trickle >= full:
+        out.append(
+            f"serve_prefill: trickle-shape peak ({trickle}B) is not "
+            f"smaller than the full-width peak ({full}B) — the narrow "
+            "admission shape exists to make trickle rounds cheaper; this "
+            "layout broke that"
+        )
+    return out
+
+
+def compare_budget(
+    report: Dict[str, Any], budget: Dict[str, Any]
+) -> List[str]:
+    """Budget-gate violations: semantic invariants on the measurement,
+    exact compile counts, per-root HBM ceilings (with TODO growth notes
+    rejected), and the jit-root ledger in exact sync."""
+    out: List[str] = list(semantic_violations(report))
+    want = {
+        (w, r): root for w, r, root in _iter_roots(budget)
+    }
+    got = {
+        (w, r): root for w, r, root in _iter_roots(report)
+    }
+    for key in sorted(set(want) | set(got)):
+        wname, rname = key
+        if key not in got:
+            out.append(
+                f"budget root '{wname}/{rname}' was not audited (stale?)"
+            )
+            continue
+        if key not in want:
+            out.append(f"root '{wname}/{rname}' has no budget entry")
+            continue
+        g, w = got[key], want[key]
+        if g.get("compiles") != w.get("compiles"):
+            out.append(
+                f"{wname}/{rname}: {g.get('compiles')} compile(s) "
+                f"(budget grants exactly {w.get('compiles')})"
+            )
+        ceiling = w.get("peak_bytes_ceiling")
+        if ceiling is None:
+            out.append(
+                f"{wname}/{rname}: budget entry lacks peak_bytes_ceiling"
+            )
+        elif g.get("peak_bytes", 0) > ceiling:
+            peak = g.get("peak_bytes", 0)
+            pct = 100.0 * (peak - ceiling) / max(ceiling, 1)
+            out.append(
+                f"{wname}/{rname}: peak {peak}B exceeds the HBM ceiling "
+                f"{ceiling}B (+{pct:.0f}%) — justify and regrow the "
+                "ceiling via --write-budget + an edited ceiling_note, or "
+                "fix the regression"
+            )
+        note = str(w.get("ceiling_note", ""))
+        if "TODO" in note:
+            out.append(
+                f"{wname}/{rname}: ceiling_note is an unjustified TODO — "
+                "a grown ceiling needs a human-written reason"
+            )
+
+    ledger = budget.get("jit_roots", {})
+    discovered = report.get("jit_roots", {}).get("discovered", [])
+    for symbol in discovered:
+        reason = ledger.get(symbol)
+        if reason is None:
+            out.append(
+                f"new jit root '{symbol}' is neither covered by a "
+                "compile-audit workload nor waived in compile_budget.json"
+            )
+        elif not str(reason).strip() or "TODO" in str(reason):
+            out.append(
+                f"jit root '{symbol}' has no real coverage/waiver reason"
+            )
+    for symbol in sorted(set(ledger) - set(discovered)):
+        out.append(
+            f"stale jit-root ledger entry '{symbol}' (root no longer "
+            "exists)"
+        )
+    return out
+
+
+def load_budget(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or default_budget_path()
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_budget(
+    report: Dict[str, Any], path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Regenerate the budget from a report.  Compile counts are copied
+    (the semantic gate separately forbids steady-state retraces), HBM
+    ceilings are PRESERVED while the measurement still fits and only grow
+    through a TODO note the gate rejects until a human edits it, and
+    jit-root reasons are preserved (new roots get a TODO)."""
+    path = path or default_budget_path()
+    old: Dict[str, Any] = {}
+    if os.path.exists(path):
+        old = load_budget(path)
+    old_roots = {(w, r): root for w, r, root in _iter_roots(old)}
+    old_ledger = old.get("jit_roots", {})
+
+    workloads: Dict[str, Any] = {}
+    for wname, wl in report.get("workloads", {}).items():
+        roots_out = {}
+        for rname, root in wl.get("roots", {}).items():
+            peak = int(root.get("peak_bytes", 0))
+            prior = old_roots.get((wname, rname), {})
+            prior_ceiling = prior.get("peak_bytes_ceiling")
+            if prior_ceiling is not None and peak <= prior_ceiling:
+                ceiling = prior_ceiling
+                note = prior.get("ceiling_note", "")
+            else:
+                ceiling = int(math.ceil(peak * CEILING_HEADROOM))
+                if prior_ceiling is None:
+                    note = prior.get(
+                        "ceiling_note",
+                        "TODO: justify the initial ceiling",
+                    )
+                else:
+                    note = (
+                        f"TODO: justify growth from {prior_ceiling} to "
+                        f"{ceiling} bytes"
+                    )
+            roots_out[rname] = {
+                "compiles": root.get("compiles"),
+                "steady_state_retraces": 0,
+                "peak_bytes_ceiling": ceiling,
+                "ceiling_note": note,
+            }
+        workloads[wname] = {
+            "meta": wl.get("meta", {}),
+            "roots": roots_out,
+        }
+
+    budget = {
+        "_comment": (
+            "Compile-count + HBM budget for the serving jit roots "
+            "(docs/STATIC_ANALYSIS.md).  Counts and memory_analysis "
+            "bytes are measured by scripts/compile_audit.py; amend ONLY "
+            "via --write-budget plus a reviewed ceiling_note for any "
+            "grown ceiling.  jit_roots maps every traced root to the "
+            "workload covering it or a waiver reason."
+        ),
+        "workloads": workloads,
+        "jit_roots": {
+            symbol: old_ledger.get(symbol, "TODO: justify")
+            for symbol in report.get("jit_roots", {}).get("discovered", [])
+        },
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(budget, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return budget

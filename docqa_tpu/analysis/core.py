@@ -488,19 +488,25 @@ def all_checkers() -> Dict[str, object]:
     """Rule name -> checker instance (import here to avoid cycles)."""
     from docqa_tpu.analysis.deadline_flow import DeadlineFlowChecker
     from docqa_tpu.analysis.donation import DonationChecker
+    from docqa_tpu.analysis.dtype_flow import DtypeFlowChecker
+    from docqa_tpu.analysis.host_sync import HostSyncChecker
     from docqa_tpu.analysis.jit_purity import JitPurityChecker
     from docqa_tpu.analysis.lock_discipline import LockDisciplineChecker
     from docqa_tpu.analysis.mesh_axes import MeshAxesChecker
     from docqa_tpu.analysis.phi_taint import PhiTaintChecker
+    from docqa_tpu.analysis.retrace_hazard import RetraceHazardChecker
     from docqa_tpu.analysis.spec_shape import SpecShapeChecker
 
     checkers = [
         DeadlineFlowChecker(),
         DonationChecker(),
+        DtypeFlowChecker(),
+        HostSyncChecker(),
         JitPurityChecker(),
         LockDisciplineChecker(),
         MeshAxesChecker(),
         PhiTaintChecker(),
+        RetraceHazardChecker(),
         SpecShapeChecker(),
     ]
     return {c.rule: c for c in checkers}

@@ -28,7 +28,11 @@ Resolution model (no type inference; unresolvable sites stay silent):
   unless a rebind (assignment to the same name/chain, including tuple
   unpacking of the call's own result) happens on an earlier-or-equal
   line.  Reads inside the donating call itself don't count; line order
-  approximates control flow (a loop back-edge read is out of scope).
+  approximates control flow (a loop back-edge read is out of scope),
+  EXCEPT that a read in the mutually-exclusive arm of the same ``if``
+  as the donating call never flags — exactly one arm executes (the
+  spec/non-spec dispatch branches in ``serve.warmup`` donate the same
+  fresh buffer from either arm).
 """
 
 from __future__ import annotations
@@ -77,6 +81,38 @@ def _is_jit_call(fn: FunctionInfo, node: ast.Call) -> bool:
         return False
     resolved = fn.module.resolve_alias(name)
     return resolved.rsplit(".", 1)[-1] in _JIT_NAMES
+
+
+def _branch_paths(root: ast.AST) -> Dict[int, Tuple[Tuple[int, str], ...]]:
+    """Node id -> chain of ``(id(If node), arm)`` ancestors, where arm is
+    ``"body"`` or ``"orelse"``.  Two nodes whose chains disagree on any
+    shared If sit in mutually-exclusive arms — at most one executes."""
+    paths: Dict[int, Tuple[Tuple[int, str], ...]] = {}
+
+    def visit(node: ast.AST, path: Tuple[Tuple[int, str], ...]) -> None:
+        is_if = isinstance(node, ast.If)
+        for field_name, field in ast.iter_fields(node):
+            children = field if isinstance(field, list) else [field]
+            child_path = path
+            if is_if and field_name in ("body", "orelse"):
+                child_path = path + ((id(node), field_name),)
+            for child in children:
+                if isinstance(child, ast.AST):
+                    paths[id(child)] = child_path
+                    visit(child, child_path)
+
+    paths[id(root)] = ()
+    visit(root, ())
+    return paths
+
+
+def _mutually_exclusive(
+    a: Tuple[Tuple[int, str], ...], b: Tuple[Tuple[int, str], ...]
+) -> bool:
+    arms = dict(a)
+    return any(
+        if_id in arms and arms[if_id] != arm for if_id, arm in b
+    )
 
 
 class DonationChecker:
@@ -159,6 +195,7 @@ class DonationChecker:
                     local[t.id] = donated
 
         # find donating calls
+        paths = _branch_paths(fn.node)
         for node in ast.walk(fn.node):
             if not isinstance(node, ast.Call):
                 continue
@@ -174,7 +211,7 @@ class DonationChecker:
                 donated = cls_attrs.get(name)
             if donated is None:
                 continue
-            out.extend(self._check_call(fn, node, donated))
+            out.extend(self._check_call(fn, node, donated, paths))
         return out
 
     def _check_call(
@@ -182,6 +219,7 @@ class DonationChecker:
         fn: FunctionInfo,
         call: ast.Call,
         donated: Tuple[Set[int], Set[str]],
+        paths: Dict[int, Tuple[Tuple[int, str], ...]],
     ) -> List[Finding]:
         out: List[Finding] = []
         argnums, argnames = donated
@@ -224,9 +262,12 @@ class DonationChecker:
                     if text in exprs:
                         reads.append((node.lineno, text, node))
 
+        call_path = paths.get(id(call), ())
         for line, text, node in reads:
             if line <= call_line:
                 continue
+            if _mutually_exclusive(call_path, paths.get(id(node), ())):
+                continue  # other arm of the same if: never both execute
             rebound = any(
                 rl <= line and rb == text and rl >= call_line
                 for rl, rb in rebinds

@@ -1,0 +1,582 @@
+"""dtype-flow: low-precision math must accumulate wide, and nothing may
+silently widen a bf16 pipeline.
+
+The serving stack stores weights in bf16/int8/int4 (``models/quant.py``)
+because decode is HBM-bandwidth bound — but the MATH contract is that
+every matmul over those operands accumulates in float32
+(``preferred_element_type``), every reduction over bf16 activations
+upcasts first, and nothing drags float64 (TPU-emulated, 2x bytes) into
+device code.  Until now that contract lived in comments
+(``index/ivf.py:156``: "All scores accumulate to f32"); this checker
+makes it a red build.
+
+Dtype **facts** are tracked per name, per function, flow-insensitively in
+statement order — no type inference, only what the source states:
+
+* literal dtype references through import aliases (``jnp.bfloat16``,
+  ``np.int8``, ``ml_dtypes.int4``, ``"bfloat16"`` strings,
+  ``jnp.dtype("bfloat16")``);
+* ``x = y.astype(D)`` rebinds ``x`` to ``D``'s fact — including the
+  ``.dtype`` rebind form ``y.astype(z.dtype)`` (``x`` takes ``z``'s
+  fact, the idiom ``serve._prefill_program`` uses);
+* array creation (``jnp.zeros/ones/full/empty/asarray/array``,
+  ``jax.ShapeDtypeStruct``) with a resolvable dtype argument;
+* propagation through ``.T``/subscripts/unary ops/binary ops (Python
+  scalar literals are weak-typed and never widen a fact);
+* cross-module: a call that resolves through the package index
+  (:meth:`~docqa_tpu.analysis.core.Package.resolve_call`) re-scans the
+  callee with the caller's low-precision argument facts bound to its
+  parameters (depth-limited, memoized), and a resolved callee's RETURN
+  fact flows back — so the int8/int4 tensors minted at the quant
+  boundary (``models/quant.py:quantize_array`` returns are ``.astype(
+  jnp.int8)``) stay tracked through helper layers.
+
+Findings (ambiguity never guesses — an unresolvable dtype is silent):
+
+1. ``@`` / ``jnp.dot`` / ``jnp.matmul`` / ``jnp.einsum`` /
+   ``jnp.tensordot`` / ``lax.dot_general`` with a bf16/f16/int8/int4
+   operand fact and no ``preferred_element_type`` of f32-or-wider;
+2. reductions over bf16/f16 facts — ``sum``/``mean``/``var``/``std``/
+   ``prod``/``logsumexp`` (function or method form) without a wide
+   ``dtype=``, and ``softmax``/``log_softmax`` (no accumulator kwarg
+   exists — the operand itself must be upcast first);
+3. float64 entering device code: an f64 dtype argument to any ``jnp``/
+   ``jax`` call, or ``.astype(float64)`` on a value with a known float
+   fact;
+4. silent widening: a binary op between a bf16/f16 fact and an f64 fact
+   (the weak-type promotion that turns a bf16 pipeline f64 without any
+   visible cast).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Package,
+    call_name,
+    dotted_name,
+)
+
+# canonical category names; width order for promotion
+_DTYPE_NAMES = {
+    "int4": "i4",
+    "int8": "i8",
+    "uint8": "i8",
+    "bfloat16": "bf16",
+    "float16": "f16",
+    "half": "f16",
+    "int32": "i32",
+    "int64": "i64",
+    "float32": "f32",
+    "single": "f32",
+    "float64": "f64",
+    "double": "f64",
+}
+_WIDTH = {"i4": 0, "i8": 1, "bf16": 2, "f16": 2, "i32": 3, "i64": 4,
+          "f32": 5, "f64": 6}
+LOW_MATMUL = frozenset({"bf16", "f16", "i8", "i4"})
+LOW_FLOAT = frozenset({"bf16", "f16"})
+WIDE_ACC = frozenset({"f32", "f64", "i32", "i64"})
+
+# heads whose attributes are dtype namespaces (post alias resolution)
+_DTYPE_HEADS = ("jax.numpy", "jax", "numpy", "jnp", "np", "ml_dtypes")
+
+_MATMUL_TAILS = frozenset({"dot", "matmul", "einsum", "tensordot",
+                           "dot_general"})
+_REDUCE_TAILS = frozenset({"sum", "mean", "var", "std", "prod",
+                           "logsumexp"})
+_SOFTMAX_TAILS = frozenset({"softmax", "log_softmax"})
+_CREATE_TAILS = {
+    # tail -> positional index of the dtype argument (after the first)
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+    "asarray": 1, "array": 1, "full_like": 2, "arange": None,
+}
+
+_MAX_DEPTH = 5
+
+
+def _is_jnp_head(resolved: str) -> bool:
+    head = resolved.split(".")[0]
+    return head in ("jax", "jnp") or resolved.startswith("jax.")
+
+
+class DtypeFlowChecker:
+    rule = "dtype-flow"
+
+    def check(self, package: Package) -> List[Finding]:
+        self._package = package
+        self._out: List[Finding] = []
+        self._seen: set = set()  # (node id, fact context) scan memo
+        self._ret_memo: Dict[int, object] = {}
+        for fn in package.functions:
+            self._scan(fn, {}, via="", depth=0)
+        for module in package.modules:
+            pseudo = FunctionInfo(
+                module=module, node=module.tree, qualname="<module>",
+                class_name=None,
+            )
+            self._scan(pseudo, {}, via="", depth=0)
+        return self._out
+
+    # -- dtype literal resolution -------------------------------------------
+
+    def _dtype_of(self, module, node: Optional[ast.AST],
+                  facts: Dict[str, Optional[str]]) -> Optional[str]:
+        """Category of an expression used IN DTYPE POSITION, or None."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return _DTYPE_NAMES.get(node.value)
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            dotted = dotted_name(node)
+            if isinstance(node, ast.Attribute) and node.attr == "dtype":
+                # y.dtype in dtype position: the .dtype rebind — take y's fact
+                return self._fact_quiet(module, node.value, facts)
+            resolved = module.resolve_alias(dotted)
+            tail = resolved.rsplit(".", 1)[-1]
+            cat = _DTYPE_NAMES.get(tail)
+            if cat is None:
+                return None
+            if "." not in resolved:
+                return cat  # from-import of the dtype name itself
+            head = resolved.rsplit(".", 1)[0]
+            return cat if head in _DTYPE_HEADS or head.startswith("jax") else None
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name.rsplit(".", 1)[-1] == "dtype" and node.args:
+                return self._dtype_of(module, node.args[0], facts)
+        return None
+
+    def _fact_quiet(self, module, node, facts):
+        """Fact of an expression without emitting findings (used from
+        dtype-position resolution, where nothing is computed)."""
+        sink: List[Finding] = []
+        return self._eval(None, module, node, facts, sink, depth=_MAX_DEPTH)
+
+    # -- function scan -------------------------------------------------------
+
+    def _scan(self, fn: FunctionInfo, param_facts: Dict[str, Optional[str]],
+              via: str, depth: int) -> None:
+        key = (id(fn.node), tuple(sorted(
+            (k, v) for k, v in param_facts.items() if v
+        )))
+        if key in self._seen or depth > _MAX_DEPTH:
+            return
+        self._seen.add(key)
+        facts: Dict[str, Optional[str]] = dict(param_facts)
+        body = getattr(fn.node, "body", None)
+        if body is None:
+            return
+        self._exec_block(fn, body, facts, via, depth)
+
+    def _exec_block(self, fn, stmts, facts, via, depth) -> None:
+        for stmt in stmts:
+            self._exec_stmt(fn, stmt, facts, via, depth)
+
+    def _exec_stmt(self, fn, stmt, facts, via, depth) -> None:
+        module = fn.module
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # own FunctionInfo pass
+        if isinstance(stmt, ast.Assign):
+            fact = self._eval(fn, module, stmt.value, facts, self._out,
+                              depth, via=via)
+            for target in stmt.targets:
+                self._bind(target, fact, facts)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            fact = self._eval(fn, module, stmt.value, facts, self._out,
+                              depth, via=via)
+            self._bind(stmt.target, fact, facts)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._eval(fn, module, stmt.value, facts, self._out, depth,
+                       via=via)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._eval(fn, module, stmt.value, facts, self._out, depth,
+                           via=via)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval(fn, module, stmt.value, facts, self._out, depth,
+                       via=via)
+            return
+        if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            for attr in ("iter", "test"):
+                sub = getattr(stmt, attr, None)
+                if sub is not None:
+                    self._eval(fn, module, sub, facts, self._out, depth,
+                               via=via)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._bind(stmt.target, None, facts)
+            self._exec_block(fn, stmt.body, facts, via, depth)
+            self._exec_block(fn, stmt.orelse, facts, via, depth)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(fn, module, item.context_expr, facts, self._out,
+                           depth, via=via)
+            self._exec_block(fn, stmt.body, facts, via, depth)
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(fn, stmt.body, facts, via, depth)
+            for handler in stmt.handlers:
+                self._exec_block(fn, handler.body, facts, via, depth)
+            self._exec_block(fn, stmt.orelse, facts, via, depth)
+            self._exec_block(fn, stmt.finalbody, facts, via, depth)
+            return
+        # any other statement kind: evaluate nested expressions for findings
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._eval(fn, module, sub, facts, self._out, depth, via=via)
+
+    @staticmethod
+    def _bind(target, fact, facts) -> None:
+        if isinstance(target, ast.Name):
+            facts[target.id] = fact if isinstance(fact, str) else None
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            sub = fact if isinstance(fact, tuple) else (None,) * len(elts)
+            if len(sub) != len(elts):
+                sub = (None,) * len(elts)
+            for t, f in zip(elts, sub):
+                DtypeFlowChecker._bind(t, f, facts)
+
+    # -- expression evaluation (facts + findings) ----------------------------
+
+    def _add(self, fn, node, message, via) -> None:
+        suffix = f" [dtype via {via}]" if via else ""
+        self._out.append(
+            Finding(
+                self.rule,
+                fn.module.relpath,
+                getattr(node, "lineno", 1),
+                fn.qualname,
+                message + suffix,
+            )
+        )
+
+    def _eval(self, fn, module, node, facts, out, depth, via=""):
+        """Returns the fact (category str, tuple of facts, or None) and
+        appends findings for the patterns in the module docstring.  ``fn``
+        may be None for quiet dtype-position evaluation."""
+        if isinstance(node, ast.Name):
+            return facts.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("T", "mT", "real", "imag"):
+                return self._eval(fn, module, node.value, facts, out, depth,
+                                  via)
+            return None
+        if isinstance(node, ast.Subscript):
+            self._eval(fn, module, node.slice, facts, out, depth, via)
+            return self._eval(fn, module, node.value, facts, out, depth, via)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(fn, module, node.operand, facts, out, depth,
+                              via)
+        if isinstance(node, ast.Tuple):
+            return tuple(
+                self._eval(fn, module, e, facts, out, depth, via)
+                for e in node.elts
+            )
+        if isinstance(node, ast.Lambda):
+            inner = dict(facts)
+            for a in node.args.args:
+                inner[a.arg] = None
+            return self._eval(fn, module, node.body, inner, out, depth, via)
+        if isinstance(node, ast.IfExp):
+            self._eval(fn, module, node.test, facts, out, depth, via)
+            a = self._eval(fn, module, node.body, facts, out, depth, via)
+            b = self._eval(fn, module, node.orelse, facts, out, depth, via)
+            return a if a == b else None
+        if isinstance(node, ast.BinOp):
+            left = self._eval(fn, module, node.left, facts, out, depth, via)
+            right = self._eval(fn, module, node.right, facts, out, depth, via)
+            lf = left if isinstance(left, str) else None
+            rf = right if isinstance(right, str) else None
+            if isinstance(node.op, ast.MatMult):
+                if fn is not None and (lf in LOW_MATMUL or rf in LOW_MATMUL):
+                    low = lf if lf in LOW_MATMUL else rf
+                    self._add(
+                        fn, node,
+                        f"{low} matmul via '@' without f32 accumulation "
+                        f"(use jnp.matmul/lax.dot_general with "
+                        f"preferred_element_type=jnp.float32)",
+                        via,
+                    )
+                return self._widest(lf, rf)
+            if fn is not None and (
+                (lf in LOW_FLOAT and rf == "f64")
+                or (rf in LOW_FLOAT and lf == "f64")
+            ):
+                self._add(
+                    fn, node,
+                    "float64 operand silently widens a bf16/f16 pipeline "
+                    "(weak-type promotion; cast explicitly or keep f32)",
+                    via,
+                )
+            return self._widest(lf, rf)
+        if isinstance(node, ast.Call):
+            return self._eval_call(fn, module, node, facts, out, depth, via)
+        if isinstance(node, (ast.List, ast.Set)):
+            for e in node.elts:
+                self._eval(fn, module, e, facts, out, depth, via)
+            return None
+        if isinstance(node, ast.Dict):
+            for e in list(node.keys) + list(node.values):
+                if e is not None:
+                    self._eval(fn, module, e, facts, out, depth, via)
+            return None
+        if isinstance(node, ast.Compare):
+            self._eval(fn, module, node.left, facts, out, depth, via)
+            for c in node.comparators:
+                self._eval(fn, module, c, facts, out, depth, via)
+            return None
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return None  # comprehension scopes: out of fact range
+        return None
+
+    @staticmethod
+    def _widest(a: Optional[str], b: Optional[str]) -> Optional[str]:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a if _WIDTH.get(a, 0) >= _WIDTH.get(b, 0) else b
+
+    def _kwarg(self, node: ast.Call, name: str) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _eval_call(self, fn, module, node, facts, out, depth, via):
+        name = call_name(node)
+        resolved = module.resolve_alias(name) if name else ""
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        if not isinstance(node.func, (ast.Name, ast.Attribute)):
+            # computed target — e.g. jax.jit(lambda ...)(args): the
+            # wrapper call (and any lambda body) still carries dtype flow
+            self._eval(fn, module, node.func, facts, out, depth, via)
+        arg_facts = [
+            self._eval(fn, module, a, facts, out, depth, via)
+            for a in node.args
+        ]
+        for kw in node.keywords:
+            self._eval(fn, module, kw.value, facts, out, depth, via)
+
+        # float64 entering a jax/jnp call through any dtype-ish argument
+        if fn is not None and _is_jnp_head(resolved):
+            for candidate in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if self._dtype_of(module, candidate, facts) == "f64":
+                    self._add(
+                        fn, node,
+                        f"float64 dtype passed to {name}() — f64 is "
+                        "TPU-emulated and doubles HBM traffic; use float32",
+                        via,
+                    )
+                    break
+
+        # x.astype(D): the rebind
+        if tail == "astype" and isinstance(node.func, ast.Attribute):
+            recv = self._eval(fn, module, node.func.value, facts, out,
+                              depth, via)
+            cat = self._dtype_of(module, node.args[0] if node.args else None,
+                                 facts)
+            if (
+                fn is not None
+                and cat == "f64"
+                and isinstance(recv, str)
+                and recv in ("bf16", "f16", "f32")
+            ):
+                self._add(
+                    fn, node,
+                    "astype(float64) on a float pipeline value — f64 is "
+                    "TPU-emulated; accumulate in float32 instead",
+                    via,
+                )
+            return cat
+
+        # creation calls with a dtype argument
+        head = resolved.split(".")[0]
+        if tail in _CREATE_TAILS and head in ("jax", "jnp", "np", "numpy"):
+            d = self._kwarg(node, "dtype")
+            if d is None:
+                pos = _CREATE_TAILS[tail]
+                if pos is not None and len(node.args) > pos:
+                    d = node.args[pos]
+            return self._dtype_of(module, d, facts)
+        if tail == "ShapeDtypeStruct" and len(node.args) >= 2:
+            return self._dtype_of(module, node.args[1], facts)
+
+        # matmul family
+        if tail in _MATMUL_TAILS and (
+            _is_jnp_head(resolved) or head in ("np", "numpy")
+        ):
+            if tail == "einsum" and node.args and isinstance(
+                node.args[0], ast.Constant
+            ):
+                operands = arg_facts[1:]
+            elif tail == "dot_general":
+                operands = arg_facts[:2]
+            else:
+                operands = arg_facts[:2]
+            pet = self._kwarg(node, "preferred_element_type")
+            pet_cat = self._dtype_of(module, pet, facts)
+            low = next((f for f in operands if f in LOW_MATMUL), None)
+            if fn is not None and low is not None:
+                if pet is None:
+                    self._add(
+                        fn, node,
+                        f"{low} operand to {tail}() without "
+                        "preferred_element_type — low-precision matmuls "
+                        "must accumulate in float32 or wider",
+                        via,
+                    )
+                elif pet_cat is not None and pet_cat not in WIDE_ACC:
+                    self._add(
+                        fn, node,
+                        f"{tail}() accumulates a {low} operand into "
+                        f"{pet_cat} — preferred_element_type must be "
+                        "float32 or wider",
+                        via,
+                    )
+            if pet_cat is not None:
+                return pet_cat
+            known = [f for f in operands if isinstance(f, str)]
+            return known[0] if len(known) == len(operands) and known else None
+
+        # method-form matmul: x.dot(y)
+        if tail == "dot" and isinstance(node.func, ast.Attribute):
+            recv = self._eval(fn, module, node.func.value, facts, out,
+                              depth, via)
+            if fn is not None and (
+                recv in LOW_MATMUL
+                or any(f in LOW_MATMUL for f in arg_facts)
+            ):
+                self._add(
+                    fn, node,
+                    "low-precision .dot() without f32 accumulation (use "
+                    "jnp.matmul/lax.dot_general with "
+                    "preferred_element_type=jnp.float32)",
+                    via,
+                )
+            return recv if isinstance(recv, str) else None
+
+        # reductions
+        if tail in _REDUCE_TAILS:
+            operand = None
+            if isinstance(node.func, ast.Attribute) and head not in (
+                "jnp", "np", "numpy", "jax"
+            ):
+                operand = self._eval(fn, module, node.func.value, facts,
+                                     out, depth, via)
+            elif arg_facts:
+                if _is_jnp_head(resolved) or head in ("np", "numpy"):
+                    operand = arg_facts[0]
+            dt = self._dtype_of(module, self._kwarg(node, "dtype"), facts)
+            if fn is not None and operand in LOW_FLOAT and (
+                dt is None or dt not in WIDE_ACC
+            ):
+                self._add(
+                    fn, node,
+                    f"{tail}() reduces a {operand} value without an f32 "
+                    "accumulator — pass dtype=jnp.float32 or upcast the "
+                    "operand first",
+                    via,
+                )
+            return dt or (operand if isinstance(operand, str) else None)
+        if tail in _SOFTMAX_TAILS and arg_facts:
+            if fn is not None and arg_facts[0] in LOW_FLOAT:
+                self._add(
+                    fn, node,
+                    f"{tail}() over a {arg_facts[0]} value — softmax "
+                    "must run in float32 (upcast the scores first)",
+                    via,
+                )
+            return arg_facts[0] if isinstance(arg_facts[0], str) else None
+
+        # jnp.dtype(...) in value position
+        if tail == "dtype" and node.args:
+            return self._dtype_of(module, node.args[0], facts)
+
+        # cross-module propagation through the package index
+        if fn is not None and self._package is not None:
+            callee = self._package.resolve_call(fn, node)
+            if callee is not None and hasattr(callee.node, "args"):
+                low_binding = self._bind_params(callee, node, arg_facts)
+                if low_binding:
+                    self._scan(
+                        callee, low_binding,
+                        via=via or fn.qualname, depth=depth + 1,
+                    )
+                return self._return_fact(callee, depth + 1)
+        return None
+
+    def _bind_params(self, callee: FunctionInfo, node: ast.Call,
+                     arg_facts) -> Dict[str, Optional[str]]:
+        """Positional/keyword binding of LOW facts onto callee params;
+        empty when no low fact crosses the call (nothing new to scan)."""
+        params = callee.params
+        offset = 1 if callee.class_name and params[:1] == ["self"] else 0
+        binding: Dict[str, Optional[str]] = {}
+        for i, f in enumerate(arg_facts):
+            if f in LOW_MATMUL and i + offset < len(params):
+                binding[params[i + offset]] = f
+        for kw in node.keywords:
+            if kw.arg and kw.arg in params:
+                # facts for keywords were evaluated already; re-derive is
+                # costlier than it is worth — positional covers the tree
+                continue
+        return binding
+
+    def _return_fact(self, callee: FunctionInfo, depth: int):
+        """Fact of a resolved callee's return value, from a quiet scan of
+        its body with no parameter facts (memoized)."""
+        if depth > _MAX_DEPTH:
+            return None
+        memo = self._ret_memo
+        key = id(callee.node)
+        if key in memo:
+            return memo[key]
+        memo[key] = None  # cycle guard
+        facts: Dict[str, Optional[str]] = {}
+        sink: List[Finding] = []
+        rets = []
+
+        def walk(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.Assign):
+                    fact = self._eval(None, callee.module, stmt.value, facts,
+                                      sink, depth)
+                    for t in stmt.targets:
+                        self._bind(t, fact, facts)
+                elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                    rets.append(
+                        self._eval(None, callee.module, stmt.value, facts,
+                                   sink, depth)
+                    )
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if isinstance(sub, list):
+                        walk(sub)
+                if isinstance(stmt, ast.Try):
+                    for handler in stmt.handlers:
+                        walk(handler.body)
+
+        body = getattr(callee.node, "body", None)
+        if body:
+            walk(body)
+        uniq = {repr(r) for r in rets}
+        result = rets[0] if len(uniq) == 1 and rets else None
+        memo[key] = result
+        return result

@@ -221,7 +221,12 @@ class JitPurityChecker:
         if depth > 6:
             return
         if isinstance(target, ast.Lambda):
-            lambdas.append((fn, target, via))
+            # dedupe by node identity: two call sites wrapping the same
+            # builder (e.g. the serving engine AND an audit harness both
+            # jit build_fused_search_program's return) must not ledger
+            # the one lambda twice
+            if not any(lam is target for _fn, lam, _via in lambdas):
+                lambdas.append((fn, target, via))
             return
         if isinstance(target, ast.Call):
             name = call_name(target)

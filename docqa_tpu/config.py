@@ -421,6 +421,14 @@ class GenerateConfig:
     # decode-step bucketing: prefill lengths are padded to these buckets so a
     # handful of compiled programs cover all requests.
     prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
+    # startup warm depth: how many of the SMALLEST prefill buckets the
+    # runtime pre-compiles (both admission shape families + the decode
+    # chunk) in the background at boot via ContinuousBatcher.warmup().
+    # -1 = the whole bucket ladder (a deployment that wants zero compile
+    # surprises pays the full compile bill up front); 0 = none.  The
+    # default keeps dev/CPU boots cheap; the compile audit proves the
+    # full-set mechanism retrace-free regardless (compile_budget.json).
+    startup_warm_buckets: int = 1
     max_concurrent: int = 16  # continuous batching lanes (QPS 16 target)
     # tokens per batcher decode dispatch: larger chunks amortize dispatch
     # round-trips (dominant over a tunneled TPU) at the cost of coarser

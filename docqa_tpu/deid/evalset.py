@@ -128,10 +128,15 @@ _MARKED: Sequence[str] = (
     "regional wall motion abnormality.",
 )
 
-# ---- TEST split (VERDICT r4 item 5) ----------------------------------------
-# Written AFTER the served threshold (0.8) was frozen from the dev curve,
-# and never consulted by any tuning step — the bench's reported ``deid.f1``
-# comes from these spans only.  Registers again avoid datagen's templates
+# ---- SECOND DEV split (VERDICT r4 item 5, relabeled honestly) --------------
+# Written AFTER the served threshold (0.8) was frozen from the dev curve —
+# but round 5 then tuned the deny-word list and person-position cues
+# (deid/engine.py) directly against THESE spans, so they are a second dev
+# set, not a held-out test: the bench's reported ``deid.f1`` carries
+# tuning optimism from that step and must be labeled accordingly wherever
+# it is quoted.  A genuinely held-out split would have to be written
+# fresh and never scored until a release gate.  Registers avoid datagen's
+# templates
 # and go beyond the dev split's: ED triage, operative notes, medication
 # reconciliation, transcribed voicemail, social-work and hospice notes,
 # billing correspondence, more French prose, and harder shapes (initials,
@@ -355,14 +360,16 @@ def _bootstrap_f1_ci(
 def evaluate_deid_split(
     engine, n_boot: int = 1000, seed: int = 0
 ) -> Dict[str, object]:
-    """Dev/test evaluation (VERDICT r4 item 5).
+    """Dev / second-dev evaluation (VERDICT r4 item 5, relabeled).
 
     * ``dev`` — the original 21-example split; the served acceptance
       threshold (``DEFAULT_NER_THRESHOLD``) was selected on its operating
       curve, so its numbers carry metric-selection optimism.
-    * ``test`` — spans written after that threshold was frozen and never
-      used by any tuning step; ``test.entity_f1`` (with its bootstrap
-      95% CI) is the number to report.
+    * ``test`` — the SECOND dev split (key kept for report
+      compatibility): written after the threshold froze, but round 5
+      tuned deny-words and person-position cues against these spans, so
+      ``test.entity_f1`` also carries tuning optimism — report it as a
+      second dev number, never as held-out.
     """
     dev_preds = _predict(engine, DEV_EXAMPLES)
     test_preds = _predict(engine, TEST_EXAMPLES)
@@ -373,7 +380,8 @@ def evaluate_deid_split(
         "dev": _score(DEV_EXAMPLES, dev_preds),
         "test": test,
         "note": (
-            "threshold selected on dev only; test spans never used for "
-            "tuning"
+            "threshold selected on dev; the 'test' split is a SECOND dev "
+            "set (r5 tuned deny-words/cues against its spans) — its F1 "
+            "carries tuning optimism and is not a held-out number"
         ),
     }

@@ -16,17 +16,31 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Tuple
 
 from docqa_tpu.resilience.deadline import Deadline
+from docqa_tpu.runtime.metrics import get_logger
+
+log = get_logger("docqa.dispatch")
+
+# jax's actual use-after-donation phrasings, both layers of the stack:
+# jaxlib PjRt raises "Buffer has been deleted or donated", and jax's own
+# lifecycle guard raises "Array has been deleted".  Matching bare
+# "deleted"/"donated" (the old test) also swallowed unrelated
+# RuntimeErrors — e.g. an XLA "resource deleted by peer" transport error
+# — and retried them 3x with a fresh multi-second compile each time.
+_DELETED_BUFFER_MARKERS = (
+    "buffer has been deleted or donated",
+    "deleted or donated buffer",
+    "array has been deleted",
+)
 
 
 def _is_deleted_buffer_error(e: Exception) -> bool:
-    """True only for the use-after-donation failure mode (jax raises
-    RuntimeError mentioning the deleted/donated buffer).  Anything else —
-    compile failure, device OOM — must propagate: retrying it under the
-    lock would repeat a multi-second compile while holding up every
-    concurrent store caller, the exact stall this module exists to
-    avoid."""
+    """True only for the use-after-donation failure mode.  Anything else —
+    compile failure, device OOM, transport errors — must propagate:
+    retrying it under the lock would repeat a multi-second compile while
+    holding up every concurrent store caller, the exact stall this module
+    exists to avoid."""
     msg = str(e).lower()
-    return "deleted" in msg or "donated" in msg
+    return any(marker in msg for marker in _DELETED_BUFFER_MARKERS)
 
 
 def dispatch_with_donation_retry(
@@ -66,6 +80,13 @@ def dispatch_with_donation_retry(
         except RuntimeError as e:
             if not _is_deleted_buffer_error(e):
                 raise
+            # visible, not silent: a donation race per dispatch is
+            # expected noise, a STREAK of them is an ingest/serve
+            # contention signal an operator should see
+            log.warning(
+                "donation race on unlocked dispatch attempt %d/2; "
+                "re-snapshotting (%r)", unlocked_try + 1, e,
+            )
     with lock:
         if deadline is not None:
             deadline.check("dispatch")

@@ -36,9 +36,11 @@ from docqa_tpu.models.decoder import (
 from docqa_tpu.ops.sampling import sample
 from docqa_tpu.parallel.sharding import cache_pspecs, shard_decoder_params
 from docqa_tpu.runtime.mesh import MeshContext
-from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 from docqa_tpu.text.tokenizer import Tokenizer, default_tokenizer
 from docqa_tpu.utils import pick_bucket, round_up
+
+log = get_logger("docqa.generate")
 
 BATCH_BUCKETS = (1, 2, 4, 8, 16)
 
@@ -432,6 +434,66 @@ class GenerateEngine:
             )
             self._fns[key] = fn
         return fn
+
+    def decode_memory_analysis(
+        self,
+        prompt_len: int = 3,
+        batch: int = 1,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+    ):
+        """AOT ``memory_analysis()`` of the decode program serving the
+        given request shape: lower+compile against abstract token inputs
+        (the real param arrays ride along, so ``argument_bytes`` is the
+        true HBM-resident working set) and return the backend's byte
+        accounting, or None when it provides none.
+
+        Shared by the compile audit (``analysis/compile_audit.py`` gates
+        per-root ``peak_bytes`` against ``compile_budget.json``) and
+        ``bench.py`` (which feeds ``argument_bytes`` into the
+        ``hbm_utilization`` it reports) — one measurement path
+        (``utils.compiled_memory_stats``), no drift."""
+        from docqa_tpu.utils import compiled_memory_stats
+
+        max_new = (
+            self.gen.max_new_tokens
+            if max_new_tokens is None
+            else max_new_tokens
+        )
+        temperature = (
+            self.gen.temperature if temperature is None else temperature
+        )
+        usable = self.cfg.max_seq_len - max_new
+        bucket = min(
+            pick_bucket(prompt_len, self.gen.prefill_buckets)
+            if prompt_len <= self.gen.prefill_buckets[-1]
+            else round_up(prompt_len, 128),
+            usable,
+        )
+        b_pad = (
+            pick_bucket(batch, BATCH_BUCKETS)
+            if batch <= BATCH_BUCKETS[-1]
+            else batch
+        )
+        if self.mesh is not None:
+            b_pad = round_up(b_pad, self.mesh.n_data)
+        fn = self._get_fn(b_pad, bucket, max_new, greedy=temperature == 0.0)
+        try:
+            return compiled_memory_stats(
+                fn.lower(
+                    self.params,
+                    jax.ShapeDtypeStruct((b_pad, bucket), jnp.int32),
+                    jax.ShapeDtypeStruct((b_pad,), jnp.int32),
+                    jax.random.PRNGKey(0),
+                    jnp.float32(temperature),
+                ).compile()
+            )
+        except Exception:
+            # a lowering failure must not take the bench/audit caller
+            # down, but it must be VISIBLE — a silent None here would
+            # quietly reintroduce the unmeasured-HBM state
+            log.exception("decode AOT memory analysis failed")
+            return None
 
     # ---- host API ------------------------------------------------------------
 

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import itertools
 import threading
 from dataclasses import dataclass, field
 from time import monotonic as time_monotonic
@@ -224,7 +225,7 @@ class ContinuousBatcher:
         self.chunk = chunk or getattr(self.gen, "decode_chunk", 8)
         self.cache_len = round_up(cache_len or self.cfg.max_seq_len, 128)
         self._seed = seed
-        self._rng_counter = 0
+        self._rng_counter = itertools.count(1)
         self.max_queue = max_queue
         # prompt-lookup speculation in the served path (greedy only): each
         # chunk iteration verifies spec_k tokens per slot in one weight
@@ -268,8 +269,13 @@ class ContinuousBatcher:
     # ---- device programs -----------------------------------------------------
 
     def _next_rng(self) -> jax.Array:
-        self._rng_counter += 1
-        return jax.random.PRNGKey(self._seed * 100_003 + self._rng_counter)
+        # next() on itertools.count is atomic (C level): warmup() runs
+        # from a background thread while the worker dispatches, and a
+        # torn `+= 1` would mint the SAME PRNGKey for two dispatches
+        # (correlated sampling across requests)
+        return jax.random.PRNGKey(
+            self._seed * 100_003 + next(self._rng_counter)
+        )
 
     def _prefill_program(self, params, cache, ids, lengths, slots, rng,
                          table=None):
@@ -448,9 +454,14 @@ class ContinuousBatcher:
         return cache, table, tok, lengths, active, packed
 
     def _get_prefill_fn(self):
-        """One jit object; XLA re-specializes per prompt-bucket shape (the
-        batch axis is always padded to ``n_slots``, so prompt buckets are
-        the only compile dimension)."""
+        """One jit object; XLA re-specializes per (batch, prompt-bucket)
+        shape.  The batch axis pads to one of exactly TWO shapes per
+        bucket — the 4-lane trickle shape for rounds admitting <=4
+        requests and the full ``n_slots`` width otherwise (see
+        ``_admit_round``) — so the compile surface is 2 x len(buckets),
+        and :meth:`warmup` pre-compiles every member of that set before
+        traffic (the compile audit holds the steady state to zero
+        retraces against ``compile_budget.json``)."""
         if self._prefill_fn is None:
             if self.spec_k:
                 self._prefill_fn = jax.jit(
@@ -476,6 +487,89 @@ class ContinuousBatcher:
                     self._decode_program, donate_argnums=(1,)
                 )
         return self._decode_fn
+
+    def _fresh_device_state(self):
+        """A throwaway (cache, table, tok, lengths, active) tuple with the
+        exact shapes/dtypes/shardings of the live slot state — warmup
+        dispatches donate THESE instead of the live buffers, so a warmup
+        can run concurrently with serving without ever racing the worker
+        for ``self._cache``."""
+        cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
+        if self.mesh is not None and self.mesh.n_devices > 1:
+            from docqa_tpu.parallel.sharding import shard_kv_cache
+
+            cache = shard_kv_cache(cache, self.cfg, self.mesh)
+        table = (
+            jnp.full((self.n_slots, self.cfg.vocab_size), -1, jnp.int32)
+            if self.spec_k
+            else None
+        )
+        tok = jnp.zeros((self.n_slots,), jnp.int32)
+        lengths = jnp.zeros((self.n_slots,), jnp.int32)
+        active = jnp.zeros((self.n_slots,), bool)
+        return cache, table, tok, lengths, active
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
+        """Compile the whole admission-path shape set ahead of traffic.
+
+        ``_admit_round`` dispatches one of exactly TWO batch shapes per
+        prompt bucket: the 4-lane trickle shape (rounds admitting <=4)
+        and the full ``n_slots`` width.  Warming only one of them — the
+        old behavior everywhere (the app's single dummy submit warmed
+        trickle only; the bench's ``n_slots`` burst warmed full only) —
+        left the other family to trace+compile INSIDE the first latency
+        measurement or live request that hit it (the r05 open-loop runs
+        paid the trickle compile mid-measurement; BENCH_r05).
+
+        Every warm dispatch donates a throwaway state tuple
+        (``_fresh_device_state``) and scatters all lanes out of bounds,
+        so live slots are untouched and the method is safe to run from a
+        background thread while traffic arrives.  ``buckets`` defaults to
+        every configured prefill bucket that fits the cache budget.
+        """
+        usable = self.cache_len - 2 - self.spec_k
+        if buckets is None:
+            buckets = self.gen.prefill_buckets
+        # CLAMP oversized buckets to ``usable`` (never drop them):
+        # _admit_round dispatches min(bucket, usable), so the clamped
+        # shape is a real admitted shape that must be warmed too — a
+        # dropped bucket would leave a live compile for any prompt
+        # whose bucket exceeds the cache budget
+        buckets = sorted({min(int(b), usable) for b in buckets})
+        widths = sorted({4, self.n_slots}) if self.n_slots > 4 else [
+            self.n_slots
+        ]
+        fn = self._get_prefill_fn()
+        for bucket in buckets:
+            for B in widths:
+                cache, table, _tok, _lengths, _active = (
+                    self._fresh_device_state()
+                )
+                ids = jnp.full((B, bucket), self.gen.pad_id, jnp.int32)
+                lengths = jnp.ones((B,), jnp.int32)
+                # every lane scatters out of bounds -> dropped write
+                slots = jnp.full((B,), self.n_slots, jnp.int32)
+                if self.spec_k:
+                    fn(
+                        self.engine.params, cache, table, ids, lengths,
+                        slots, self._next_rng(),
+                    )
+                else:
+                    fn(
+                        self.engine.params, cache, ids, lengths, slots,
+                        self._next_rng(),
+                    )
+        # decode chunk: one shape regardless of bucket — all-inactive
+        # lanes still trace/compile the full program
+        dfn = self._get_decode_fn()
+        cache, table, tok, lengths, active = self._fresh_device_state()
+        if self.spec_k:
+            dfn(self.engine.params, cache, table, tok, lengths, active)
+        else:
+            dfn(
+                self.engine.params, cache, tok, lengths, active,
+                self._next_rng(),
+            )
 
     # ---- public API ----------------------------------------------------------
 

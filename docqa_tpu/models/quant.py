@@ -102,7 +102,10 @@ def probe_int4_support() -> Tuple[bool, str]:
             jnp.arange(256, dtype=jnp.int8).reshape(16, 16).astype(jnp.int4)
         )
         x4 = jnp.ones((4, 16), jnp.bfloat16)
-        np.asarray(jax.jit(lambda x, w: x @ w.astype(jnp.bfloat16))(x4, w4))
+        # One-shot capability probe: the throwaway wrapper and bf16
+        # accumulation are the point — only "does an S4 program lower and
+        # execute" matters, never the product's numerics or a warm cache.
+        np.asarray(jax.jit(lambda x, w: x @ w.astype(jnp.bfloat16))(x4, w4))  # docqa-lint: disable=dtype-flow,retrace-hazard
         del w4, x4
         return True, ""
     except Exception as e:

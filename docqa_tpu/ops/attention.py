@@ -46,6 +46,12 @@ def attention_reference(
 ):
     """Plain XLA attention.  f32 softmax, bf16 matmuls via preferred type.
 
+    The upcast-before-math recipe below (``astype(float32)`` on q/k/v,
+    softmax over f32 scores) is the dtype contract the ``dtype-flow``
+    lint rule enforces tree-wide (docs/STATIC_ANALYSIS.md): a bf16
+    operand reaching an einsum/softmax without this upcast is a red
+    build, not a convention.
+
     ``q_offset`` [batch]: absolute position of q[:, 0] (decode steps where
     q_len << kv_len).  Defaults to aligning the *ends* of q and kv when
     causal (standard prefill/decode convention).

@@ -479,10 +479,30 @@ class DocQARuntime:
 
     def _warmup_decode(self) -> None:
         try:
+            # compile BOTH admission shape families (4-lane trickle + full
+            # n_slots) plus the decode chunk for the configured warm depth
+            # (gen.startup_warm_buckets smallest buckets; -1 = the whole
+            # ladder) — the single dummy submit below only ever warmed
+            # the trickle shape, so the first busy round paid a
+            # full-width prefill compile inside a live request's deadline
+            gen = self.batcher.gen
+            depth = gen.startup_warm_buckets
+            if depth != 0:
+                buckets = (
+                    None if depth < 0
+                    else list(gen.prefill_buckets[:depth])
+                )
+                self.batcher.warmup(buckets=buckets)
+            # then one real request end to end: exercises admission,
+            # sampling, retirement and the result path on top of the
+            # warmed programs
             self.batcher.submit_ids(
                 [1, 2, 3], max_new_tokens=2
             ).result(timeout=600)
-            log.info("decode programs warm")
+            log.info(
+                "decode programs warm (both prefill shape families, "
+                "warm depth %s)", depth,
+            )
         except Exception:
             log.exception("decode warmup failed (serving continues cold)")
 

@@ -129,6 +129,32 @@ def _decode_pdf_string(raw: bytes) -> bytes:
     return bytes(out)
 
 
+def _iter_streams(data: bytes):
+    """Yield ``(dict_window, content)`` per PDF stream: the bytes of the
+    object dictionary immediately preceding the ``stream`` keyword and
+    the inflated (or raw, for uncompressed streams) body — the ONE
+    stream walk shared by :func:`extract_pdf` and the failure diagnosis
+    (`_pdf_has_text_content`), so stream handling cannot drift between
+    extraction and its post-mortem."""
+    for m in _STREAM_RE.finditer(data):
+        raw = m.group(1)
+        try:
+            content = zlib.decompress(raw)
+        except zlib.error:
+            content = raw  # uncompressed stream
+        # the dict window stops at the nearest object boundary so one
+        # stream's window can never swallow the PREVIOUS object's dict
+        # (tiny PDFs put several objects within 300 bytes)
+        start = m.start()
+        head_start = max(
+            data.rfind(b"obj", 0, start),
+            data.rfind(b"endstream", 0, start),
+            start - 300,
+            0,
+        )
+        yield data[head_start:start], content
+
+
 def extract_pdf(data: bytes) -> Optional[str]:
     """Minimal PDF text extraction: inflate content streams, read Tj/TJ
     show-text operators.  Covers linear text PDFs (clinical letters/reports);
@@ -137,12 +163,7 @@ def extract_pdf(data: bytes) -> Optional[str]:
     if not data.startswith(b"%PDF"):
         return None
     pieces = []
-    for m in _STREAM_RE.finditer(data):
-        raw = m.group(1)
-        try:
-            content = zlib.decompress(raw)
-        except zlib.error:
-            content = raw  # uncompressed stream
+    for _head, content in _iter_streams(data):
         if b"Tj" not in content and b"TJ" not in content:
             continue
         line: list = []
@@ -199,6 +220,33 @@ _PDF_HARD_FILTERS = (
 )
 _PDF_IMAGE_MARKS = (b"DCTDecode", b"JPXDecode", b"/Image")
 
+# Evidence that a PDF carries TEXT content even though extraction came
+# back empty: structured show-text operators inside a (decompressable)
+# content stream — literal, hex (CID-keyed fonts), or array form — or
+# font-machinery dictionaries (/ToUnicode, /CIDFont).  Deliberately
+# structural patterns, not bare "Tj"/"BT" substrings: JPEG payloads
+# contain arbitrary byte pairs and must not read as text evidence.
+_PDF_TEXT_EVIDENCE_RE = re.compile(
+    rb"\((?:[^()\\]|\\.)*\)\s*T[jJ]"
+    rb"|<[0-9A-Fa-f\s]+>\s*T[jJ]"
+    rb"|\[(?:[^\]\\]|\\.)*\]\s*TJ"
+)
+
+
+def _pdf_has_text_content(data: bytes) -> bool:
+    if b"/ToUnicode" in data or b"/CIDFont" in data:
+        return True
+    for head, content in _iter_streams(data):
+        # image streams are raw compressed pixel data — multi-MB JPEG
+        # bodies can coincidentally contain show-text-shaped byte runs,
+        # and a false "text evidence" hit would steer a genuinely
+        # scanned PDF's operator away from OCR
+        if any(mark in head for mark in _PDF_IMAGE_MARKS):
+            continue
+        if _PDF_TEXT_EVIDENCE_RE.search(content):
+            return True
+    return False
+
 # THE signature table: known non-plain-text containers with no in-process
 # extractor, (magic prefixes, diagnosis slug).  Read by BOTH the dispatch
 # gate in extract_text_ex (so these never fall into the latin-1 text
@@ -250,7 +298,14 @@ def diagnose_unextractable(data: bytes, filename: str) -> str:
     if data.startswith(b"%PDF"):
         if b"/Encrypt" in data:
             return "pdf_encrypted"
-        if any(m in data for m in _PDF_IMAGE_MARKS):
+        # Text evidence FIRST: a text PDF with a letterhead logo (or a
+        # CID-font report with figures) contains image marks too, and the
+        # old image-marks-first order mislabeled every such failure
+        # "scanned" — sending the operator to OCR when the actionable fix
+        # was the unsupported stream filter or the CID font.
+        if any(m in data for m in _PDF_IMAGE_MARKS) and not (
+            _pdf_has_text_content(data)
+        ):
             return "pdf_scanned_image_only"
         if any(f in data for f in _PDF_HARD_FILTERS):
             return "pdf_unsupported_filter"

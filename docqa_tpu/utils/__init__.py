@@ -34,3 +34,42 @@ def pick_bucket(value: int, buckets: Sequence[int]) -> int:
         if value <= b:
             return b
     return buckets[-1]
+
+
+def compiled_memory_stats(lowered_compiled) -> Optional[dict]:
+    """``memory_analysis()`` of an AOT-compiled jax program as plain ints,
+    or None when the backend provides no analysis.
+
+    Lives here (not in ``analysis/``) because both the SERVING layer
+    (``GenerateEngine.decode_memory_analysis`` feeds bench's
+    ``hbm_utilization``) and the audit tooling
+    (``analysis/compile_audit.py`` gates ``compile_budget.json``) read
+    the same accounting — engines must never import the lint tree.
+
+    ``peak_bytes`` = argument + output + temp − alias: the working set
+    resident during a dispatch, with donation aliases (in-place cache /
+    table updates) not double-counted."""
+    try:
+        ma = lowered_compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for key, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+    ):
+        try:
+            out[key] = int(getattr(ma, attr))
+        except Exception:
+            out[key] = 0
+    out["peak_bytes"] = max(
+        0,
+        out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        - out["alias_bytes"],
+    )
+    return out

@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""docqa-numcheck CLI: drive the serving workloads, count compiles, and
+hold compile counts + per-root HBM bytes to compile_budget.json.
+
+Usage:
+    python scripts/compile_audit.py                      # gate (exit 1 on
+                                                         # drift)
+    python scripts/compile_audit.py --report out.json    # also write the
+                                                         # CI trend artifact
+    python scripts/compile_audit.py --write-budget       # accept measured
+                                                         # counts (HBM
+                                                         # ceilings only
+                                                         # grow through a
+                                                         # TODO note the
+                                                         # gate rejects
+                                                         # until edited;
+                                                         # jit-root reasons
+                                                         # preserved)
+    python scripts/compile_audit.py --workloads serve,generate
+
+The gate fails on: any steady-state retrace, a compile count different
+from the budget's, a root's measured peak bytes above its ceiling, a
+TODO ceiling/waiver note, and a jit-root ledger out of sync with the
+tree.  Runs on the CPU backend so CI and laptops measure the same
+programs.  See docs/STATIC_ANALYSIS.md for the budget format and the
+amendment workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from docqa_tpu.analysis import compile_audit  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="budget JSON path (default: <repo>/compile_budget.json)",
+    )
+    parser.add_argument(
+        "--report",
+        default=None,
+        help="write the measured report (counts + memory + roots) to this "
+        "path (the CI compile/HBM trend artifact)",
+    )
+    parser.add_argument(
+        "--write-budget",
+        action="store_true",
+        help="rewrite the budget from the measured counts (ceilings are "
+        "preserved while the measurement fits; growth gets a TODO note "
+        "the gate rejects until justified)",
+    )
+    parser.add_argument(
+        "--workloads",
+        default=None,
+        help="comma-separated subset of: "
+        + ", ".join(compile_audit.WORKLOADS),
+    )
+    args = parser.parse_args(argv)
+
+    workloads = (
+        [w.strip() for w in args.workloads.split(",") if w.strip()]
+        if args.workloads
+        else None
+    )
+    for name in workloads or ():
+        if name not in compile_audit.WORKLOADS:
+            parser.error(f"unknown workload '{name}'")
+
+    report = compile_audit.run_audit(workloads=workloads)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"report -> {args.report}")
+
+    if args.write_budget:
+        if workloads:
+            parser.error(
+                "--write-budget needs a full run (no --workloads): a "
+                "partial budget would be stale"
+            )
+        budget = compile_audit.write_budget(report, args.budget)
+        todo = [
+            f"{w}/{r}: {root['ceiling_note']}"
+            for w, r, root in compile_audit._iter_roots(budget)
+            if "TODO" in str(root.get("ceiling_note", ""))
+        ]
+        todo += [
+            s for s, reason in budget["jit_roots"].items()
+            if "TODO" in str(reason)
+        ]
+        print(
+            f"budget updated -> "
+            f"{args.budget or compile_audit.default_budget_path()}"
+        )
+        if todo:
+            print(
+                f"{len(todo)} entr(ies) need a human-written reason "
+                f"before the gate passes:"
+            )
+            for s in todo:
+                print(f"  {s}")
+        return 0
+
+    budget_path = args.budget or compile_audit.default_budget_path()
+    if not os.path.exists(budget_path):
+        print(
+            f"no budget at {budget_path}; run --write-budget first",
+            file=sys.stderr,
+        )
+        return 1
+    budget = compile_audit.load_budget(budget_path)
+    if workloads:
+        # scoped runs compare only what they measured
+        budget = dict(budget)
+        budget["workloads"] = {
+            k: v
+            for k, v in budget.get("workloads", {}).items()
+            if k in workloads
+        }
+        budget.pop("jit_roots", None)
+        report = dict(report)
+        report.pop("jit_roots", None)
+    violations = compile_audit.compare_budget(report, budget)
+
+    for wname, rname, root in sorted(compile_audit._iter_roots(report)):
+        print(
+            f"{wname:16s} {rname:18s} compiles={root.get('compiles')} "
+            f"retraces={root.get('steady_state_retraces')} "
+            f"peak={root.get('peak_bytes')}B"
+        )
+    if violations:
+        print(f"\ncompile-audit: {len(violations)} violation(s):")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("\ncompile-audit: budget satisfied")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,7 @@
 Each rule gets three fixture classes: a seeded violation (detected), the
 same violation with a ``# docqa-lint: disable=<rule>`` suppression
 (silent), and a clean/sanctioned variant (silent).  The gate tests then
-run the full four-checker suite over the real ``docqa_tpu`` tree and
+run the full ten-checker suite over the real ``docqa_tpu`` tree and
 assert it is exactly in sync with the committed baseline — zero new
 findings AND zero stale entries (the acceptance contract of
 ``scripts/lint.py``).
@@ -839,10 +839,13 @@ class TestTreeGate:
         assert sorted(all_checkers()) == [
             "deadline-flow",
             "donation",
+            "dtype-flow",
+            "host-sync",
             "jit-purity",
             "lock-discipline",
             "mesh-axes",
             "phi-taint",
+            "retrace-hazard",
             "spec-shape",
         ]
 

@@ -198,6 +198,59 @@ class TestExtract:
     def test_docx_rejects_garbage(self):
         assert extract_docx(b"not a zip") is None
 
+    def test_text_pdf_with_logo_not_mislabeled_scanned(self):
+        """Diagnosis order regression: a TEXT pdf that merely carries a
+        letterhead image (DCTDecode logo) but fails extraction for
+        another reason (CID-font hex show-text our extractor cannot
+        decode) must NOT be classified pdf_scanned_image_only — the
+        operator's fix is the font/filter, not OCR."""
+        from docqa_tpu.service.extract import (
+            diagnose_unextractable,
+            extract_text_ex,
+        )
+
+        cid_text_with_logo = (
+            b"%PDF-1.4\n"
+            b"1 0 obj\n<< /Type /XObject /Subtype /Image "
+            b"/Filter /DCTDecode >>\nstream\n\xff\xd8\xff\xe0JFIF"
+            b"\nendstream\nendobj\n"
+            b"2 0 obj\n<< /Length 44 >>\nstream\n"
+            b"BT /F1 12 Tf <00470048004F004F0052> Tj ET"
+            b"\nendstream\nendobj\n%%EOF"
+        )
+        text, reason = extract_text_ex(cid_text_with_logo, "letter.pdf")
+        assert text is None
+        assert reason == "pdf_no_extractable_text"
+        assert (
+            diagnose_unextractable(cid_text_with_logo, "letter.pdf")
+            == "pdf_no_extractable_text"
+        )
+
+        # an unsupported-filter text stream alongside a logo gets the
+        # filter slug, again not the scanned one
+        lzw_with_logo = (
+            b"%PDF-1.4\n"
+            b"1 0 obj\n<< /Subtype /Image /Filter /DCTDecode >>\n"
+            b"stream\n\xff\xd8\xff\xe0JFIF\nendstream\nendobj\n"
+            b"2 0 obj\n<< /Filter /LZWDecode /ToUnicode 3 0 R >>\n"
+            b"stream\n\x80\x0b\x60\x50\nendstream\nendobj\n%%EOF"
+        )
+        assert (
+            diagnose_unextractable(lzw_with_logo, "letter.pdf")
+            == "pdf_unsupported_filter"
+        )
+
+        # a genuinely image-only pdf still reads as scanned
+        scanned = (
+            b"%PDF-1.4\n1 0 obj\n<< /Type /XObject /Subtype /Image "
+            b"/Filter /DCTDecode >>\nstream\n\xff\xd8\xff\xe0JFIF"
+            b"\nendstream\nendobj\n%%EOF"
+        )
+        assert (
+            diagnose_unextractable(scanned, "scan.pdf")
+            == "pdf_scanned_image_only"
+        )
+
 
 # ---- chunking --------------------------------------------------------------
 
