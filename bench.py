@@ -789,12 +789,36 @@ def main() -> None:
         return True
 
     # ---- load harnesses ------------------------------------------------------
+    def trace_stats(traces):
+        """Fold completed request traces (docqa_tpu/obs) into the
+        per-stage attribution record the load sections report: stage
+        table, device/host split, and span coverage of request wall time
+        (the ≥95% acceptance figure — an unattributed gap means a stage
+        nobody instrumented ate latency)."""
+        from docqa_tpu import obs
+
+        done = [t for t in traces if t is not None and t.finished]
+        if not done:
+            return None
+        rows = obs.attribution(done)
+        covs = [obs.coverage(t) for t in done]
+        return {
+            "n_traces": len(done),
+            "trace_coverage_mean": round(float(np.mean(covs)), 4),
+            "trace_coverage_min": round(float(min(covs)), 4),
+            "device_host_split": obs.device_host_split(done),
+            "stage_attribution": rows,
+        }
+
     def run_load(engine, n_slots, chunk, n_req, cache_len):
         """Closed-loop load: n_req concurrent requests, max_new tokens
-        each, through a ContinuousBatcher.  Returns (qps, wall_s, lat_ms)
-        where lat_ms are submit->done completion latencies."""
+        each, through a ContinuousBatcher.  Returns (qps, wall_s, lat_ms,
+        traces) where lat_ms are submit->done completion latencies and
+        traces are the per-request obs timelines (queue-wait / prefill /
+        decode-chunk / result-wait attribution)."""
         import threading as _threading
 
+        from docqa_tpu import obs
         from docqa_tpu.engines.serve import ContinuousBatcher
 
         b = ContinuousBatcher(
@@ -818,16 +842,20 @@ def main() -> None:
                 h.result()
             b.submit_ids(prompt_ids[0], max_new_tokens=max_new).result()
             lat_ms = [0.0] * n_req
+            traces = [None] * n_req
             waiters = []
             t0 = time.perf_counter()
 
-            def wait_one(idx, handle):
+            def wait_one(idx, handle, ctx):
                 handle.result()
                 lat_ms[idx] = (time.perf_counter() - t0) * 1e3
+                obs.finish(ctx)
+                traces[idx] = ctx.trace if ctx else None
 
             for i, p in enumerate(prompt_ids):
-                h = b.submit_ids(p, max_new_tokens=max_new)
-                w = _threading.Thread(target=wait_one, args=(i, h))
+                ctx = obs.new_trace("rag_load")
+                h = obs.call_in(ctx, b.submit_ids, p, max_new_tokens=max_new)
+                w = _threading.Thread(target=wait_one, args=(i, h, ctx))
                 w.start()
                 waiters.append(w)
             for w in waiters:
@@ -837,14 +865,14 @@ def main() -> None:
             b.stop()
             del b
             gc.collect()
-        return n_req / wall, wall, lat_ms
+        return n_req / wall, wall, lat_ms, traces
 
     def sweep_load(engine, n_req, cache_len, grid):
         """Closed-loop knob grid over (n_slots, chunk); the served config
         should be the measured winner, not a guess.  Stops early once the
         target is comfortably beaten (QPS >= 20)."""
         attempts = []
-        qps, wall, lat = run_load(engine, *grid[0], n_req, cache_len)
+        qps, wall, lat, traces = run_load(engine, *grid[0], n_req, cache_len)
         attempts.append(
             {"n_slots": grid[0][0], "chunk": grid[0][1], "qps": round(qps, 2)}
         )
@@ -854,7 +882,7 @@ def main() -> None:
                     attempts.append({"skipped_past": f"({ns},{ch})"})
                     break
                 try:
-                    q2, w2, l2 = run_load(engine, ns, ch, n_req, cache_len)
+                    q2, w2, l2, tr2 = run_load(engine, ns, ch, n_req, cache_len)
                 except Exception as e:
                     log(f"load sweep ({ns},{ch}) failed: {e!r}")
                     continue
@@ -862,9 +890,9 @@ def main() -> None:
                     {"n_slots": ns, "chunk": ch, "qps": round(q2, 2)}
                 )
                 if q2 > qps:
-                    qps, wall, lat = q2, w2, l2
+                    qps, wall, lat, traces = q2, w2, l2, tr2
         best = max((a for a in attempts if "qps" in a), key=lambda a: a["qps"])
-        return {
+        out = {
             "arrival": "closed-loop burst",
             "requests": n_req,
             "wall_s": round(wall, 2),
@@ -875,6 +903,16 @@ def main() -> None:
             "best_knobs": {"n_slots": best["n_slots"], "chunk": best["chunk"]},
             "attempts": attempts,
         }
+        stats = trace_stats(traces)
+        if stats is not None:
+            out.update(stats)
+            from docqa_tpu import obs
+
+            log(
+                "rag_load per-stage attribution (winner config):\n"
+                + obs.format_table(stats["stage_attribution"])
+            )
+        return out
 
     def run_open_loop(engine, n_slots, chunk, cache_len, qps_target, n_req):
         """OPEN-loop load (VERDICT r4 item 3): requests arrive on a fixed
@@ -885,6 +923,7 @@ def main() -> None:
         number BASELINE's metric names.  Queue depth is sampled at 20 Hz."""
         import threading as _threading
 
+        from docqa_tpu import obs
         from docqa_tpu.engines.serve import ContinuousBatcher
 
         rngp = np.random.default_rng(3)
@@ -915,6 +954,7 @@ def main() -> None:
             # DOWN exactly when the batcher was failing)
             lat_ms = [0.0] * n_req
             ok = [False] * n_req
+            req_traces = [None] * n_req
             qdepth: list = []
             done_evt = _threading.Event()
 
@@ -928,26 +968,33 @@ def main() -> None:
             waiters = []
             t0 = time.perf_counter()
 
-            def wait_one(idx, handle, sched):
+            def wait_one(idx, handle, sched, ctx):
                 try:
                     handle.result()
                 except Exception:
+                    obs.finish(ctx, status="error")
+                    req_traces[idx] = ctx.trace if ctx else None
                     return  # counted in errors; latency sample excluded
                 ok[idx] = True
                 lat_ms[idx] = (time.perf_counter() - sched) * 1e3
+                obs.finish(ctx)
+                req_traces[idx] = ctx.trace if ctx else None
 
             for i in range(n_req):
                 sched = t0 + i / qps_target
                 now = time.perf_counter()
                 if sched > now:
                     time.sleep(sched - now)
+                ctx = obs.new_trace("rag_open_loop")
                 try:
-                    h = b.submit_ids(
-                        prompts[n_slots + i], max_new_tokens=max_new
+                    h = obs.call_in(
+                        ctx, b.submit_ids, prompts[n_slots + i],
+                        max_new_tokens=max_new,
                     )
                 except Exception:
+                    obs.finish(ctx, status="error")
                     continue  # shed at admission: an error, not a latency
-                w = _threading.Thread(target=wait_one, args=(i, h, sched))
+                w = _threading.Thread(target=wait_one, args=(i, h, sched, ctx))
                 w.start()
                 waiters.append(w)
             for w in waiters:
@@ -961,11 +1008,20 @@ def main() -> None:
             gc.collect()
         good = [l for l, k in zip(lat_ms, ok) if k]
         errors = n_req - len(good)
+        stats = trace_stats(req_traces)
+        if stats is not None:
+            from docqa_tpu import obs as _obs
+
+            log(
+                f"open@{qps_target} per-stage attribution:\n"
+                + _obs.format_table(stats["stage_attribution"])
+            )
         return {
             "arrival": f"open@{qps_target}",
             "requests": n_req,
             "requests_ok": len(good),
             "errors": errors,
+            **(stats or {}),
             "wall_s": round(wall, 2),
             "achieved_qps": round(len(good) / wall, 2),
             "request_p50_ms": (
@@ -1181,7 +1237,7 @@ def main() -> None:
                     params=gen1.params,
                 )
                 try:
-                    qs, ws, ls = run_load(
+                    qs, ws, ls, _tr = run_load(
                         gen_spec, bk["n_slots"], bk["chunk"], n_req, cache_len
                     )
                 finally:
@@ -1225,8 +1281,60 @@ def main() -> None:
                     del open_engine
                     gc.collect()
 
+    def sec_trace_overhead():
+        """Tracing-overhead A/B on the qa_e2e path (acceptance: ≤2% on
+        p50).  Same engine, same queries, recorder OFF then ON with a
+        full per-request trace — the difference is what docqa-trace
+        costs a served request."""
+        from docqa_tpu import obs
+
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        ask = make_ask(S["gen1"])
+        for q in q_texts[:2]:  # compile at the measured shapes
+            ask(q)
+        n_ab = max(n_e2e, 8)
+        queries = [q_texts[2 + i % n_queries] for i in range(n_ab)]
+
+        def run_p50(traced: bool) -> float:
+            lats = []
+            for q in queries:
+                t0 = time.perf_counter()
+                if traced:
+                    ctx = obs.new_trace("overhead_ask")
+                    obs.call_in(ctx, ask, q)
+                    obs.finish(ctx)
+                else:
+                    ask(q)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            return float(np.percentile(lats, 50))
+
+        was_enabled = obs.enabled()
+        try:
+            obs.set_enabled(False)
+            p50_off = run_p50(False)
+            obs.set_enabled(True)
+            p50_on = run_p50(True)
+        finally:
+            obs.set_enabled(was_enabled)
+        overhead = (p50_on - p50_off) / p50_off * 100.0 if p50_off else 0.0
+        DETAILS["tracing_overhead"] = {
+            "qa_e2e_p50_off_ms": round(p50_off, 2),
+            "qa_e2e_p50_on_ms": round(p50_on, 2),
+            "overhead_pct": round(overhead, 2),
+            "samples": n_ab,
+            "budget_pct": 2.0,
+        }
+        log(
+            f"tracing overhead: p50 {p50_off:.1f}ms untraced -> "
+            f"{p50_on:.1f}ms traced ({overhead:+.2f}%, budget 2%)"
+        )
+
     run_section("e2e_1b", sec_1b, 240)
     run_section("load_1b", sec_load_1b, 200)
+    run_section("trace_overhead", sec_trace_overhead, 90)
 
     # ---- config 4: summarizer, 5 retrieved chunks ---------------------------
     docs = [

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
+from docqa_tpu import obs
 from docqa_tpu.resilience.deadline import Deadline
 from docqa_tpu.runtime.metrics import get_logger
 
@@ -82,7 +83,9 @@ def dispatch_with_donation_retry(
                 raise
             # visible, not silent: a donation race per dispatch is
             # expected noise, a STREAK of them is an ingest/serve
-            # contention signal an operator should see
+            # contention signal an operator should see — and the
+            # request's timeline shows the retry it paid for
+            obs.event("donation_race", attempt=unlocked_try + 1)
             log.warning(
                 "donation race on unlocked dispatch attempt %d/2; "
                 "re-snapshotting (%r)", unlocked_try + 1, e,
@@ -90,6 +93,9 @@ def dispatch_with_donation_retry(
     with lock:
         if deadline is not None:
             deadline.check("dispatch")
+        # reaching the locked fallback is itself diagnostic: two fresh
+        # donation races in one request
+        obs.event("dispatch_locked_fallback")
         fn, args = snapshot_and_build()
         if fn is None:
             return None

@@ -45,12 +45,14 @@ import itertools
 import threading
 from dataclasses import dataclass, field
 from time import monotonic as time_monotonic
+from time import perf_counter as _now
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from docqa_tpu import obs
 from docqa_tpu.models.decoder import (
     decoder_forward,
     init_decoder_params,  # noqa: F401  (re-export convenience for tests)
@@ -77,6 +79,33 @@ class _Request:
     # the worker sheds this request — from the queue or from a live slot —
     # the moment the budget is gone, instead of decoding for nobody
     deadline: Optional[Deadline] = None
+    # request trace (docqa_tpu/obs): the worker thread serves MANY
+    # requests, so spans are recorded on each request's own Trace with
+    # explicit times — never through the context var (which belongs to
+    # the submitting thread).  None = untraced, every hook no-ops.
+    trace: Optional[obs.Trace] = None
+    span_parent: Optional[str] = None
+    t_submit: float = 0.0
+
+
+def _req_span(req: _Request, name: str, t0: float, t1: float, **attrs) -> None:
+    """Attribute a measured interval to the request's trace (no-op when
+    untraced).  The one worker-side recording path — spans parent under
+    the span that was current at submit time, so a question's whole
+    submit→admit→prefill→decode→result-wait is ONE linked timeline."""
+    if req.trace is not None:
+        req.trace.record_span(
+            name, t0, t1, parent_id=req.span_parent, **attrs
+        )
+
+
+def _req_mark(req: _Request, reason: str, anomalous: bool = True, **attrs):
+    """Record an instant event on the request's trace; ``anomalous=True``
+    also flags it for the flight recorder's always-keep ring."""
+    if req.trace is not None:
+        if anomalous:
+            req.trace.flag(reason)
+        req.trace.add_event(reason, span_id=req.span_parent, **attrs)
 
 
 # One wait policy for every consumer of a Handle (qa /ask, summarize,
@@ -117,19 +146,30 @@ class Handle:
     ) -> List[int]:
         # a request-scoped deadline bounds the wait below any caller
         # timeout: waiting past it can only ever produce a late answer
-        dl = self._req.deadline
-        if dl is not None:
-            timeout = dl.bound(timeout)
-        if not self._req.done.wait(timeout):
-            if dl is not None and dl.expired:
-                # the deadline was the binding constraint: report the
-                # budget shed, not a generic slow-decode timeout (the
-                # worker's own shed may still be a chunk round away)
-                raise DeadlineExceeded("serve_result", -dl.remaining())
-            raise ResultTimeout(timeout)
-        if self._req.error is not None:
-            raise self._req.error
-        return list(self._req.tokens)
+        t0 = _now()
+        try:
+            dl = self._req.deadline
+            if dl is not None:
+                timeout = dl.bound(timeout)
+            if not self._req.done.wait(timeout):
+                if dl is not None and dl.expired:
+                    # the deadline was the binding constraint: report the
+                    # budget shed, not a generic slow-decode timeout (the
+                    # worker's own shed may still be a chunk round away)
+                    _req_mark(
+                        self._req, "deadline_exceeded", stage="serve_result"
+                    )
+                    raise DeadlineExceeded("serve_result", -dl.remaining())
+                _req_mark(self._req, "result_timeout")
+                raise ResultTimeout(timeout)
+            if self._req.error is not None:
+                raise self._req.error
+            return list(self._req.tokens)
+        finally:
+            # the waiter-side span: overlaps the decode-chunk spans the
+            # worker records, so the union (coverage) stays gapless from
+            # submission to delivery
+            _req_span(self._req, "serve_result_wait", t0, _now())
 
     def text(
         self, tokenizer, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT
@@ -149,34 +189,42 @@ class Handle:
 
         def _timed_out():
             if req.deadline is not None and req.deadline.expired:
+                _req_mark(req, "deadline_exceeded", stage="serve_result")
                 raise DeadlineExceeded(
                     "serve_result", -req.deadline.remaining()
                 )
+            _req_mark(req, "result_timeout")
             raise ResultTimeout(timeout)
 
         deadline = (
             None if timeout is None else time_monotonic() + timeout
         )
-        while True:
-            with req.cv:
-                while len(req.tokens) <= sent and not req.done.is_set():
-                    remaining = (
-                        None
-                        if deadline is None
-                        else deadline - time_monotonic()
-                    )
-                    if remaining is not None and remaining <= 0:
-                        _timed_out()
-                    if not req.cv.wait(remaining):
-                        _timed_out()
-                fresh = list(req.tokens[sent:])
-            sent += len(fresh)
-            for t in fresh:
-                yield t
-            if req.done.is_set() and sent >= len(req.tokens):
-                if req.error is not None:
-                    raise req.error
-                return
+        t0 = _now()
+        try:
+            while True:
+                with req.cv:
+                    while len(req.tokens) <= sent and not req.done.is_set():
+                        remaining = (
+                            None
+                            if deadline is None
+                            else deadline - time_monotonic()
+                        )
+                        if remaining is not None and remaining <= 0:
+                            _timed_out()
+                        if not req.cv.wait(remaining):
+                            _timed_out()
+                    fresh = list(req.tokens[sent:])
+                sent += len(fresh)
+                for t in fresh:
+                    yield t
+                if req.done.is_set() and sent >= len(req.tokens):
+                    if req.error is not None:
+                        raise req.error
+                    return
+        finally:
+            # runs on exhaust, error, AND generator close (client
+            # disconnect) — the streaming analogue of result()'s span
+            _req_span(req, "serve_result_wait", t0, _now(), streaming=True)
 
 
 class QueueFull(RuntimeError):
@@ -586,6 +634,13 @@ class ContinuousBatcher:
             DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
             deadline.check("serve_submit")
         req = _Request(list(prompt_ids), max_new, deadline=deadline)
+        ctx = obs.current()
+        if ctx is not None:
+            # capture the SUBMITTER's trace position; the worker thread
+            # records every later stage on it explicitly
+            req.trace = ctx.trace
+            req.span_parent = ctx.span_id
+        req.t_submit = _now()
         with self._cv:
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
@@ -594,13 +649,21 @@ class ContinuousBatcher:
                 and len(self._queue) >= self.max_queue
             ):
                 DEFAULT_REGISTRY.counter("serve_shed").inc()
+                _req_mark(
+                    req, "queue_full", n_queued=len(self._queue)
+                )
                 raise QueueFull(
                     f"generation queue at capacity ({self.max_queue})",
                     n_queued=len(self._queue),
                     n_active=sum(1 for r in self._slot_req if r is not None),
                 )
             self._queue.append(req)
+            n_queued = len(self._queue)
             self._cv.notify_all()
+        _req_mark(
+            req, "serve_submit", anomalous=False,
+            n_queued=n_queued, prompt_len=len(req.prompt_ids),
+        )
         DEFAULT_REGISTRY.counter("serve_submitted").inc()
         return Handle(req)
 
@@ -720,6 +783,7 @@ class ContinuousBatcher:
                     "serve_admit", -req.deadline.remaining()
                 )
                 DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+                _req_mark(req, "deadline_exceeded", stage="serve_admit")
                 _finish(req)
                 continue
             try:
@@ -751,6 +815,7 @@ class ContinuousBatcher:
             slots_arr[i] = slot
             good[i] = (slot, _req, ids)
         fn = self._get_prefill_fn()
+        t_prefill0 = _now()
         with span("serve_prefill", DEFAULT_REGISTRY):
             if self.spec_k:
                 self._cache, self._table, toks = fn(
@@ -771,6 +836,13 @@ class ContinuousBatcher:
                     jnp.asarray(slots_arr),
                     self._next_rng(),
                 )
+        t_prefill1 = _now()
+        for slot, req, ids in good:
+            _req_span(
+                req, "serve_prefill", t_prefill0, t_prefill1,
+                batch=len(good), bucket=bucket, slot=slot,
+                prompt_tokens=len(ids),
+            )
         # Slot state updates ride the device (the sampled first tokens are
         # already there) — alive = (first != eos) & (budget >= 2) needs no
         # host fetch, so the decode chunk that follows this admission can
@@ -826,6 +898,7 @@ class ContinuousBatcher:
                 self._retire(slot)
             else:
                 req.tokens.append(first)
+                _req_mark(req, "first_token", anomalous=False, slot=slot)
                 with req.cv:  # the first streamed token
                     req.cv.notify_all()
                 if len(req.tokens) >= budget:
@@ -838,6 +911,7 @@ class ContinuousBatcher:
             req = self._slot_req[slot]
             if req is not None:
                 req.error = RuntimeError(f"decode failed: {err!r}")
+                _req_mark(req, "decode_failed", slot=slot)
                 _finish(req)
                 self._slot_req[slot] = None
         self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
@@ -872,6 +946,7 @@ class ContinuousBatcher:
         discarded chunk — wasted compute, never misdelivered tokens).
         Returns False when the fetch failed: the device state chained from
         this chunk is poisoned and ``_fail_active`` has reset it."""
+        t_fetch0 = _now()
         try:
             # the span blocks until the chunk's device execution completes,
             # so serve_decode_chunk_ms keeps measuring real chunk rounds
@@ -887,6 +962,7 @@ class ContinuousBatcher:
             log.exception("decode chunk failed; resetting slot state")
             self._fail_active(e)
             return False
+        t_fetch1 = _now()
         if self.spec_k:
             width = self.chunk + 2 * self.spec_k
             out_h = packed_h[:, :width]
@@ -914,6 +990,14 @@ class ContinuousBatcher:
                     break
                 req.tokens.append(int(out_h[slot, t]))
                 n_appended += 1
+            # the fetch-block interval IS this slot's share of device
+            # time for the round (one-fetch-per-dispatch boundary) —
+            # recorded per request so a timeline shows every chunk the
+            # request decoded through
+            _req_span(
+                req, "serve_decode_chunk", t_fetch0, t_fetch1,
+                slot=slot, tokens=len(req.tokens) - before,
+            )
             if len(req.tokens) > before:  # wake streamers per chunk
                 with req.cv:
                     req.cv.notify_all()
@@ -937,6 +1021,7 @@ class ContinuousBatcher:
                     "serve_decode", -req.deadline.remaining()
                 )
                 DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+                _req_mark(req, "deadline_exceeded", stage="serve_decode")
             if finished or expired:
                 deactivate.append(slot)
                 self._retire(slot)
@@ -969,11 +1054,17 @@ class ContinuousBatcher:
             while self._queue and not filled:
                 req = self._queue.popleft()
                 drained = True
+                # queue-wait is over either way (admitted or shed) —
+                # the stage BENCH_r05 could not see
+                _req_span(req, "serve_queue_wait", req.t_submit, _now())
                 if req.deadline is not None and req.deadline.expired:
                     req.error = DeadlineExceeded(
                         "serve_queue", -req.deadline.remaining()
                     )
                     DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+                    _req_mark(
+                        req, "deadline_exceeded", stage="serve_queue"
+                    )
                     _finish(req)
                     continue
                 pairs.append((slot, req))

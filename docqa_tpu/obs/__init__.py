@@ -1,0 +1,59 @@
+"""docqa-trace: request-scoped tracing, flight recorder, and profiling.
+
+The observability subsystem (docs/OBSERVABILITY.md).  One import site
+for the rest of the framework:
+
+* identity + propagation: :func:`new_trace`, :func:`current`,
+  :func:`call_in`, :func:`headers_of`, :func:`from_headers`;
+* recording: :func:`start_span` (context-var style), explicit
+  ``Trace.record_span`` (worker threads), :func:`event`, :func:`flag`;
+* retention: :data:`DEFAULT_RECORDER` (ring + always-keep anomalous),
+  :func:`finish` / :func:`finish_id`;
+* export: :func:`timeline_dict`, :func:`to_chrome_trace`,
+  :func:`coverage`;
+* analysis: :func:`attribution`, :func:`format_table`,
+  :data:`DEFAULT_PROFILER` (on-demand ``jax.profiler`` window).
+
+Depends only on the stdlib (jax is imported lazily inside the profiler
+window), so ``runtime/metrics.py`` can import it without cycles.
+"""
+
+from docqa_tpu.obs.context import (  # noqa: F401
+    SPAN_HEADER,
+    TRACE_HEADER,
+    TraceContext,
+    call_in,
+    current,
+    current_trace_id,
+    event,
+    flag,
+    headers_of,
+    next_trace_id,
+    reset_ids,
+)
+from docqa_tpu.obs.export import (  # noqa: F401
+    coverage,
+    timeline_dict,
+    to_chrome_trace,
+)
+from docqa_tpu.obs.profiler import (  # noqa: F401
+    DEFAULT_PROFILER,
+    DEVICE_STAGES,
+    ProfilerWindow,
+    attribution,
+    device_host_split,
+    format_table,
+    stage_kind,
+)
+from docqa_tpu.obs.recorder import (  # noqa: F401
+    DEFAULT_RECORDER,
+    FlightRecorder,
+    enabled,
+    ensure,
+    finish,
+    finish_id,
+    from_headers,
+    new_trace,
+    set_enabled,
+)
+from docqa_tpu.obs.spans import Span, Trace, start_span  # noqa: F401

@@ -62,9 +62,23 @@ class Deadline:
         return monotonic() >= self.expires_at
 
     def check(self, stage: str = "") -> None:
-        """Raise :class:`DeadlineExceeded` if the budget is gone."""
+        """Raise :class:`DeadlineExceeded` if the budget is gone.
+
+        A shed is marked on the active trace (docqa_tpu/obs) before the
+        raise — the flight recorder always keeps deadline-shed requests,
+        and the event names the stage that ran out, so "which stage eats
+        the budget" is answerable from one timeline.  Lazy import: the
+        shed path is rare and this module must stay import-light."""
         overrun = monotonic() - self.expires_at
         if overrun >= 0:
+            from docqa_tpu import obs
+
+            obs.flag("deadline_exceeded")
+            obs.event(
+                "deadline_exceeded",
+                stage=stage,
+                overrun_ms=round(overrun * 1000.0, 1),
+            )
             raise DeadlineExceeded(stage, overrun)
 
     def bound(self, timeout: Optional[float]) -> float:

@@ -19,11 +19,33 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
+# stdlib-only subsystem (jax lazy inside its profiler) — no import cycle
+from docqa_tpu.obs.context import current_trace_id
+from docqa_tpu.obs.spans import percentile_nearest_rank
+from docqa_tpu.obs.spans import start_span as _trace_span
+
+
+class TraceLogFilter(logging.Filter):
+    """Prefix ``trace_id=<id>`` when a TraceContext is active, so every
+    structured log line correlates with its request timeline for free
+    (``docs/OBSERVABILITY.md``).  Inactive contexts pass records through
+    untouched — one context-var read per log call."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tid = current_trace_id()
+        if tid is not None:
+            # resolve %-args NOW so the prefix composes with any format
+            # string; the message is about to be emitted anyway
+            record.msg = f"trace_id={tid} {record.getMessage()}"
+            record.args = None
+        return True
+
 
 def get_logger(name: str) -> logging.Logger:
     """Structured logger (the reference used print + emoji in 4 of 5 services,
     e.g. ``llm-qa/main.py:23``; real logging only in deid,
-    ``anonymizer.py:13-17``)."""
+    ``anonymizer.py:13-17``).  Every logger carries :class:`TraceLogFilter`
+    so log lines name the active trace."""
     logger = logging.getLogger(name)
     if not logging.getLogger().handlers and not logger.handlers:
         handler = logging.StreamHandler()
@@ -34,6 +56,8 @@ def get_logger(name: str) -> logging.Logger:
         )
         logger.addHandler(handler)
         logger.setLevel(logging.INFO)
+    if not any(isinstance(f, TraceLogFilter) for f in logger.filters):
+        logger.addFilter(TraceLogFilter())
     return logger
 
 
@@ -78,6 +102,8 @@ class Histogram:
     bench-scale sample counts, bounded memory for long-running services.
     """
 
+    MAX_EXEMPLARS = 8
+
     def __init__(self, name: str, max_samples: int = 65536):
         self.name = name
         self._samples: List[float] = []
@@ -85,9 +111,10 @@ class Histogram:
         self._count = 0
         self._sum = 0.0
         self._max_samples = max_samples
+        self._exemplars: List[tuple] = []  # (value, trace_id), largest kept
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         with self._lock:
             self._count += 1
             self._sum += value
@@ -95,15 +122,28 @@ class Histogram:
             if len(self._samples) > self._max_samples:
                 # drop an extreme alternately to stay bounded but unbiased-ish
                 self._samples.pop(0 if self._count % 2 else -1)
+            if trace_id is not None:
+                # exemplars: the LARGEST traced samples keep their trace id,
+                # so the p95 on /api/status links to a real flight-recorder
+                # timeline (docs/OBSERVABILITY.md) instead of a bare number
+                if len(self._exemplars) < self.MAX_EXEMPLARS:
+                    self._exemplars.append((value, trace_id))
+                else:
+                    lo = min(
+                        range(len(self._exemplars)),
+                        key=lambda i: self._exemplars[i][0],
+                    )
+                    if value >= self._exemplars[lo][0]:
+                        self._exemplars[lo] = (value, trace_id)
 
     def percentile(self, q: float) -> float:
         with self._lock:
             if not self._samples:
                 return float("nan")
-            idx = min(
-                len(self._samples) - 1, max(0, round(q / 100 * (len(self._samples) - 1)))
-            )
-            return self._samples[idx]
+            # shared nearest-rank definition (obs/spans.py) — histograms,
+            # the flight recorder's slow flag, and the attribution table
+            # must agree on what a percentile means
+            return percentile_nearest_rank(self._samples, q)
 
     @property
     def count(self) -> int:
@@ -115,14 +155,25 @@ class Histogram:
         with self._lock:
             return self._sum / self._count if self._count else float("nan")
 
-    def summary(self) -> Dict[str, float]:
-        return {
+    def exemplars(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return [
+                {"value": v, "trace_id": t}
+                for v, t in sorted(self._exemplars, reverse=True)
+            ]
+
+    def summary(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
             "count": self.count,
             "mean": self.mean,
             "p50": self.percentile(50),
             "p95": self.percentile(95),
             "p99": self.percentile(99),
         }
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = ex
+        return out
 
 
 @dataclass
@@ -172,7 +223,13 @@ def span(
     profile: bool = False,
 ) -> Iterator[None]:
     """Wall-clock span recorded as ``<name>_ms`` histogram; optionally wraps a
-    ``jax.profiler.TraceAnnotation`` so the stage shows up in TPU traces."""
+    ``jax.profiler.TraceAnnotation`` so the stage shows up in TPU traces.
+
+    When a TraceContext is active (docqa_tpu/obs), the same interval is
+    ALSO recorded as a trace span and the histogram sample carries the
+    trace id as an exemplar — one call site, both observables.  Untraced
+    callers (the batcher worker, background jobs) pay one context-var
+    read."""
     registry = registry or DEFAULT_REGISTRY
     start = time.perf_counter()
     if profile:
@@ -181,10 +238,11 @@ def span(
         ctx: contextlib.AbstractContextManager = jax.profiler.TraceAnnotation(name)
     else:
         ctx = contextlib.nullcontext()
-    with ctx:
+    with ctx, _trace_span(name):
         try:
             yield
         finally:
             registry.histogram(f"{name}_ms").observe(
-                (time.perf_counter() - start) * 1000.0
+                (time.perf_counter() - start) * 1000.0,
+                trace_id=current_trace_id(),
             )

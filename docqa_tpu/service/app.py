@@ -31,6 +31,7 @@ import os
 import time
 from typing import Optional
 
+from docqa_tpu import obs
 from docqa_tpu.config import Config, load_config
 from docqa_tpu.engines.serve import QueueFull
 from docqa_tpu.resilience import BreakerBoard, FaultPlan
@@ -630,8 +631,19 @@ def make_app(rt: DocQARuntime):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(host_pool, lambda: fn(*args, **kw))
 
-    def json_error(status: int, detail: str):
-        return web.json_response({"detail": detail}, status=status)
+    def json_error(status: int, detail: str, ctx=None):
+        resp = web.json_response({"detail": detail}, status=status)
+        if ctx is not None:
+            resp.headers["X-Trace-Id"] = ctx.trace_id
+        return resp
+
+    def with_trace(resp, ctx):
+        """Stamp the request's trace id on the response — the body
+        contract stays exactly the reference's (``{"answer","sources"}``
+        for /ask); the timeline link rides a header."""
+        if ctx is not None:
+            resp.headers["X-Trace-Id"] = ctx.trace_id
+        return resp
 
     # ---- health / status ----------------------------------------------------
 
@@ -662,6 +674,59 @@ def make_app(rt: DocQARuntime):
 
     async def metrics(_req):
         return web.json_response(DEFAULT_REGISTRY.snapshot())
+
+    # ---- observability (docs/OBSERVABILITY.md) ------------------------------
+
+    async def api_traces(req):
+        """Flight-recorder listing: recent completed timelines, or only
+        the anomalous ring (?anomalous=1).  Summaries only — fetch one
+        timeline via /api/trace/<id>."""
+        anomalous = req.query.get("anomalous") in ("1", "true")
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            return json_error(422, "limit must be an integer")
+        return web.json_response(
+            obs.DEFAULT_RECORDER.summaries(n=limit, anomalous=anomalous)
+        )
+
+    async def api_trace_one(req):
+        """One request's full timeline — JSON by default, Chrome-trace
+        (Perfetto-loadable) with ?format=chrome."""
+        trace = obs.DEFAULT_RECORDER.get(req.match_info["trace_id"])
+        if trace is None:
+            return json_error(404, "trace not found (evicted or unknown)")
+        if req.query.get("format") == "chrome":
+            return web.json_response(obs.to_chrome_trace([trace]))
+        return web.json_response(obs.timeline_dict(trace))
+
+    async def profiler_start(req):
+        """Open an on-demand ``jax.profiler`` window (jit-exterior by
+        construction: this runs on the HTTP surface, never inside a
+        compiled program — the jit-purity lint rule enforces the
+        general invariant)."""
+        logdir = None
+        if req.can_read_body:
+            try:
+                logdir = (await req.json()).get("logdir")
+            except Exception:
+                pass
+        try:
+            logdir = await on_host(obs.DEFAULT_PROFILER.start, logdir)
+        except RuntimeError as e:  # already active
+            return json_error(409, str(e))
+        except Exception as e:  # backend without profiler support
+            return json_error(500, f"profiler start failed: {e!r}")
+        return web.json_response({"profiling": True, "logdir": logdir})
+
+    async def profiler_stop(_req):
+        try:
+            logdir = await on_host(obs.DEFAULT_PROFILER.stop)
+        except RuntimeError as e:  # no window open
+            return json_error(409, str(e))
+        except Exception as e:
+            return json_error(500, f"profiler stop failed: {e!r}")
+        return web.json_response({"profiling": False, "logdir": logdir})
 
     # ---- ingestion ----------------------------------------------------------
 
@@ -694,21 +759,38 @@ def make_app(rt: DocQARuntime):
             doc_date = body.get("doc_date")
         if not data:
             return json_error(400, "no file/text provided")
-        record = await on_host(
-            rt.pipeline.ingest_document,
-            filename,
-            data,
-            doc_type,
-            patient_id,
-            doc_date,
-        )
+        # the DOCUMENT trace: opened here, finished by the pipeline at
+        # the doc's terminal status (INDEXED / ERROR_* / dead-letter) —
+        # the response may return while deid/index hops are still
+        # appending to the same timeline
+        ctx = obs.new_trace("ingest")
+        try:
+            record = await on_host(
+                obs.call_in,
+                ctx,
+                rt.pipeline.ingest_document,
+                filename,
+                data,
+                doc_type,
+                patient_id,
+                doc_date,
+            )
+        except Exception:
+            # an exception ESCAPING the pipeline (before its own terminal
+            # paths) would otherwise leak the trace open until the
+            # recorder's abandoned-eviction mislabels it
+            obs.finish(ctx, status="error")
+            raise
         if wait:
             await asyncio.get_running_loop().run_in_executor(
                 None, rt.pipeline.wait_indexed, record.doc_id
             )
             record = rt.registry.get(record.doc_id)
-        return web.json_response(
-            {"doc_id": record.doc_id, "status": record.status}
+        return with_trace(
+            web.json_response(
+                {"doc_id": record.doc_id, "status": record.status}
+            ),
+            ctx,
         )
 
     async def documents(_req):
@@ -736,7 +818,7 @@ def make_app(rt: DocQARuntime):
 
     # ---- QA -----------------------------------------------------------------
 
-    async def _ask_preamble(req):
+    async def _ask_preamble(req, ctx):
         """Shared /ask admission: parse → 422, empty index → 503, submit
         on the device lane → QueueFull 503, budget gone → 504.  Returns
         (pending, None) or (None, error-response) so both the blocking and
@@ -745,51 +827,63 @@ def make_app(rt: DocQARuntime):
         The request's end-to-end :class:`Deadline` is stamped HERE — the
         one admission point — and threaded through retrieval, dispatch and
         the batcher (docs/RESILIENCE.md); every later stage sheds instead
-        of queueing past it."""
+        of queueing past it.  ``ctx`` is the request's trace: retrieval
+        and batcher submission run UNDER it (``obs.call_in``), so the
+        whole submit→admit→prefill→decode→result-wait is one timeline."""
         try:
             q = Query(**await req.json())
         except Exception as e:
-            return None, json_error(422, str(e))
+            return None, json_error(422, str(e), ctx)
         if rt.store.count == 0:
             # parity: llm-qa returns 503 when its index is unavailable
             # (main.py:113-114) — ours can only be *empty*, never missing
             return None, json_error(
-                503, "index is empty; ingest documents first"
+                503, "index is empty; ingest documents first", ctx
             )
         budget = rt.cfg.resilience.request_deadline_s
         deadline = Deadline.after(budget) if budget > 0 else None
         try:
             pending = await on_device(
-                rt.qa.ask_submit, q.question, deadline=deadline
+                obs.call_in, ctx, rt.qa.ask_submit, q.question,
+                deadline=deadline,
             )
         except QueueFull as e:
-            return None, json_error(503, str(e))
+            return None, json_error(503, str(e), ctx)
         except DeadlineExceeded as e:
             # shed before any answer material existed (admission or
             # retrieval) — 504 distinguishes "out of time" from the
             # QueueFull 503 "out of capacity"
             DEFAULT_REGISTRY.counter("qa_deadline_shed").inc()
-            return None, json_error(504, str(e))
+            return None, json_error(504, str(e), ctx)
         return pending, None
 
     async def ask(req):
         # retrieval + submission on the device lane; decode wait on the gen
         # lane so N concurrent /ask share batcher slots (≈ solo latency)
         t0 = time.perf_counter()
-        pending, err = await _ask_preamble(req)
-        if err is not None:
-            return err
+        ctx = obs.new_trace("ask")
         try:
-            result = await on_gen(pending.resolve)
-        except DeadlineExceeded as e:
-            # resolve() degrades whenever it has chunks to degrade to, so
-            # reaching here means even the fallback was impossible
-            DEFAULT_REGISTRY.counter("qa_deadline_shed").inc()
-            return json_error(504, str(e))
-        DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
-            (time.perf_counter() - t0) * 1000
-        )
-        return web.json_response(result)
+            pending, err = await _ask_preamble(req, ctx)
+            if err is not None:
+                obs.finish(ctx, status="error")
+                return err
+            try:
+                result = await on_gen(obs.call_in, ctx, pending.resolve)
+            except DeadlineExceeded as e:
+                # resolve() degrades whenever it has chunks to degrade to,
+                # so reaching here means even the fallback was impossible
+                DEFAULT_REGISTRY.counter("qa_deadline_shed").inc()
+                obs.finish(ctx, status="error")
+                return json_error(504, str(e), ctx)
+            DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
+                (time.perf_counter() - t0) * 1000,
+                trace_id=ctx.trace_id if ctx else None,
+            )
+            obs.finish(ctx)
+            return with_trace(web.json_response(result), ctx)
+        except Exception:
+            obs.finish(ctx, status="error")
+            raise
 
     async def ask_stream(req):
         """Server-sent-events variant of /ask/: token deltas as they
@@ -799,8 +893,10 @@ def make_app(rt: DocQARuntime):
         import threading as _threading
 
         t0 = time.perf_counter()
-        pending, err = await _ask_preamble(req)
+        ctx = obs.new_trace("ask_stream")
+        pending, err = await _ask_preamble(req, ctx)
         if err is not None:
+            obs.finish(ctx, status="error")
             return err
         resp = web.StreamResponse(
             headers={
@@ -808,12 +904,16 @@ def make_app(rt: DocQARuntime):
                 "Cache-Control": "no-cache",
             }
         )
+        with_trace(resp, ctx)
         await resp.prepare(req)
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
         gone = _threading.Event()  # client disconnected: stop pumping
 
         def pump():
+            # no ctx activation here: iter_text records its spans on the
+            # request's own trace via the batcher Handle (worker-side),
+            # and a generator body would outlive any activation scope
             try:
                 for delta in pending.iter_text():
                     if gone.is_set():
@@ -855,8 +955,10 @@ def make_app(rt: DocQARuntime):
             gone.set()
             del fut
             DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
-                (time.perf_counter() - t0) * 1000
+                (time.perf_counter() - t0) * 1000,
+                trace_id=ctx.trace_id if ctx else None,
             )
+            obs.finish(ctx)
         await resp.write_eof()
         return resp
 
@@ -882,20 +984,31 @@ def make_app(rt: DocQARuntime):
         except Exception as e:
             return json_error(422, str(e))
         t0 = time.perf_counter()
+        ctx = obs.new_trace("summarize")
         try:
             pending = await on_device(
-                rt.summarizer.submit_prompt, body.prompt, body.max_tokens
+                obs.call_in, ctx, rt.summarizer.submit_prompt,
+                body.prompt, body.max_tokens,
             )
         except QueueFull as e:
-            return json_error(503, str(e))
-        summary = await on_gen(rt.summarizer.resolve, pending)
+            obs.finish(ctx, status="error")
+            return json_error(503, str(e), ctx)
+        try:
+            summary = await on_gen(
+                obs.call_in, ctx, rt.summarizer.resolve, pending
+            )
+        except Exception:
+            obs.finish(ctx, status="error")
+            raise
         if rt.batcher is not None:
             # the batcher path skips the engine's span("summarize"); record
             # the e2e latency here so /metrics keeps the serving histogram
             DEFAULT_REGISTRY.histogram("summarize_ms").observe(
-                (time.perf_counter() - t0) * 1000
+                (time.perf_counter() - t0) * 1000,
+                trace_id=ctx.trace_id if ctx else None,
             )
-        return web.json_response({"summary": summary})
+        obs.finish(ctx)
+        return with_trace(web.json_response({"summary": summary}), ctx)
 
     # ---- synthesis ----------------------------------------------------------
 
@@ -905,8 +1018,11 @@ def make_app(rt: DocQARuntime):
         except Exception as e:
             return json_error(422, str(e))
         # retrieval/packing on the device lane; decode wait on the gen lane
+        ctx = obs.new_trace("synthese_patient")
         try:
             finish = await on_device(
+                obs.call_in,
+                ctx,
                 rt.synthesis.patient_summary_submit,
                 body.patient_id,
                 body.from_date,
@@ -914,29 +1030,50 @@ def make_app(rt: DocQARuntime):
                 body.focus,
             )
         except SynthesisError as e:
-            return json_error(e.status, e.detail)
+            obs.finish(ctx, status="error")
+            return json_error(e.status, e.detail, ctx)
         except QueueFull as e:
-            return json_error(503, str(e))
-        resp = await on_gen(finish)
-        return web.json_response(json.loads(resp.model_dump_json()))
+            obs.finish(ctx, status="error")
+            return json_error(503, str(e), ctx)
+        try:
+            resp = await on_gen(obs.call_in, ctx, finish)
+        except Exception:
+            obs.finish(ctx, status="error")
+            raise
+        obs.finish(ctx)
+        return with_trace(
+            web.json_response(json.loads(resp.model_dump_json())), ctx
+        )
 
     async def synthese_comparaison(req):
         try:
             body = PatientComparisonRequest(**await req.json())
         except Exception as e:
             return json_error(422, str(e))
+        ctx = obs.new_trace("synthese_comparaison")
         try:
             finish = await on_device(
+                obs.call_in,
+                ctx,
                 rt.synthesis.patient_comparison_submit,
                 body.patient_ids,
                 body.focus,
             )
         except SynthesisError as e:
-            return json_error(e.status, e.detail)
+            obs.finish(ctx, status="error")
+            return json_error(e.status, e.detail, ctx)
         except QueueFull as e:
-            return json_error(503, str(e))
-        resp = await on_gen(finish)
-        return web.json_response(json.loads(resp.model_dump_json()))
+            obs.finish(ctx, status="error")
+            return json_error(503, str(e), ctx)
+        try:
+            resp = await on_gen(obs.call_in, ctx, finish)
+        except Exception:
+            obs.finish(ctx, status="error")
+            raise
+        obs.finish(ctx)
+        return with_trace(
+            web.json_response(json.loads(resp.model_dump_json())), ctx
+        )
 
     async def index_page(_req):
         """The chat/upload UI (replaces the reference's Streamlit app,
@@ -952,6 +1089,10 @@ def make_app(rt: DocQARuntime):
             web.get("/health", health),
             web.get("/api/status", api_status),
             web.get("/metrics", metrics),
+            web.get("/api/traces", api_traces),
+            web.get("/api/trace/{trace_id}", api_trace_one),
+            web.post("/api/profiler/start", profiler_start),
+            web.post("/api/profiler/stop", profiler_stop),
             web.post("/ingest/", ingest),
             web.get("/documents/", documents),
             web.get("/documents/{doc_id}", document_one),

@@ -27,7 +27,7 @@ import json
 import os
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from docqa_tpu.config import BrokerConfig
@@ -39,17 +39,26 @@ log = get_logger("docqa.broker")
 
 @dataclass
 class Delivery:
-    """One in-flight message: ack or nack it via the broker."""
+    """One in-flight message: ack or nack it via the broker.
+
+    ``headers`` carry message metadata OUTSIDE the payload — trace
+    propagation (docqa_tpu/obs: ``x-trace-id``/``x-parent-span``) rides
+    here, and the broker preserves them through every redelivery hop
+    (nack→backoff requeue, journal replay, dead-lettering), so a
+    document's ingest→deid→index stays one linked timeline no matter how
+    many retries it took."""
 
     queue: str
     tag: int
     body: Dict[str, Any]
     attempts: int  # 1 on first delivery
+    headers: Dict[str, Any] = field(default_factory=dict)
 
 
 class _Queue:
     def __init__(self) -> None:
-        self.pending: collections.deque = collections.deque()  # (tag, body, attempts)
+        # pending entries: (tag, body, attempts, ready_at, headers)
+        self.pending: collections.deque = collections.deque()
         self.unacked: Dict[int, tuple] = {}
         self.dead: List[Dict[str, Any]] = []
 
@@ -91,14 +100,16 @@ class MemoryBroker:
         os.fsync(f.fileno())
 
     def _replay(self) -> None:
-        """Rebuild queue state: published minus acked/dead, then compact."""
+        """Rebuild queue state: published minus acked/dead, then compact.
+        Message headers (trace ids) replay with their bodies — a crash
+        must not unlink a document's timeline."""
         assert self._journal_dir is not None
         for name in os.listdir(self._journal_dir):
             if not name.endswith(".jsonl"):
                 continue
             queue = name[: -len(".jsonl")]
-            alive: Dict[int, Dict[str, Any]] = {}
-            dead: List[tuple] = []  # (tag, body) — tags kept so compaction can re-journal them
+            alive: Dict[int, tuple] = {}  # tag -> (body, headers)
+            dead: List[tuple] = []  # (tag, body, headers) — tags kept so compaction can re-journal them
             with open(os.path.join(self._journal_dir, name), encoding="utf-8") as f:
                 for line in f:
                     line = line.strip()
@@ -106,29 +117,37 @@ class MemoryBroker:
                         continue
                     rec = json.loads(line)
                     if rec["op"] == "pub":
-                        alive[rec["tag"]] = rec["body"]
+                        alive[rec["tag"]] = (
+                            rec["body"], rec.get("headers") or {}
+                        )
                     elif rec["op"] == "ack":
                         alive.pop(rec["tag"], None)
                     elif rec["op"] == "dlq":
-                        body = alive.pop(rec["tag"], None)
-                        if body is not None:
-                            dead.append((rec["tag"], body))
+                        entry = alive.pop(rec["tag"], None)
+                        if entry is not None:
+                            dead.append((rec["tag"], entry[0], entry[1]))
             q = self._queues.setdefault(queue, _Queue())
-            q.dead.extend(body for _, body in dead)
+            q.dead.extend(body for _, body, _h in dead)
             # compact: rewrite still-alive publications AND dead letters (as
             # pub+dlq pairs) — dead letters must survive any number of restarts
             tmp = self._journal_path(queue) + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
-                for tag, body in alive.items():
-                    f.write(json.dumps({"op": "pub", "tag": tag, "body": body}) + "\n")
-                for tag, body in dead:
-                    f.write(json.dumps({"op": "pub", "tag": tag, "body": body}) + "\n")
+                for tag, (body, headers) in alive.items():
+                    f.write(json.dumps(
+                        {"op": "pub", "tag": tag, "body": body,
+                         "headers": headers}
+                    ) + "\n")
+                for tag, body, headers in dead:
+                    f.write(json.dumps(
+                        {"op": "pub", "tag": tag, "body": body,
+                         "headers": headers}
+                    ) + "\n")
                     f.write(json.dumps({"op": "dlq", "tag": tag}) + "\n")
             os.replace(tmp, self._journal_path(queue))
-            for tag, body in alive.items():
-                q.pending.append((tag, body, 0, 0.0))
+            for tag, (body, headers) in alive.items():
+                q.pending.append((tag, body, 0, 0.0, headers))
                 self._next_tag = max(self._next_tag, tag + 1)
-            for tag, _ in dead:
+            for tag, _b, _h in dead:
                 self._next_tag = max(self._next_tag, tag + 1)
             if alive or dead:
                 log.info(
@@ -137,17 +156,26 @@ class MemoryBroker:
 
     # ---- core API ------------------------------------------------------------
 
-    def publish(self, queue: str, body: Dict[str, Any]) -> int:
+    def publish(
+        self,
+        queue: str,
+        body: Dict[str, Any],
+        headers: Optional[Dict[str, Any]] = None,
+    ) -> int:
         # resilience_site: broker.publish — an injected raise HERE (before
         # the journal write) models a dropped broker connection: nothing
         # was enqueued, the caller's RetryPolicy re-publishes
         faults.perturb("broker.publish")
+        headers = headers or {}
         with self._cv:
             tag = self._next_tag
             self._next_tag += 1
-            self._journal_write(queue, {"op": "pub", "tag": tag, "body": body})
+            self._journal_write(
+                queue,
+                {"op": "pub", "tag": tag, "body": body, "headers": headers},
+            )
             self._queues.setdefault(queue, _Queue()).pending.append(
-                (tag, body, 0, 0.0)
+                (tag, body, 0, 0.0, headers)
             )
             self._cv.notify_all()
             return tag
@@ -190,10 +218,12 @@ class MemoryBroker:
             out: List[Delivery] = []
             for entry in ready[:max_n]:
                 q.pending.remove(entry)
-                tag, body, attempts, _ = entry
+                tag, body, attempts, _, headers = entry
                 attempts += 1
-                q.unacked[tag] = (body, attempts)
-                out.append(Delivery(queue, tag, body, attempts))
+                q.unacked[tag] = (body, attempts, headers)
+                out.append(
+                    Delivery(queue, tag, body, attempts, headers=headers)
+                )
             return out
 
     def ack(self, delivery: Delivery) -> None:
@@ -211,13 +241,17 @@ class MemoryBroker:
             entry = q.unacked.pop(delivery.tag, None)
             if entry is None:
                 return False
-            body, attempts = entry
+            body, attempts, headers = entry
             if requeue and attempts < self.cfg.max_redelivery:
                 # backoff so transient failures (device busy, downstream
-                # hiccup) don't burn every attempt within milliseconds
+                # hiccup) don't burn every attempt within milliseconds;
+                # headers (trace ids) ride every redelivery hop
                 delay = self.cfg.retry_backoff_s * (2 ** (attempts - 1))
                 q.pending.appendleft(
-                    (delivery.tag, body, attempts, time.monotonic() + delay)
+                    (
+                        delivery.tag, body, attempts,
+                        time.monotonic() + delay, headers,
+                    )
                 )
                 self._cv.notify_all()
                 return False
@@ -306,6 +340,9 @@ class Consumer(threading.Thread):
         on_dead: Optional[Callable[[Dict[str, Any]], None]] = None,
         retry=None,  # resilience.RetryPolicy: in-place handler retries
         breaker=None,  # resilience.CircuitBreaker: pause pulls while open
+        pass_headers: bool = False,  # handler(bodies, headers) + on_dead
+        # (body, headers): trace propagation (docqa_tpu/obs) without
+        # touching payloads — the pipeline's consumers opt in
     ) -> None:
         super().__init__(daemon=True, name=name or f"consumer-{queue}")
         self.broker = broker
@@ -316,6 +353,7 @@ class Consumer(threading.Thread):
         self.on_dead = on_dead
         self.retry = retry
         self.breaker = breaker
+        self.pass_headers = pass_headers
         self._stopped = threading.Event()
 
     def stop(self, join: bool = True) -> None:
@@ -326,12 +364,18 @@ class Consumer(threading.Thread):
     def _nack(self, delivery: Delivery) -> None:
         if self.broker.nack(delivery, requeue=True) and self.on_dead:
             try:
-                self.on_dead(delivery.body)
+                if self.pass_headers:
+                    self.on_dead(delivery.body, delivery.headers)
+                else:
+                    self.on_dead(delivery.body)
             except Exception:
                 log.exception("on_dead callback failed for %s", self.queue)
 
     def _handle(
-        self, bodies: List[Dict[str, Any]], use_breaker: bool = True
+        self,
+        bodies: List[Dict[str, Any]],
+        headers: Optional[List[Dict[str, Any]]] = None,
+        use_breaker: bool = True,
     ) -> None:
         """One handler invocation under the retry policy (+ breaker).
 
@@ -353,14 +397,21 @@ class Consumer(threading.Thread):
         healthy batch-mates with BreakerOpen, burning their redelivery
         budget)."""
 
+        if self.pass_headers:
+            hdrs = headers if headers is not None else [{} for _ in bodies]
+
+            def invoke() -> None:
+                self.handler(bodies, hdrs)
+        else:
+
+            def invoke() -> None:
+                self.handler(bodies)
+
         def attempt() -> None:
             if self.retry is not None:
-                self.retry.call(
-                    lambda: self.handler(bodies),
-                    name=f"consumer_{self.queue}",
-                )
+                self.retry.call(invoke, name=f"consumer_{self.queue}")
             else:
-                self.handler(bodies)
+                invoke()
 
         if use_breaker and self.breaker is not None:
             self.breaker.call(attempt)
@@ -384,7 +435,10 @@ class Consumer(threading.Thread):
             if not deliveries:
                 continue
             try:
-                self._handle([d.body for d in deliveries])
+                self._handle(
+                    [d.body for d in deliveries],
+                    [d.headers for d in deliveries],
+                )
             except Exception:
                 log.exception(
                     "batch handler failed on %s (%d msgs); isolating",
@@ -401,7 +455,9 @@ class Consumer(threading.Thread):
                 # an outage failing every message crosses the threshold
                 for d in deliveries:
                     try:
-                        self._handle([d.body], use_breaker=False)
+                        self._handle(
+                            [d.body], [d.headers], use_breaker=False
+                        )
                     except Exception:
                         if self.breaker is not None:
                             self.breaker.record_failure()
@@ -470,12 +526,17 @@ class AmqpBroker:
             self._ch.queue_declare(queue=queue, durable=True)
             self._declared.add(queue)
 
+    # broker-reserved header keys; everything else is caller metadata
+    # (trace ids) that must survive every republish hop
+    _RESERVED_HEADERS = ("x-attempts", "x-ready-at")
+
     def _publish_locked(
         self,
         queue: str,
         body: Dict[str, Any],
         attempts: int,
         ready_at: float = 0.0,
+        headers: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._declare(queue)
         self._ch.basic_publish(
@@ -484,14 +545,23 @@ class AmqpBroker:
             body=json.dumps(body),
             properties=self._pika.BasicProperties(
                 delivery_mode=2,
-                headers={"x-attempts": attempts, "x-ready-at": ready_at},
+                headers={
+                    "x-attempts": attempts,
+                    "x-ready-at": ready_at,
+                    **(headers or {}),
+                },
             ),
         )
 
-    def publish(self, queue: str, body: Dict[str, Any]) -> int:
+    def publish(
+        self,
+        queue: str,
+        body: Dict[str, Any],
+        headers: Optional[Dict[str, Any]] = None,
+    ) -> int:
         faults.perturb("broker.publish")  # resilience_site: broker.publish
         with self._lock:
-            self._publish_locked(queue, body, 0)
+            self._publish_locked(queue, body, 0, headers=headers)
             self._n_published += 1
             return self._n_published
 
@@ -526,12 +596,22 @@ class AmqpBroker:
                     headers = getattr(props, "headers", None) or {}
                     ready_at = float(headers.get("x-ready-at", 0.0))
                     attempts = int(headers.get("x-attempts", 0))
+                    user_headers = {
+                        k: v
+                        for k, v in headers.items()
+                        if k not in self._RESERVED_HEADERS
+                    }
                     if ready_at > time.time():
                         # still in retry backoff: push it to the back,
                         # durably, and keep scanning (MemoryBroker parity —
-                        # its pending entries carry a not-before timestamp)
+                        # its pending entries carry a not-before timestamp).
+                        # Caller headers MUST ride along: this republish
+                        # used to reconstruct only the broker's own
+                        # bookkeeping, silently stripping trace ids on
+                        # every backoff hop.
                         self._publish_locked(
-                            queue, json.loads(payload), attempts, ready_at
+                            queue, json.loads(payload), attempts, ready_at,
+                            headers=user_headers,
                         )
                         self._ch.basic_ack(method.delivery_tag)
                         continue
@@ -544,6 +624,7 @@ class AmqpBroker:
                             method.delivery_tag,
                             json.loads(payload),
                             attempts + 1,
+                            headers=user_headers,
                         )
                     )
                 if out:
@@ -566,17 +647,22 @@ class AmqpBroker:
             if requeue and delivery.attempts < self.cfg.max_redelivery:
                 # exponential backoff via a durable not-before header, so a
                 # transient failure doesn't burn every attempt within
-                # milliseconds (MemoryBroker.nack parity)
+                # milliseconds (MemoryBroker.nack parity); caller headers
+                # (trace ids) are preserved through the hop
                 delay = self.cfg.retry_backoff_s * (2 ** (delivery.attempts - 1))
                 self._publish_locked(
                     delivery.queue,
                     delivery.body,
                     delivery.attempts,
                     ready_at=time.time() + delay,
+                    headers=delivery.headers,
                 )
                 self._ch.basic_ack(delivery.tag)
                 return False
-            self._publish_locked(f"{delivery.queue}.dlq", delivery.body, 0)
+            self._publish_locked(
+                f"{delivery.queue}.dlq", delivery.body, 0,
+                headers=delivery.headers,
+            )
             self._ch.basic_ack(delivery.tag)
             self._dead.setdefault(delivery.queue, []).append(delivery.body)
             log.warning(

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from docqa_tpu import obs
 from docqa_tpu.config import Config
 from docqa_tpu.resilience import faults
 from docqa_tpu.resilience.policy import RetryPolicy
@@ -118,13 +119,21 @@ class DocumentPipeline:
         # dropped) or the add completes first (delete_docs tombstones them).
         self._suppressed_doc_ids: set = set()
         self._suppress_lock = threading.Lock()
-        def _dead(body, status):
+        def _dead(body, headers, status):
             self.registry.set_status_unless_deleted(body["doc_id"], status)
+            # the document's timeline ends here, flagged — dead-lettered
+            # docs are exactly what the flight recorder must always keep
+            obs.finish_id(
+                (headers or {}).get(obs.TRACE_HEADER),
+                flag="dead_lettered",
+            )
             self._notify_done()
 
         # per-stage breakers: while a stage's circuit is open its consumer
         # pauses pulling (messages keep their redelivery budget); the
-        # retry policy absorbs transient failures before any nack
+        # retry policy absorbs transient failures before any nack.
+        # pass_headers threads each message's trace id (docqa_tpu/obs)
+        # through both hops without touching payloads.
         self._consumers = [
             Consumer(
                 broker,
@@ -132,9 +141,12 @@ class DocumentPipeline:
                 self._deid_handler,
                 batch=cfg.broker.prefetch,
                 name="deid-worker",
-                on_dead=lambda body: _dead(body, reg.ERROR_DEID),
+                on_dead=lambda body, headers: _dead(
+                    body, headers, reg.ERROR_DEID
+                ),
                 retry=self._consumer_retry,
                 breaker=breakers.get("deid") if breakers else None,
+                pass_headers=True,
             ),
             Consumer(
                 broker,
@@ -142,9 +154,12 @@ class DocumentPipeline:
                 self._index_handler,
                 batch=cfg.broker.prefetch,
                 name="index-worker",
-                on_dead=lambda body: _dead(body, reg.ERROR_INDEXING),
+                on_dead=lambda body, headers: _dead(
+                    body, headers, reg.ERROR_INDEXING
+                ),
                 retry=self._consumer_retry,
                 breaker=breakers.get("index") if breakers else None,
+                pass_headers=True,
             ),
         ]
 
@@ -197,8 +212,24 @@ class DocumentPipeline:
     ):
         """Reference contract (``doc-ingestor/main.py:19-65``): create the
         metadata row first, then extract, then queue; every failure mode gets
-        a distinct terminal status."""
+        a distinct terminal status.
+
+        The document's trace starts (or continues — the HTTP layer may
+        have opened it) HERE and spans the whole extract→deid→index
+        lifecycle: trace headers ride the broker messages, and the trace
+        completes at the first terminal status — including a dead-letter
+        — so every ingested document leaves exactly one timeline."""
+        with obs.ensure("ingest") as ctx:
+            return self._ingest_traced(
+                ctx, filename, data, doc_type, patient_id, doc_date
+            )
+
+    def _ingest_traced(
+        self, ctx, filename, data, doc_type, patient_id, doc_date
+    ):
         record = self.registry.create(filename, doc_type, patient_id, doc_date)
+        if ctx is not None:
+            ctx.trace.root.attrs.setdefault("doc_id", record.doc_id)
 
         def _extract():
             faults.perturb("extract")  # resilience_site: extract
@@ -223,6 +254,8 @@ class DocumentPipeline:
                 detail=why or "empty_text",
             )
             self._notify_done()
+            obs.flag("error_extraction")
+            obs.finish(ctx, status="error")
             return self.registry.get(record.doc_id)
         try:
             self._publish(
@@ -237,16 +270,26 @@ class DocumentPipeline:
                         "doc_date": doc_date,
                     },
                 },
+                headers=obs.headers_of(ctx),
             )
         except Exception:
             log.exception("queue publish failed")
             self.registry.set_status(record.doc_id, reg.ERROR_QUEUE)
             self._notify_done()
+            obs.flag("error_queue")
+            obs.finish(ctx, status="error")
             return self.registry.get(record.doc_id)
         self.registry.set_status(record.doc_id, reg.PROCESSED)
+        # trace stays OPEN: the async deid/index hops finish it at the
+        # document's terminal status (or dead-letter)
         return self.registry.get(record.doc_id)
 
-    def _publish(self, queue: str, body: Dict[str, Any]) -> None:
+    def _publish(
+        self,
+        queue: str,
+        body: Dict[str, Any],
+        headers: Optional[Dict[str, Any]] = None,
+    ) -> None:
         """Broker publish under the retry policy — a transient broker
         failure is retried with backoff instead of immediately becoming a
         terminal ERROR_QUEUE/ERROR_DEID.
@@ -259,7 +302,7 @@ class DocumentPipeline:
         br = self._broker_breaker
         try:
             self._retry.call(
-                lambda: self.broker.publish(queue, body),
+                lambda: self.broker.publish(queue, body, headers=headers),
                 name="broker_publish",
             )
         except Exception:
@@ -275,17 +318,33 @@ class DocumentPipeline:
 
     # ---- workers -------------------------------------------------------------
 
-    def _deid_handler(self, bodies: List[Dict[str, Any]]) -> None:
+    def _deid_handler(
+        self,
+        bodies: List[Dict[str, Any]],
+        headers: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
         # Pure phase first — a raise here is side-effect-free, so the
         # Consumer's one-by-one poison isolation (and its in-place retry
         # policy) may safely replay the batch.
         faults.perturb("deid")  # resilience_site: deid (slow-stage/outage)
+        headers = headers if headers is not None else [{} for _ in bodies]
         texts = [b["text"] for b in bodies]
+        t_batch0 = time.perf_counter()
         with span("deid_batch", DEFAULT_REGISTRY):
             masked = self.deid.deidentify_batch(texts)
+        t_batch1 = time.perf_counter()
         # Side-effect phase: per-message failures are terminal here, never
         # re-raised (a raise would make the retry republish the prefix).
-        for body, clean in zip(bodies, masked):
+        for body, clean, hdrs in zip(bodies, masked, headers):
+            # re-link the document's trace (or adopt a stub after a
+            # cross-restart replay) and charge it this batch's interval
+            ctx = obs.from_headers(hdrs, name="doc")
+            if ctx is not None:
+                ctx.trace.record_span(
+                    "deid_batch", t_batch0, t_batch1,
+                    parent_id=ctx.span_id, batch=len(bodies),
+                    doc_id=body.get("doc_id"),
+                )
             try:
                 # deleted docs stop HERE, not just at the index worker: a
                 # DEIDENTIFIED overwrite of DELETED would advertise an
@@ -327,6 +386,7 @@ class DocumentPipeline:
                     log.info(
                         "dropping deleted doc %s at deid stage", body["doc_id"]
                     )
+                    obs.finish(ctx, status="dropped")
                     continue
                 self._publish(
                     self.cfg.broker.clean_queue,
@@ -336,6 +396,7 @@ class DocumentPipeline:
                         "metadata": body.get("metadata", {}),
                         "processed_at": time.time(),
                     },
+                    headers=obs.headers_of(ctx),
                 )
             except Exception:
                 log.exception("clean-queue publish failed for %s", body["doc_id"])
@@ -346,11 +407,26 @@ class DocumentPipeline:
                     self._notify_done()
                 except Exception:
                     log.exception("status write failed for %s", body["doc_id"])
+                if ctx is not None:
+                    ctx.trace.flag("error_deid")
+                    obs.finish(ctx, status="error")
 
-    def _index_handler(self, bodies: List[Dict[str, Any]]) -> None:
+    def _index_handler(
+        self,
+        bodies: List[Dict[str, Any]],
+        headers: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
         # before any side effect: an injected raise here replays the whole
         # batch safely (resilience_site: index)
         faults.perturb("index")
+        headers = headers if headers is not None else [{} for _ in bodies]
+        # per-doc trace contexts (docqa_tpu/obs): re-linked from message
+        # headers so the index hop lands on the same timeline as ingest
+        # and deid; the terminal status below completes each trace
+        ctx_by_doc = {
+            body["doc_id"]: obs.from_headers(hdrs, name="doc")
+            for body, hdrs in zip(bodies, headers)
+        }
         all_chunks: List[str] = []
         all_meta: List[Dict[str, Any]] = []
         per_doc: List[tuple] = []
@@ -365,9 +441,11 @@ class DocumentPipeline:
             record = self.registry.get(body["doc_id"])
             if record is not None and record.status == reg.DELETED:
                 log.info("dropping deleted doc %s (registry)", body["doc_id"])
+                obs.finish(ctx_by_doc.get(body["doc_id"]), status="dropped")
                 continue
             if body["doc_id"] in self._suppressed_doc_ids:
                 log.info("dropping deleted in-flight doc %s", body["doc_id"])
+                obs.finish(ctx_by_doc.get(body["doc_id"]), status="dropped")
                 continue
             if body["doc_id"] in self._indexed_doc_ids:
                 log.info(
@@ -397,6 +475,7 @@ class DocumentPipeline:
                         "char_end": ch.end,
                     }
                 )
+        t_batch0 = time.perf_counter()
         if all_chunks:
             with span("index_batch", DEFAULT_REGISTRY):
                 # encode is pure; a raise from it (or from store.add, whose
@@ -442,6 +521,8 @@ class DocumentPipeline:
                         log.info(
                             "dropped %d doc(s) deleted mid-encode", len(late)
                         )
+                        for d in late:
+                            obs.finish(ctx_by_doc.get(d), status="dropped")
                     if all_meta:
                         self.store.add(
                             embeddings,
@@ -450,6 +531,15 @@ class DocumentPipeline:
                             token_lens=tok_lens,
                         )
                     self._indexed_doc_ids.update(d for d, _n in per_doc)
+            t_batch1 = time.perf_counter()
+            for doc_id, n in per_doc:
+                ctx = ctx_by_doc.get(doc_id)
+                if ctx is not None:
+                    ctx.trace.record_span(
+                        "index_batch", t_batch0, t_batch1,
+                        parent_id=ctx.span_id, batch=len(per_doc),
+                        doc_id=doc_id, n_chunks=n,
+                    )
         # vectors are committed past this point: never raise (a retry would
         # re-encode and re-append the whole batch)
         if self.on_indexed is not None and per_doc:
@@ -481,10 +571,14 @@ class DocumentPipeline:
                 with self._suppress_lock:
                     skip = doc_id in self._suppressed_doc_ids
                 if skip:
+                    obs.finish(ctx_by_doc.get(doc_id), status="dropped")
                     continue
                 self.registry.set_status_unless_deleted(
                     doc_id, reg.INDEXED, n_chunks=n
                 )
+                # terminal: the document's whole ingest→deid→index
+                # timeline completes here
+                obs.finish(ctx_by_doc.get(doc_id), status="ok")
             except Exception:
                 log.exception("status write failed for %s", doc_id)
         for doc_id in replayed:
@@ -498,8 +592,10 @@ class DocumentPipeline:
                 with self._suppress_lock:
                     skip = doc_id in self._suppressed_doc_ids
                 if skip:
+                    obs.finish(ctx_by_doc.get(doc_id), status="dropped")
                     continue
                 self.registry.set_status_unless_deleted(doc_id, reg.INDEXED)
+                obs.finish(ctx_by_doc.get(doc_id), status="ok")
             except Exception:
                 log.exception("status write failed for %s", doc_id)
         if per_doc or replayed:  # wake wait_indexed() blockers

@@ -20,6 +20,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from docqa_tpu import obs
 from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT, QueueFull
 from docqa_tpu.resilience import faults
 from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
@@ -88,6 +89,10 @@ class PendingAnswer:
         self.degraded = True
         self.degrade_reason = reason
         DEFAULT_REGISTRY.counter("qa_degraded").inc()
+        # anomalous by definition: the flight recorder always keeps
+        # degraded requests, and the timeline says WHY (the reason event)
+        obs.flag("degraded")
+        obs.event("degraded", reason=reason)
         return self._result(
             extractive_answer(self.chunks, self.degraded_max_chars)
         )
@@ -207,6 +212,8 @@ class QAService:
         self, sources: List[str], chunks: List[str], reason: str
     ) -> PendingAnswer:
         DEFAULT_REGISTRY.counter("qa_degraded").inc()
+        obs.flag("degraded")
+        obs.event("degraded", reason=reason)
         return PendingAnswer(
             sources=sources,
             answer=extractive_answer(chunks, self.degraded_max_chars),

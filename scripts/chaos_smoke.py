@@ -16,6 +16,7 @@ failure here is replayable with the printed command line.
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -124,6 +125,8 @@ def main() -> int:
     finally:
         pipeline.stop()
 
+    from docqa_tpu import obs
+
     statuses = {d: registry.get(d).status for d in doc_ids}
     indexed = [d for d, s in statuses.items() if s == reg.INDEXED]
     errored = [d for d, s in statuses.items() if s.startswith("ERROR")]
@@ -155,8 +158,42 @@ def main() -> int:
     if lost:
         print(f"LOST DOCUMENTS: stuck={stuck} missing={missing_vectors} "
               f"residue={residue}", file=sys.stderr)
+        # post-hoc diagnosis: every ingested doc left a timeline in the
+        # flight recorder (stuck docs are still OPEN traces) — dump all
+        # of it so the failure is replayable AND inspectable
+        dump_path = f"chaos_traces_seed{args.seed}.json"
+        try:
+            with open(dump_path, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "seed": args.seed,
+                        "stuck": stuck,
+                        "missing_vectors": missing_vectors,
+                        "open": [
+                            obs.timeline_dict(t)
+                            for t in obs.DEFAULT_RECORDER.open_traces()
+                        ],
+                        "anomalous": [
+                            obs.timeline_dict(t)
+                            for t in obs.DEFAULT_RECORDER.anomalous(100)
+                        ],
+                        "recent": [
+                            obs.timeline_dict(t)
+                            for t in obs.DEFAULT_RECORDER.recent(100)
+                        ],
+                    },
+                    f,
+                    indent=1,
+                )
+            print(f"flight recorder dumped to {dump_path}", file=sys.stderr)
+        except Exception as e:
+            print(f"flight-recorder dump failed: {e!r}", file=sys.stderr)
         return 1
-    print("zero lost documents — every doc acked, dead-lettered, or indexed")
+    n_anom = len(obs.DEFAULT_RECORDER.anomalous(100))
+    print(
+        "zero lost documents — every doc acked, dead-lettered, or indexed "
+        f"({n_anom} anomalous timeline(s) in the flight recorder)"
+    )
     return 0
 
 

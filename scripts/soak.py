@@ -136,6 +136,35 @@ for d in results["doc_ids"]:
         bad.append(f"{d}: expected INDEXED got {rec['status']}")
 st, status = req("GET", "/api/status")
 live_expected = len(results["doc_ids"]) - len(set(results["deleted"]))
+
+
+def dump_flight_recorder(reason):
+    """On failure, pull the service's flight recorder (anomalous ring +
+    recent) so the soak violation is diagnosable post-hoc — which
+    request shed where, which doc's pipeline hop ate the time."""
+    try:
+        _, anomalous = req("GET", "/api/traces?anomalous=1&limit=100")
+        _, recent = req("GET", "/api/traces?limit=50")
+        timelines = []
+        for row in anomalous[:50]:
+            try:
+                _, tl = req("GET", f"/api/trace/{row['trace_id']}")
+                timelines.append(tl)
+            except Exception:
+                pass
+        out = {
+            "reason": reason,
+            "anomalous_summaries": anomalous,
+            "recent_summaries": recent,
+            "anomalous_timelines": timelines,
+        }
+        path = "soak_traces.json"
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=1)
+        print(f"flight recorder dumped to {path} ({len(anomalous)} anomalous)",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"flight-recorder dump failed: {e!r}", file=sys.stderr)
 print(json.dumps({
     "wall_s": round(wall, 1),
     "ingested": len(results["doc_ids"]),
@@ -150,6 +179,10 @@ print(json.dumps({
     "queue_depths": status.get("queue_depths"),
     "dead_letters": status.get("dead_letters"),
 }, indent=1))
+if results["errors"] or bad:
+    dump_flight_recorder(
+        {"errors": results["errors"][:5], "violations": bad[:5]}
+    )
 assert not results["errors"], results["errors"][:5]
 assert not bad, bad[:5]
 print("SOAK OK")

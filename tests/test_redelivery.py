@@ -167,6 +167,82 @@ class TestJournalCrashReplay:
         assert seen == [{"doc": "a"}]
 
 
+class TestTraceHeaderPreservation:
+    """Trace headers (docqa_tpu/obs propagation) must survive EVERY
+    redelivery hop — the regression fixed this PR: the AMQP backoff
+    republish and nack requeue reconstructed only the broker's own
+    bookkeeping headers, silently unlinking a document's timeline on
+    its first retry."""
+
+    HDRS = {"x-trace-id": "t-abc123", "x-parent-span": "s7"}
+
+    def test_headers_survive_nack_requeue(self, broker):
+        broker.publish("q", {"x": 1}, headers=dict(self.HDRS))
+        d1 = broker.get_many("q", timeout=5)[0]
+        assert d1.headers == self.HDRS
+        assert broker.nack(d1) is False  # requeued
+        d2 = broker.get_many("q", timeout=5)[0]
+        assert d2.attempts == 2
+        assert d2.headers == self.HDRS  # the hop kept the trace link
+        broker.ack(d2)
+
+    def test_headers_survive_amqp_backoff_republish(self):
+        # a wide backoff window forces the get_many scan to take the
+        # push-to-the-back republish path — the exact path that used to
+        # strip caller headers
+        cfg = BrokerConfig(max_redelivery=3, retry_backoff_s=0.3)
+        broker = AmqpBroker(cfg, pika_module=FakePika())
+        try:
+            broker.publish("q", {"x": 1}, headers=dict(self.HDRS))
+            broker.nack(broker.get_many("q", timeout=5)[0])
+            # inside the window: scanning republishes it durably
+            assert broker.get_many("q", timeout=0.05) == []
+            d = broker.get_many("q", timeout=5)[0]
+            assert d.headers == self.HDRS
+            assert d.attempts == 2
+        finally:
+            broker.close()
+
+    def test_headers_survive_journal_crash_replay(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        b = MemoryBroker(CFG, journal_dir=jd)
+        b.publish("q", {"n": 1}, headers=dict(self.HDRS))
+        b.get("q", timeout=1)  # mid-flight, then CRASH (no ack/close)
+        b2 = MemoryBroker(CFG, journal_dir=jd)
+        d = b2.get("q", timeout=1)
+        assert d.headers == self.HDRS
+        b2.close()  # still unacked -> compacted journal must keep them
+        b3 = MemoryBroker(CFG, journal_dir=jd)
+        d3 = b3.get("q", timeout=1)
+        assert d3.headers == self.HDRS
+        b3.ack(d3)
+        b3.close()
+
+    def test_headers_reach_dead_letter_callback(self):
+        """A dead-lettered message's trace id reaches on_dead so the
+        pipeline can finish the doc's timeline flagged."""
+        from docqa_tpu.service.broker import Consumer
+
+        b = MemoryBroker(BrokerConfig(max_redelivery=2,
+                                      retry_backoff_s=0.01))
+        seen = []
+
+        def boom(bodies, headers):
+            raise RuntimeError("poison")
+
+        c = Consumer(
+            b, "q", boom, poll_s=0.01, pass_headers=True,
+            on_dead=lambda body, headers: seen.append((body, headers)),
+        )
+        c.start()
+        b.publish("q", {"i": 0}, headers=dict(self.HDRS))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.01)
+        c.stop()
+        assert seen == [({"i": 0}, self.HDRS)]
+
+
 class TestAmqpAttemptHeaderFidelity:
     def test_attempts_ride_the_wire_header(self):
         """The x-attempts header — not broker memory — carries the count,
