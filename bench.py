@@ -218,26 +218,59 @@ def _device_backend_alive(timeout_s: float = 150.0) -> bool:
 
 
 def _device_backend_alive_retrying(
-    attempts: int = 2, probe_timeout_s: float = 120.0, backoff_s: float = 45.0
+    wait_budget_s: Optional[float] = None,
+    probe_timeout_s: float = 120.0,
+    backoff_s: float = 45.0,
 ) -> bool:
-    """Bounded retry/backoff around the probe: a transient tunnel outage at
-    bench start must not forfeit the whole round to a CPU smoke run (it did,
-    twice).  Budget: ~2 probes over ~4.5 min worst case — the r04 lesson
-    cut this from ~13 min: every pre-headline minute is driver-window
-    risk (the r04 driver artifact was a timeout with the headline already
-    measured but unprinted)."""
-    for i in range(attempts):
-        if _device_backend_alive(probe_timeout_s):
-            if i:
-                log(f"accelerator answered on probe attempt {i + 1}")
+    """TIME-budgeted retry/wait around the probe: a transient tunnel
+    outage at bench start must not forfeit the whole round to a CPU smoke
+    run (it did, twice) — but the budget is bounded because every
+    pre-headline minute is driver-window risk (the r04 driver artifact
+    was a timeout with the headline already measured but unprinted).
+
+    Probes repeat with backoff until the accelerator answers or
+    ``wait_budget_s`` (``DOCQA_BENCH_TPU_WAIT_S``, default 270 s ≈ the
+    old 2-probe worst case) is exhausted; only THEN does the caller fall
+    back to CPU and stamp ``degraded: true``.  The probe history lands in
+    ``DETAILS["backend_probe"]`` so a degraded line is attributable to
+    "waited N s across M probes", not a single silent failure."""
+    if wait_budget_s is None:
+        wait_budget_s = float(os.environ.get("DOCQA_BENCH_TPU_WAIT_S", "270"))
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        # never let one probe overrun what's left of the budget (+grace)
+        left = wait_budget_s - (time.monotonic() - t0)
+        ok = _device_backend_alive(min(probe_timeout_s, max(left, 30.0)))
+        waited = round(time.monotonic() - t0, 1)
+        if ok:
+            if attempt > 1:
+                log(
+                    f"accelerator answered on probe attempt {attempt} "
+                    f"(+{waited}s)"
+                )
+            DETAILS["backend_probe"] = {
+                "ok": True, "attempts": attempt, "waited_s": waited,
+            }
             return True
-        if i + 1 < attempts:
+        left = wait_budget_s - (time.monotonic() - t0)
+        if left <= 1.0:
+            DETAILS["backend_probe"] = {
+                "ok": False, "attempts": attempt, "waited_s": waited,
+                "budget_s": wait_budget_s,
+            }
             log(
-                f"accelerator probe {i + 1}/{attempts} failed; "
-                f"retrying in {backoff_s:.0f}s"
+                f"accelerator unreachable after {attempt} probe(s) over "
+                f"{waited}s (budget {wait_budget_s:.0f}s)"
             )
-            time.sleep(backoff_s)
-    return False
+            return False
+        sleep_s = min(backoff_s, left)
+        log(
+            f"accelerator probe {attempt} failed (+{waited}s of "
+            f"{wait_budget_s:.0f}s budget); retrying in {sleep_s:.0f}s"
+        )
+        time.sleep(sleep_s)
 
 
 def _start_stall_watchdog(stall_min: Optional[float] = None) -> None:
@@ -753,6 +786,17 @@ def main() -> None:
     # parses: distinct metric name AND an explicit degraded flag.
     degraded = not on_tpu
     DETAILS["degraded"] = degraded
+    if degraded:
+        # degraded is stamped ONLY after the TPU retry budget was spent
+        # (or an explicit forced-CPU rerun) — the reason says which
+        probe = DETAILS.get("backend_probe")
+        DETAILS["degraded_reason"] = (
+            "forced_cpu_rerun"
+            if force_cpu
+            else "backend_unreachable_after_retry_budget"
+            if probe and not probe.get("ok")
+            else "cpu_backend"
+        )
     DETAILS["headline_printed_at_s"] = round(time.monotonic() - T0, 1)
     flush_details()
     summary = {
@@ -1332,8 +1376,126 @@ def main() -> None:
             f"{p50_on:.1f}ms traced ({overhead:+.2f}%, budget 2%)"
         )
 
+    def run_pool_load(engine, replicas, n_slots, chunk, n_req, cache_len):
+        """Closed-loop burst through an ``EnginePool`` with N replicas —
+        the aggregate-QPS-vs-replica-count measurement ROADMAP item 5
+        names.  Same protocol as :func:`run_load` so the 1-replica row is
+        directly comparable to ``rag_load`` (pool dispatch overhead =
+        the delta)."""
+        import threading as _threading
+
+        from docqa_tpu.engines.pool import EnginePool
+
+        pool = EnginePool(
+            engine,
+            replicas=replicas,
+            n_slots=n_slots,
+            chunk=chunk,
+            cache_len=cache_len,
+            # no canary/hedge noise inside the measured window; health
+            # checks stay on (they are part of the serving config)
+            canary_interval_s=600.0,
+            health_interval_s=0.2,
+        )
+        try:
+            pool.warmup(buckets=engine.gen.prefill_buckets[:1])
+            prompt_ids = [
+                [7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(n_req)
+            ]
+            # touch every replica's admission shapes before t0
+            for h in [
+                pool.submit_ids(p, max_new_tokens=4)
+                for p in prompt_ids[: n_slots * replicas]
+            ]:
+                h.result()
+            pool.submit_ids(prompt_ids[0], max_new_tokens=max_new).result()
+            # per-request success, same as run_open_loop: a failed
+            # request must not leave a 0.0 placeholder dragging the
+            # percentiles down, nor count toward achieved QPS
+            lat_ms = [None] * n_req
+            waiters = []
+            t0 = time.perf_counter()
+
+            def wait_one(idx, handle):
+                try:
+                    handle.result()
+                except Exception as e:
+                    log(f"pool_scaling request {idx} failed: {e!r}")
+                    return
+                lat_ms[idx] = (time.perf_counter() - t0) * 1e3
+
+            for i, p in enumerate(prompt_ids):
+                h = pool.submit_ids(p, max_new_tokens=max_new)
+                w = _threading.Thread(target=wait_one, args=(i, h))
+                w.start()
+                waiters.append(w)
+            for w in waiters:
+                w.join()
+            wall = time.perf_counter() - t0
+        finally:
+            pool.stop()
+            del pool
+            gc.collect()
+        ok = [v for v in lat_ms if v is not None]
+        return len(ok) / wall, wall, ok, n_req - len(ok)
+
+    def sec_pool_scaling():
+        """Aggregate QPS + p50/p95 at 1, 2, 4 pool replicas (ROADMAP
+        item 5's scale-out benchmark).  HONESTY (r05 rule): replicas
+        here are same-host lanes SHARING one device, so this measures
+        pool dispatch overhead and failover-ready replication — NOT
+        per-slice hardware scaling; linear aggregate QPS needs one mesh
+        slice per replica (labeled accordingly)."""
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        gen1 = S["gen1"]
+        n_req = 32 if not small else 8
+        cache_len = 1024 if not small else 256
+        n_slots = 8 if not small else 4
+        rows = []
+        for replicas in (1, 2, 4):
+            if remaining() < 60 and rows:
+                log(f"pool_scaling: budget stop before {replicas} replicas")
+                break
+            try:
+                qps, wall, lat, errors = run_pool_load(
+                    gen1, replicas, n_slots, 16, n_req, cache_len
+                )
+            except Exception as e:
+                log(f"pool_scaling at {replicas} replicas failed: {e!r}")
+                continue
+            if not lat:
+                log(f"pool_scaling at {replicas} replicas: 0 completions")
+                continue
+            rows.append(
+                {
+                    "replicas": replicas,
+                    "aggregate_qps": round(qps, 2),
+                    "wall_s": round(wall, 2),
+                    "request_p50_ms": round(float(np.percentile(lat, 50)), 1),
+                    "request_p95_ms": round(float(np.percentile(lat, 95)), 1),
+                    "requests_ok": len(lat),
+                    "errors": errors,
+                }
+            )
+            log(f"pool_scaling: {rows[-1]}")
+        DETAILS["pool_scaling"] = {
+            "arrival": "closed-loop burst",
+            "requests": n_req,
+            "n_slots_per_replica": n_slots,
+            "placement": (
+                "same-host lanes, one shared device — dispatch overhead "
+                "and replication cost, not per-slice hardware scaling"
+                + ("" if on_tpu else " (CPU smoke)")
+            ),
+            "rows": rows,
+        }
+
     run_section("e2e_1b", sec_1b, 240)
     run_section("load_1b", sec_load_1b, 200)
+    run_section("pool_scaling", sec_pool_scaling, 150)
     run_section("trace_overhead", sec_trace_overhead, 90)
 
     # ---- config 4: summarizer, 5 retrieved chunks ---------------------------

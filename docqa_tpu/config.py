@@ -92,6 +92,15 @@ class NERConfig:
     # weights — pipeline-plumbing mode only, never masks contextual PHI.
     params_path: Optional[str] = None
     train_steps: int = 1500
+    # Document-register language for the PATTERN recognizers (the NER
+    # tagger is model-bound and language-blind).  "fr" — the reference's
+    # actual data language (NLP_LANG, deid-service/anonymizer.py:24) —
+    # keeps the combined French+English register (French clinical prose
+    # quotes English drug labels); "en" drops the French-only date and
+    # d'origine cues whose lowercase forms would be dead weight on
+    # English text.  Threaded end-to-end: pipeline → DeidEngine →
+    # analyze/deidentify (VERDICT item 8).
+    language: str = "fr"
     # cross-entropy weight on entity (non-O) labels: O is ~82 % of
     # supervised positions and a fresh tagger otherwise sits in the
     # all-O collapse for hundreds of steps (observed: 500 steps of the
@@ -409,6 +418,45 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class PoolConfig:
+    """Replicated decode-engine pool (``engines/pool.py``; docqa-pool,
+    docs/OPERATIONS.md "Replica pool").
+
+    The pool wraps N continuous batchers behind one submit surface with
+    a liveness contract per replica (heartbeat, canary, breaker),
+    failover for queued requests, fail-fast for admitted ones, graceful
+    drain for hot restarts, and optional hedged dispatch.  ``replicas=1``
+    (the default) keeps single-batcher economics while still providing
+    worker-death fail-fast, drain, and the /api/pool surface."""
+
+    replicas: int = 1
+    # per-replica batcher knobs; None = the batcher's own defaults
+    # (gen.max_concurrent slots)
+    n_slots: Optional[int] = None
+    max_queue: int = 256
+    # a worker iteration can legitimately contain a first-shape XLA
+    # compile (tens of seconds on a real chip) — pre-warmed deployments
+    # (generate.startup_warm_buckets=-1) can drop this for faster wedge
+    # detection
+    heartbeat_max_age_s: float = 60.0
+    # synthetic 2-token canary generate per replica; its outcome feeds
+    # the replica breaker so a slow/stuck replica stops receiving
+    # traffic before real requests pile onto it
+    canary_interval_s: float = 20.0
+    canary_timeout_s: float = 30.0
+    health_interval_s: float = 0.5
+    # failover budget: how many replica hops a queued request may make
+    # before failing typed (at-most-one by default)
+    requeue_max_hops: int = 1
+    # hedged dispatch: duplicate a request with no first token after a
+    # p95-based delay onto a second replica; first token wins, the loser
+    # is cancelled at its next admit round
+    hedge: bool = False
+    hedge_min_delay_s: float = 0.75
+    hedge_warmup: int = 20
+
+
+@dataclass(frozen=True)
 class GenerateConfig:
     """Decode-loop policy."""
 
@@ -460,6 +508,7 @@ class Config:
     flags: FlagsConfig = field(default_factory=FlagsConfig)
     generate: GenerateConfig = field(default_factory=GenerateConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    pool: PoolConfig = field(default_factory=PoolConfig)
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

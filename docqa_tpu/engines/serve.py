@@ -59,6 +59,7 @@ from docqa_tpu.models.decoder import (
     init_kv_cache,
 )
 from docqa_tpu.ops.sampling import sample
+from docqa_tpu.resilience import faults
 from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 from docqa_tpu.utils import pick_bucket, round_up
@@ -86,6 +87,42 @@ class _Request:
     trace: Optional[obs.Trace] = None
     span_parent: Optional[str] = None
     t_submit: float = 0.0
+    # pool failover budget (engines/pool.py): how many replica hops this
+    # request has already made.  A request is requeued at most
+    # ``requeue_max_hops`` times — unbounded hopping would let one poison
+    # prompt tour every replica.
+    hops: int = 0
+    # cooperative cancellation (hedged-dispatch losers, abandoned
+    # clients): the worker drops a cancelled request at its next
+    # admission round, or retires its slot at the next chunk boundary.
+    # A plain bool is enough — one writer flips it, the worker only reads.
+    cancelled: bool = False
+
+
+def make_request(
+    prompt_ids: Sequence[int],
+    max_new: int,
+    deadline: Optional[Deadline] = None,
+) -> _Request:
+    """Build a :class:`_Request`, capturing the SUBMITTER's trace position
+    (the worker thread records every later stage on it explicitly).
+
+    Module-level so :class:`~docqa_tpu.engines.pool.EnginePool` can mint a
+    request before it knows which replica will run it — the same request
+    object can then be queued, stolen back, and requeued across replicas
+    while its Handle keeps waiting on the one ``done``/``cv`` pair."""
+    if deadline is not None and deadline.expired:
+        # admission is the cheapest place to shed: a request that
+        # arrives already out of budget must not take a queue slot
+        DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
+        deadline.check("serve_submit")
+    req = _Request(list(prompt_ids), max_new, deadline=deadline)
+    ctx = obs.current()
+    if ctx is not None:
+        req.trace = ctx.trace
+        req.span_parent = ctx.span_id
+    req.t_submit = _now()
+    return req
 
 
 def _req_span(req: _Request, name: str, t0: float, t1: float, **attrs) -> None:
@@ -120,6 +157,22 @@ def _finish(req: _Request) -> None:
     req.done.set()
     with req.cv:
         req.cv.notify_all()
+
+
+class WorkerDied(RuntimeError):
+    """The batcher's worker thread died (crashed out of its loop — bug,
+    injected fault, or a kill by the pool's wedge detector).  Typed so
+    waiters get an immediate, attributable failure instead of hanging to
+    their :class:`ResultTimeout` — the QA layer maps it into the degraded
+    extractive path, and :class:`~docqa_tpu.engines.pool.EnginePool`
+    treats it as the replica-death failover trigger."""
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled (hedged-dispatch loser, abandoned
+    client) — its lane was released before completion.  Nobody should be
+    waiting on a cancelled request; the type exists so an accidental
+    waiter sees WHY the tokens never arrived."""
 
 
 class ResultTimeout(TimeoutError):
@@ -176,6 +229,21 @@ class Handle:
     ) -> str:
         """Wait and detokenize — the shared resolve path."""
         return tokenizer.decode_ids(self.result(timeout))
+
+    def cancel(self) -> None:
+        """Best-effort cancellation: the worker drops the request at its
+        next admission round (still queued) or retires its slot at the
+        next chunk boundary (already decoding).  Used by hedged dispatch
+        to release the losing replica's lane — the winner's tokens were
+        already delivered through the other handle."""
+        self._req.cancelled = True
+
+    @property
+    def started(self) -> bool:
+        """True once the request has produced at least one token (the
+        hedging trigger reads this: a request with a first token has won
+        a lane and must not be duplicated)."""
+        return bool(self._req.tokens) or self._req.done.is_set()
 
     def iter_tokens(self, timeout: Optional[float] = DEFAULT_RESULT_TIMEOUT):
         """Stream token ids as decode chunks land (the batcher appends a
@@ -251,6 +319,14 @@ class QueueFull(RuntimeError):
         super().__init__(message)
 
 
+class Draining(QueueFull):
+    """Admission refused because the batcher is draining (graceful
+    restart / weight reload).  A subclass of :class:`QueueFull` so every
+    existing 503-mapping keeps working — operationally a drain IS
+    transient overload: retry and you land on a healthy replica (the
+    pool routes around draining replicas before this is ever raised)."""
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching over a ``GenerateEngine``'s model."""
 
@@ -307,6 +383,46 @@ class ContinuousBatcher:
         self._queue: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stopped = False
+        # requests popped from the queue but not yet slot-resident (the
+        # worker's admission round holds them in a local list).  Guarded
+        # by ``_cv``.  drain() must count these as pending work: between
+        # the queue pop and the slot assignment BOTH "queue empty" and
+        # "no active slots" are true, and a drain that declared
+        # quiescence in that window would let the pool kill the batcher
+        # out from under an admission in flight.
+        self._admitting = 0
+        # the request OBJECTS of that window, kept in sync with the count
+        # (guarded by ``_cv``): the death/kill sweeps must be able to see
+        # them — they are in neither ``_queue`` nor ``_slot_req``, and a
+        # failure path that only sweeps those two strands them to a bare
+        # ResultTimeout (the hang this module promises can't happen)
+        self._admitting_reqs: List[_Request] = []
+        # liveness contract (engines/pool.py reads all three): the worker
+        # stamps ``_beat`` every loop iteration AND every idle wakeup, so
+        # a stale heartbeat means the loop is WEDGED inside one iteration
+        # (hung device fetch, injected stall) — not merely idle.
+        self._beat = time_monotonic()
+        # last REAL decode progress (a processed chunk): the pool's
+        # canary scheduler treats recent progress as a passed probe —
+        # a replica visibly delivering tokens needs no synthetic
+        # generate spending a decode lane (and, on the CPU smoke
+        # client, adding one more concurrent sharded dispatch)
+        self._last_progress = 0.0
+        self._worker_dead = False
+        self._draining = False
+        # cold-start flag: True until warmup() completes or the worker
+        # finishes its first decode chunk.  A COLD worker iteration
+        # legitimately blocks for a multi-second XLA compile, which looks
+        # exactly like a wedge to a heartbeat monitor — the pool skips
+        # wedge detection (and canaries) while cold, otherwise a tight
+        # heartbeat bound kills every replica mid-first-compile and the
+        # rebuild (also cold) spirals.
+        self._cold = True
+        # pool failover hook: called (from the dying worker thread) with
+        # (batcher, queued_requests) when the loop dies; returns the
+        # requests it could NOT rescue — those fail typed here.  None =
+        # solo batcher, every request fails typed immediately.
+        self.on_worker_death = None
         self._prefill_fn = None
         self._decode_fn = None
         self._worker = threading.Thread(
@@ -618,6 +734,9 @@ class ContinuousBatcher:
                 self.engine.params, cache, tok, lengths, active,
                 self._next_rng(),
             )
+        # warmed shapes cover the admission path: worker iterations are
+        # now bounded by real chunk rounds, so liveness checks may engage
+        self._cold = False
 
     # ---- public API ----------------------------------------------------------
 
@@ -628,22 +747,25 @@ class ContinuousBatcher:
         deadline: Optional[Deadline] = None,
     ) -> Handle:
         max_new = max_new_tokens or self.gen.max_new_tokens
-        if deadline is not None and deadline.expired:
-            # admission is the cheapest place to shed: a request that
-            # arrives already out of budget must not take a queue slot
-            DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
-            deadline.check("serve_submit")
-        req = _Request(list(prompt_ids), max_new, deadline=deadline)
-        ctx = obs.current()
-        if ctx is not None:
-            # capture the SUBMITTER's trace position; the worker thread
-            # records every later stage on it explicitly
-            req.trace = ctx.trace
-            req.span_parent = ctx.span_id
-        req.t_submit = _now()
+        return self.submit_request(
+            make_request(prompt_ids, max_new, deadline=deadline)
+        )
+
+    def submit_request(self, req: _Request) -> Handle:
+        """Admit an already-built :class:`_Request` (the pool's requeue
+        path re-admits the SAME object on a different replica, so the
+        original Handle keeps waiting on the same ``done``/``cv``)."""
         with self._cv:
+            if self._worker_dead:
+                raise WorkerDied("batcher worker is dead")
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
+            if self._draining:
+                raise Draining(
+                    "batcher is draining",
+                    n_queued=len(self._queue),
+                    n_active=sum(1 for r in self._slot_req if r is not None),
+                )
             if (
                 self.max_queue is not None
                 and len(self._queue) >= self.max_queue
@@ -737,6 +859,121 @@ class ContinuousBatcher:
                 req.error = RuntimeError("batcher stopped")
                 _finish(req)
 
+    # ---- liveness / graceful-drain contract (engines/pool.py) ---------------
+
+    @property
+    def worker_alive(self) -> bool:
+        """The worker loop can still make progress (thread running and
+        not past its death handler)."""
+        return self._worker.is_alive() and not self._worker_dead
+
+    @property
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the worker last stamped its loop heartbeat.  An
+        idle worker re-stamps every 0.5 s wakeup, so a large age with
+        work pending means the loop is wedged INSIDE one iteration."""
+        return time_monotonic() - self._beat
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def cold(self) -> bool:
+        """True until warmup() completes or the first decode chunk lands.
+        A cold worker's iteration can legitimately block in a
+        multi-second XLA compile — liveness monitors must not read a
+        stale heartbeat as a wedge until this clears."""
+        return self._cold
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Graceful quiesce: stop admitting (new submissions raise
+        :class:`Draining` → 503/route-around), let queued + in-flight
+        requests FINISH, then return True.  False = not quiescent within
+        ``timeout`` (or the worker died mid-drain).  The batcher stays
+        alive either way; :meth:`resume` re-opens admission — the
+        drain→restart→resume cycle is how the pool hot-reloads a replica
+        with zero dropped requests."""
+        deadline = Deadline.after(timeout) if timeout is not None else None
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            while (
+                self._queue
+                or self._admitting
+                or any(r is not None for r in self._slot_req)
+            ):
+                if self._stopped or self._worker_dead:
+                    return False
+                if deadline is not None and deadline.expired:
+                    return False
+                # periodic re-check (no completion signal targets this
+                # cv on retire); the bound rides the drain budget
+                wait_s = 0.1 if deadline is None else deadline.bound(0.1)
+                self._cv.wait(wait_s)
+            return True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._draining = False
+            self._cv.notify_all()
+
+    def steal_queued(self) -> List[_Request]:
+        """Atomically take every queued-but-unadmitted request (the pool
+        requeues them onto a healthy replica when this one wedges).  The
+        stolen requests are exactly the ones with no slot, no tokens, no
+        device state — safe to re-admit elsewhere."""
+        with self._cv:
+            out = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        return out
+
+    def fail_active(self, error: BaseException) -> None:
+        """Typed-fail every admitted (slot-resident) request — the pool's
+        fail-fast for a wedged replica being discarded.  Device state is
+        untouched (the wedged worker may still own it); callers must not
+        route new work here afterwards.  A worker that later un-wedges
+        and delivers tokens to a finished request is harmless: ``done``
+        is already set and ``_finish`` is idempotent."""
+        for slot in range(self.n_slots):
+            req = self._slot_req[slot]
+            if req is not None and not req.done.is_set():
+                req.error = error
+                _req_mark(req, "replica_failed", slot=slot)
+                _finish(req)
+
+    def kill(self, error: BaseException) -> None:
+        """Fail-fast teardown for a wedged replica: mark stopped (the
+        worker exits at its next wakeup — it is NOT joined, it may be
+        hung in a device fetch), fail everything typed.  Unlike
+        :meth:`stop` this never blocks on the worker thread."""
+        with self._cv:
+            self._stopped = True
+            # a killed batcher can never make progress again even though
+            # its (possibly hung) thread may linger: mark the worker dead
+            # so ``worker_alive`` reads False — submits fail typed
+            # WorkerDied, routing disqualifies it, and the pool's
+            # resume(rebuild=False) cannot re-open it in place
+            self._worker_dead = True
+            # admission-window requests fail TYPED here, never rescued:
+            # unlike a crashed worker, a wedged one may un-wedge later
+            # and deliver tokens into these very objects — re-admitting
+            # them elsewhere could interleave two replicas' tokens.
+            # (_finish is idempotent, so a zombie completing a
+            # failed-typed request is harmless.)
+            queued = self._admitting_reqs + list(self._queue)
+            self._admitting_reqs = []
+            self._admitting = 0
+            self._queue.clear()
+            self._cv.notify_all()
+        for req in queued:
+            if not req.done.is_set():
+                req.error = error
+                _req_mark(req, "replica_killed", queued=True)
+                _finish(req)
+        self.fail_active(error)
+
     @property
     def n_active(self) -> int:
         return sum(1 for r in self._slot_req if r is not None)
@@ -744,6 +981,22 @@ class ContinuousBatcher:
     @property
     def n_queued(self) -> int:
         return len(self._queue)
+
+    @property
+    def last_progress_age_s(self) -> float:
+        """Seconds since the worker last fetched a decode chunk —
+        ``inf`` until the first one.  Recent progress is stronger
+        liveness evidence than any synthetic probe."""
+        if not self._last_progress:
+            return float("inf")
+        return time_monotonic() - self._last_progress
+
+    @property
+    def n_admitting(self) -> int:
+        """Requests in the admission window: popped from the queue but
+        not yet slot-resident.  Work-pending for liveness purposes — a
+        worker wedged here shows 0 queued AND 0 active."""
+        return self._admitting
 
     # ---- worker loop ---------------------------------------------------------
 
@@ -914,6 +1167,12 @@ class ContinuousBatcher:
                 _req_mark(req, "decode_failed", slot=slot)
                 _finish(req)
                 self._slot_req[slot] = None
+        if self._stopped:
+            # a killed batcher never serves again — re-allocating a fresh
+            # KV cache here would waste HBM right as the pool's rebuild
+            # allocates the replacement replica's (and would undo the
+            # pool's device-state scrub of this shell)
+            return
         self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
         if self.mesh is not None and self.mesh.n_devices > 1:
             from docqa_tpu.parallel.sharding import shard_kv_cache
@@ -948,6 +1207,11 @@ class ContinuousBatcher:
         this chunk is poisoned and ``_fail_active`` has reset it."""
         t_fetch0 = _now()
         try:
+            # resilience_site: serve.decode_chunk — a delay rule here is
+            # a SLOW-DECODE replica (chunk rounds stretch, deadlines shed,
+            # the pool's canary/p95 hedging reacts); a raise is a decode
+            # failure (the _fail_active typed-error path below)
+            faults.perturb("serve.decode_chunk")
             # the span blocks until the chunk's device execution completes,
             # so serve_decode_chunk_ms keeps measuring real chunk rounds
             # (minus whatever host work the pipeline already overlapped) —
@@ -963,6 +1227,14 @@ class ContinuousBatcher:
             self._fail_active(e)
             return False
         t_fetch1 = _now()
+        # first chunk landed: all request-path shapes are compiled, so
+        # iteration time is now bounded by real chunk rounds — liveness
+        # monitoring (pool wedge detection, canaries) may engage
+        self._cold = False
+        # a fetched chunk is REAL liveness evidence (the full dispatch →
+        # device → fetch path just worked); the pool skips synthetic
+        # canaries while this stays fresh
+        self._last_progress = time_monotonic()
         if self.spec_k:
             width = self.chunk + 2 * self.spec_k
             out_h = packed_h[:, :width]
@@ -1022,7 +1294,16 @@ class ContinuousBatcher:
                 )
                 DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
                 _req_mark(req, "deadline_exceeded", stage="serve_decode")
-            if finished or expired:
+            # hedged-dispatch loser retires at this chunk boundary: the
+            # winning replica already owns the answer, so the lane frees
+            # for queued work instead of decoding a duplicate to the end
+            cancelled = not finished and not expired and req.cancelled
+            if cancelled and not req.done.is_set():
+                req.error = RequestCancelled("cancelled mid-decode")
+                _req_mark(
+                    req, "cancelled", anomalous=False, stage="serve_decode"
+                )
+            if finished or expired or cancelled:
                 deactivate.append(slot)
                 self._retire(slot)
         # tokens delivered per dispatch: with speculation this exceeds
@@ -1057,6 +1338,19 @@ class ContinuousBatcher:
                 # queue-wait is over either way (admitted or shed) —
                 # the stage BENCH_r05 could not see
                 _req_span(req, "serve_queue_wait", req.t_submit, _now())
+                if req.cancelled:
+                    # hedged-dispatch loser (or abandoned client) still
+                    # queued: drop before it costs a prefill lane
+                    if not req.done.is_set():
+                        req.error = RequestCancelled(
+                            "cancelled before admission"
+                        )
+                        _req_mark(
+                            req, "cancelled", anomalous=False,
+                            stage="serve_queue",
+                        )
+                        _finish(req)
+                    continue
                 if req.deadline is not None and req.deadline.expired:
                     req.error = DeadlineExceeded(
                         "serve_queue", -req.deadline.remaining()
@@ -1071,12 +1365,67 @@ class ContinuousBatcher:
                 filled = True
             if not self._queue and not filled:
                 break
+        # pairs are now this round's in-flight admissions (cumulative
+        # across the pipeline-drain top-up call); the worker clears the
+        # count once _admit_round has made them slot-resident
+        self._admitting = len(pairs)
+        self._admitting_reqs = [r for _, r in pairs]
         if drained:
             # wake bulk submitters blocked on queue capacity
             # (generate_texts waits on this condition, not a sleep poll)
             self._cv.notify_all()
 
     def _run(self) -> None:
+        """Worker entry: the loop body must NEVER die silently — a dead
+        daemon thread would strand every current and future request with
+        no error until their result timeouts (the exact hang the
+        replica-pool failover exists to prevent)."""
+        try:
+            self._run_loop()
+        except BaseException as e:
+            self._worker_died(e)
+
+    def _worker_died(self, e: BaseException) -> None:
+        """The loop crashed out: fail-fast every request with a TYPED
+        error.  Queued (unadmitted) requests are first offered to the
+        pool's ``on_worker_death`` hook, which requeues them onto a
+        healthy replica — only the unrescued remainder fails.  Admitted
+        requests always fail here (their KV state died with the worker);
+        the QA layer turns that into a degraded extractive answer."""
+        log.error("batcher worker died: %r — failing in-flight typed", e)
+        DEFAULT_REGISTRY.counter("serve_worker_deaths").inc()
+        with self._cv:
+            self._worker_dead = True
+            # admission-window requests (popped but never slot-resident)
+            # count as queued for rescue purposes: the dead worker can
+            # never touch them again, and like the queue they carry no
+            # tokens or device state — safe to re-admit elsewhere
+            queued = self._admitting_reqs + list(self._queue)
+            self._admitting_reqs = []
+            self._admitting = 0
+            self._queue.clear()
+            self._cv.notify_all()
+        cb = self.on_worker_death
+        if cb is not None:
+            try:
+                queued = list(cb(self, queued) or [])
+            except Exception:
+                log.exception("on_worker_death hook failed; failing queue")
+        err = WorkerDied(f"batcher worker died: {e!r}")
+        for req in queued:
+            if not req.done.is_set():
+                req.error = err
+                _req_mark(req, "worker_died", queued=True)
+                _finish(req)
+        for slot in range(self.n_slots):
+            req = self._slot_req[slot]
+            self._slot_req[slot] = None
+            if req is not None and not req.done.is_set():
+                req.error = err
+                _req_mark(req, "worker_died", slot=slot)
+                _finish(req)
+
+    def _run_loop(self) -> None:
         # The one dispatched-but-unprocessed decode chunk: (packed device
         # array, dispatch-time slot→request snapshot).  Invariant: no
         # admission happens between that chunk's dispatch and its
@@ -1084,6 +1433,13 @@ class ContinuousBatcher:
         # so the snapshot's live entries are always current occupants.
         pending: Optional[Tuple[jax.Array, List[Optional[_Request]]]] = None
         while True:
+            self._beat = time_monotonic()
+            # resilience_site: serve.worker_loop — a raise here is a
+            # worker CRASH (escapes to _worker_died: queued requests
+            # requeue via the pool, admitted fail typed); a delay rule is
+            # a worker WEDGE (the heartbeat goes stale mid-iteration and
+            # the pool's health monitor declares the replica dead)
+            faults.perturb("serve.worker_loop")
             pairs: List[Tuple[int, _Request]] = []
             with self._cv:
                 while (
@@ -1091,6 +1447,7 @@ class ContinuousBatcher:
                     and not self._queue
                     and not any(self._slot_req)
                 ):
+                    self._beat = time_monotonic()
                     self._cv.wait(0.5)
                 if self._stopped:
                     return
@@ -1125,6 +1482,13 @@ class ContinuousBatcher:
                     self._fail_active(e)
                     pending = None
                     continue
+                finally:
+                    # every pair is slot-resident or finished by now —
+                    # drain() may judge quiescence again
+                    with self._cv:
+                        self._admitting = 0
+                        self._admitting_reqs = []
+                        self._cv.notify_all()
             if not any(self._slot_req):
                 continue
             # one decode chunk for every live slot, dispatched BEFORE the

@@ -9,16 +9,27 @@ that is one global read and an immediate return.
 
 Instrumented sites (grep ``resilience_site:`` to enumerate):
 
-==================  ========================================================
-``broker.publish``  ``MemoryBroker.publish`` / ``AmqpBroker.publish`` —
-                    raising here simulates a dropped broker connection
-``extract``         ``DocumentPipeline.ingest_document``, before extraction
-``deid``            ``DocumentPipeline._deid_handler``, before the NER batch
-``index``           ``DocumentPipeline._index_handler``, before encoding
-``decoder``         ``QAService`` generation submission — a raise here is a
-                    decoder outage (the degraded-mode trigger)
-``checkpoint.load`` ``models/hf_checkpoint.load_checkpoint_dir`` weight read
-==================  ========================================================
+=====================  =====================================================
+``broker.publish``     ``MemoryBroker.publish`` / ``AmqpBroker.publish`` —
+                       raising here simulates a dropped broker connection
+``extract``            ``DocumentPipeline.ingest_document``, before
+                       extraction
+``deid``               ``DocumentPipeline._deid_handler``, before the NER
+                       batch
+``index``              ``DocumentPipeline._index_handler``, before encoding
+``decoder``            ``QAService`` generation submission — a raise here is
+                       a decoder outage (the degraded-mode trigger)
+``checkpoint.load``    ``models/hf_checkpoint.load_checkpoint_dir`` weight
+                       read
+``serve.worker_loop``  top of every ``ContinuousBatcher`` worker iteration —
+                       a raise is a replica worker CRASH (queued requests
+                       fail over via the pool, admitted fail typed); a pure
+                       delay (``noerror``) is a worker WEDGE (heartbeat goes
+                       stale, the pool declares the replica dead)
+``serve.decode_chunk`` before each decode chunk's device fetch — a delay is
+                       a SLOW-DECODE replica; a raise is a decode failure
+                       (typed errors via ``_fail_active``, batcher survives)
+=====================  =====================================================
 
 A :class:`FaultPlan` is a list of :class:`FaultRule`; each rule matches a
 site and fires either at explicit call indices (``at_steps``) or with

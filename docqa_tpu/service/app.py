@@ -239,15 +239,21 @@ class DocQARuntime:
             self.generator = GenerateEngine(
                 self.cfg.decoder, gen=self.cfg.generate, mesh=self.mesh
             )
-        # Continuous batcher: the serving path for ALL generation (BASELINE
-        # config 5, QPS 16) — concurrent requests share decode-slot lanes of
-        # one jit program instead of serializing whole requests.
+        # Decode-engine POOL: the single submit surface for ALL generation
+        # (BASELINE config 5, QPS 16 — and ROADMAP item 5's scale-out
+        # spine).  The pool owns N ContinuousBatcher replicas with a
+        # liveness contract each (worker heartbeat, canary generate,
+        # per-replica breaker): a replica that dies or wedges fails over
+        # instead of stranding every in-flight and queued request until a
+        # process restart — the serving plane's old single point of
+        # failure.  replicas=1 (default) keeps one batcher's economics
+        # while retaining fail-fast, drain, and /api/pool.
         if self.cfg.flags.use_fake_llm:
             self.batcher = None
         else:
-            from docqa_tpu.engines.serve import ContinuousBatcher
+            from docqa_tpu.engines.pool import EnginePool
 
-            self.batcher = ContinuousBatcher(self.generator)
+            self.batcher = EnginePool(self.generator, cfg=self.cfg.pool)
         summarizer_cfg = self.cfg.summarizer
         instruction_prompts = True
         if (
@@ -669,11 +675,103 @@ def make_app(rt: DocQARuntime):
                 # per-dependency breaker states (closed/half_open/open):
                 # an "open" here is WHY /ask answers are degraded right now
                 "breakers": rt.breakers.states(),
+                # decode-pool summary (full detail on /api/pool): replica
+                # health at a glance — a dead/draining replica here is WHY
+                # capacity halved or requests briefly parked
+                "pool": (
+                    rt.batcher.status()
+                    if hasattr(rt.batcher, "status")
+                    else None
+                ),
             }
         )
 
     async def metrics(_req):
         return web.json_response(DEFAULT_REGISTRY.snapshot())
+
+    # ---- decode-engine pool (docs/OPERATIONS.md "Replica pool") -------------
+
+    def _pool_or_none():
+        # duck-typed: the pool surface is whatever rt.batcher exposes;
+        # fake-llm runtimes have no batcher at all
+        b = rt.batcher
+        return b if b is not None and hasattr(b, "rolling_restart") else None
+
+    async def api_pool(_req):
+        pool = _pool_or_none()
+        if pool is None:
+            return json_error(404, "no decode pool (fake-llm runtime)")
+        return web.json_response(pool.status())
+
+    async def api_pool_drain(req):
+        """Drain one replica (stop admitting → finish in-flight).  Body
+        ``{"replica": i, "timeout": s}``; the replica stays drained until
+        /api/pool/resume — the hot-restart window."""
+        pool = _pool_or_none()
+        if pool is None:
+            return json_error(404, "no decode pool (fake-llm runtime)")
+        body = {}
+        if req.can_read_body:
+            try:
+                body = await req.json()
+            except Exception:
+                return json_error(422, "body must be JSON")
+        replica = body.get("replica", 0)
+        try:
+            timeout = float(body.get("timeout", 30.0))
+        except (TypeError, ValueError):
+            return json_error(422, "timeout must be a number")
+        if not isinstance(replica, int) or not (
+            0 <= replica < pool.n_replicas
+        ):
+            return json_error(
+                422, f"replica must be 0..{pool.n_replicas - 1}"
+            )
+        return web.json_response(
+            await on_host(pool.drain, replica, timeout)
+        )
+
+    async def api_pool_resume(req):
+        pool = _pool_or_none()
+        if pool is None:
+            return json_error(404, "no decode pool (fake-llm runtime)")
+        body = {}
+        if req.can_read_body:
+            try:
+                body = await req.json()
+            except Exception:
+                return json_error(422, "body must be JSON")
+        replica = body.get("replica", 0)
+        if not isinstance(replica, int) or not (
+            0 <= replica < pool.n_replicas
+        ):
+            return json_error(
+                422, f"replica must be 0..{pool.n_replicas - 1}"
+            )
+        return web.json_response(
+            await on_host(
+                pool.resume, replica, bool(body.get("rebuild", False))
+            )
+        )
+
+    async def api_pool_rolling_restart(req):
+        """Drain → rebuild → resume every replica in turn (hot restart /
+        weight reload with zero dropped requests).  Used by the
+        ``--supervise`` launcher for planned restarts."""
+        pool = _pool_or_none()
+        if pool is None:
+            return json_error(404, "no decode pool (fake-llm runtime)")
+        timeout = 30.0
+        if req.can_read_body:
+            try:
+                timeout = float(
+                    (await req.json()).get("timeout_per_replica", 30.0)
+                )
+            except Exception:
+                pass
+        return web.json_response(
+            await on_host(pool.rolling_restart, timeout)
+        )
 
     # ---- observability (docs/OBSERVABILITY.md) ------------------------------
 
@@ -1091,6 +1189,10 @@ def make_app(rt: DocQARuntime):
             web.get("/metrics", metrics),
             web.get("/api/traces", api_traces),
             web.get("/api/trace/{trace_id}", api_trace_one),
+            web.get("/api/pool", api_pool),
+            web.post("/api/pool/drain", api_pool_drain),
+            web.post("/api/pool/resume", api_pool_resume),
+            web.post("/api/pool/rolling_restart", api_pool_rolling_restart),
             web.post("/api/profiler/start", profiler_start),
             web.post("/api/profiler/stop", profiler_stop),
             web.post("/ingest/", ingest),

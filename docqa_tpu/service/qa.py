@@ -21,7 +21,11 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from docqa_tpu import obs
-from docqa_tpu.engines.serve import DEFAULT_RESULT_TIMEOUT, QueueFull
+from docqa_tpu.engines.serve import (
+    DEFAULT_RESULT_TIMEOUT,
+    QueueFull,
+    WorkerDied,
+)
 from docqa_tpu.resilience import faults
 from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
@@ -116,6 +120,17 @@ class PendingAnswer:
             if self.breaker is not None:
                 self.breaker.record_failure()
             return self._degrade("decode_timeout")
+        except WorkerDied as e:
+            # a pool replica died/wedged with this request ADMITTED —
+            # fail-fast by design (queued requests fail over instead);
+            # the reason names it so a trace distinguishes replica loss
+            # from a device decode error
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            log.warning(
+                "decode replica died; serving degraded answer: %r", e
+            )
+            return self._degrade("replica_died")
         except Exception as e:  # decode failed on device
             if self.breaker is not None:
                 self.breaker.record_failure()

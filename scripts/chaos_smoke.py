@@ -1,18 +1,29 @@
 #!/usr/bin/env python
 """Chaos smoke: the in-memory pipeline under a random-but-seeded FaultPlan.
 
-Runs ingest → deid → index end to end while injecting broker publish
-drops, slow/failing deid batches, and index-stage failures at seeded
-random call sites (docs/RESILIENCE.md §5), then asserts **zero lost
-documents**: every ingested document must end in a terminal state —
+Phase 1 (documents) runs ingest → deid → index end to end while injecting
+broker publish drops, slow/failing deid batches, and index-stage failures
+at seeded random call sites (docs/RESILIENCE.md §5), then asserts **zero
+lost documents**: every ingested document must end in a terminal state —
 INDEXED (its chunks present in the store), or a terminal ERROR_* status
 (dead-lettered / failed at ingest after retries).  Nothing silently
 dropped, nothing stuck in flight, no queue residue.
+
+Phase 2 (requests; ``--replica-kill``, docs/OPERATIONS.md "Replica
+pool") drives a 2-replica ``EnginePool`` under seeded replica faults — a
+worker CRASH (``serve.worker_loop`` raise) and a worker WEDGE (pure
+delay, heartbeat goes stale) — plus a drain + rebuild of one replica
+under load, then asserts **zero lost requests**: every submitted request
+either completes with tokens or fails with a TYPED error
+(WorkerDied / DeadlineExceeded / QueueFull) inside its deadline.  A
+request that HANGS past its deadline is a loss — the exact failure mode
+the pool's failover exists to prevent.
 
 Deterministic: the same --seed perturbs the same calls every run, so a
 failure here is replayable with the printed command line.
 
     python scripts/chaos_smoke.py --seed 7 --docs 24
+    python scripts/chaos_smoke.py --seed 7 --replica-kill
 """
 
 import argparse
@@ -25,10 +36,214 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _dump_traces(path: str, extra: dict) -> None:
+    """Flight-recorder dump (open + anomalous + recent timelines) so a
+    red chaos run is replayable AND inspectable post-hoc."""
+    from docqa_tpu import obs
+
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    **extra,
+                    "open": [
+                        obs.timeline_dict(t)
+                        for t in obs.DEFAULT_RECORDER.open_traces()
+                    ],
+                    "anomalous": [
+                        obs.timeline_dict(t)
+                        for t in obs.DEFAULT_RECORDER.anomalous(100)
+                    ],
+                    "recent": [
+                        obs.timeline_dict(t)
+                        for t in obs.DEFAULT_RECORDER.recent(100)
+                    ],
+                },
+                f,
+                indent=1,
+            )
+        print(f"flight recorder dumped to {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"flight-recorder dump failed: {e!r}", file=sys.stderr)
+
+
+def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
+    """Phase 2: seeded replica kills/wedges against a 2-replica pool.
+
+    Three chaos windows over one pool:
+      1. worker CRASH mid-traffic (``serve.worker_loop`` raise) — queued
+         requests must fail over, admitted ones must fail typed;
+      2. worker WEDGE (pure delay > heartbeat_max_age) — the health
+         monitor must declare the replica dead and fail over the same
+         way, with nobody waiting out a ResultTimeout;
+      3. ``drain()`` + rebuild of replica 0 WITH requests in flight —
+         the drain must finish them and the pool must keep serving.
+
+    Zero lost requests == every submission resolves (tokens or typed
+    error) within its deadline."""
+    import threading
+
+    from docqa_tpu import obs
+    from docqa_tpu.config import DecoderConfig, GenerateConfig
+    from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.engines.pool import EnginePool
+    from docqa_tpu.engines.serve import QueueFull, ResultTimeout, WorkerDied
+    from docqa_tpu.resilience import FaultPlan, FaultRule
+    from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
+
+    engine = GenerateEngine(
+        DecoderConfig(
+            vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=256,
+            dtype="float32",
+        ),
+        GenerateConfig(temperature=0.0, prefill_buckets=(16, 32), eos_id=2),
+        seed=7,
+    )
+    pool = EnginePool(
+        engine,
+        replicas=2,
+        n_slots=2,
+        chunk=4,
+        cache_len=128,
+        # tight liveness so the smoke's wedge window is seconds, not the
+        # production minute (every shape is pre-warmed below)
+        heartbeat_max_age_s=1.0,
+        canary_interval_s=0.5,
+        canary_timeout_s=5.0,
+        health_interval_s=0.05,
+        breaker_reset_s=0.2,
+    )
+    outcomes: list = []
+    lock = threading.Lock()
+
+    def submit_wave(tag: str, n: int, deadline_s: float = 30.0):
+        waiters = []
+        for i in range(n):
+            try:
+                h = pool.submit_ids(
+                    [3 + i % 13, 5, 9, 4 + i % 3],
+                    max_new_tokens=6,
+                    deadline=Deadline.after(deadline_s),
+                )
+            except (QueueFull, DeadlineExceeded) as e:
+                with lock:
+                    outcomes.append((tag, i, "typed_at_submit", repr(e)))
+                continue
+
+            def wait_one(idx=i, handle=h):
+                t0 = time.monotonic()
+                try:
+                    toks = handle.result(timeout=deadline_s + 10.0)
+                    out = ("ok", f"{len(toks)} tokens")
+                except (WorkerDied, DeadlineExceeded, QueueFull) as e:
+                    out = ("typed", repr(e))
+                except ResultTimeout as e:
+                    # the hang the failover exists to prevent
+                    out = ("HUNG", repr(e))
+                except Exception as e:
+                    out = ("untyped", repr(e))
+                if time.monotonic() - t0 > deadline_s + 9.0:
+                    out = ("HUNG", out[1])
+                with lock:
+                    outcomes.append((tag, idx, *out))
+
+            w = threading.Thread(target=wait_one)
+            w.start()
+            waiters.append(w)
+        return waiters
+
+    t0 = time.monotonic()
+    try:
+        pool.warmup()
+        # -- window 1: seeded worker crash under load
+        plan = FaultPlan(
+            [FaultRule("serve.worker_loop", at_steps=(6,))], seed=seed
+        )
+        with plan:
+            waiters = submit_wave("crash", n_requests)
+            for w in waiters:
+                w.join()
+        crash_fired = len(plan.log)
+        # -- window 2: worker wedge (pure delay, no raise) under load
+        plan = FaultPlan(
+            [
+                FaultRule(
+                    "serve.worker_loop", at_steps=(4,), delay_s=2.5,
+                    raise_error=False,
+                )
+            ],
+            seed=seed,
+        )
+        with plan:
+            waiters = submit_wave("wedge", n_requests)
+            for w in waiters:
+                w.join()
+        wedge_fired = len(plan.log)
+        # -- window 3: drain + rebuild replica 0 with requests in flight
+        waiters = submit_wave("drain", n_requests)
+        drained = pool.drain(0, timeout=30.0)
+        pool.resume(0, rebuild=True)
+        for w in waiters:
+            w.join()
+        # post-chaos: the pool must still serve cleanly
+        waiters = submit_wave("after", 4)
+        for w in waiters:
+            w.join()
+    finally:
+        status = pool.status()
+        pool.stop()
+
+    hung = [o for o in outcomes if o[2] == "HUNG"]
+    untyped = [o for o in outcomes if o[2] == "untyped"]
+    ok = [o for o in outcomes if o[2] == "ok"]
+    typed = [o for o in outcomes if o[2] in ("typed", "typed_at_submit")]
+    after_bad = [
+        o for o in outcomes if o[0] == "after" and o[2] != "ok"
+    ]
+    deaths = sum(r["deaths"] for r in status["replicas"])
+    print(
+        f"replica chaos seed={seed} requests={len(outcomes)} "
+        f"elapsed={time.monotonic() - t0:.1f}s\n"
+        f"  ok={len(ok)} typed={len(typed)} hung={len(hung)} "
+        f"untyped={len(untyped)} replica_deaths={deaths} "
+        f"crash_faults={crash_fired} wedge_faults={wedge_fired} "
+        f"drain_ok={drained['drained']}"
+    )
+    lost = bool(hung or untyped or after_bad)
+    if lost or not drained["drained"]:
+        print(
+            f"LOST REQUESTS: hung={hung} untyped={untyped} "
+            f"after_restart_failed={after_bad} drain={drained}",
+            file=sys.stderr,
+        )
+        _dump_traces(
+            f"chaos_traces_seed{seed}.json",
+            {"seed": seed, "phase": "replica_kill",
+             "hung": hung, "untyped": untyped},
+        )
+        return 1
+    n_anom = len(obs.DEFAULT_RECORDER.anomalous(100))
+    print(
+        "zero lost requests — every submission completed or failed typed "
+        f"inside its deadline ({n_anom} anomalous timeline(s) recorded)"
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--docs", type=int, default=16)
+    ap.add_argument(
+        "--replica-kill", action="store_true",
+        help="also run the decode-pool replica kill/wedge/drain phase "
+        "(zero-lost-requests assertion)",
+    )
+    ap.add_argument(
+        "--replica-requests", type=int, default=24,
+        help="requests per replica-kill chaos window",
+    )
     ap.add_argument("--publish-p", type=float, default=0.25,
                     help="probability a broker publish drops (per call)")
     ap.add_argument("--deid-p", type=float, default=0.25,
@@ -194,6 +409,8 @@ def main() -> int:
         "zero lost documents — every doc acked, dead-lettered, or indexed "
         f"({n_anom} anomalous timeline(s) in the flight recorder)"
     )
+    if args.replica_kill:
+        return replica_kill_chaos(args.seed, args.replica_requests)
     return 0
 
 

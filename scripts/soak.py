@@ -8,8 +8,13 @@ Start the service first (any backend):
     python scripts/soak.py [base_url]
 
 Exercises the races round 3 hardened: deletes against in-flight
-documents, erasure vs replay, concurrent /ask during ingest.  Exits
-non-zero on any consistency violation.
+documents, erasure vs replay, concurrent /ask during ingest.  When the
+service runs a real decode pool (GET /api/pool answers 200), the soak
+also triggers a POST /api/pool/rolling_restart MID-LOAD and asserts the
+restart reports zero dropped work and /ask traffic keeps resolving —
+the drain → rebuild → resume cycle under concurrent traffic
+(docs/OPERATIONS.md "Replica pool").  Exits non-zero on any consistency
+violation.
 """
 import json
 import random
@@ -107,10 +112,44 @@ def deleter(n):
             with lock:
                 results["errors"].append(f"delete {doc}: {e!r}")
 
+def pool_restarter():
+    """Mid-soak rolling restart of the decode pool (when one exists):
+    every replica drains, rebuilds, resumes WHILE the askers run.  The
+    restart must report ok and must not convert asks into errors beyond
+    the typed 503s the askers already tolerate."""
+    time.sleep(2.0)  # let load build first
+    try:
+        st, pool = req("GET", "/api/pool", timeout=10)
+    except urllib.error.HTTPError:
+        results["pool"] = "absent (fake-llm runtime); restart not exercised"
+        return
+    except Exception as e:
+        results["errors"].append(f"pool status: {e!r}")
+        return
+    try:
+        st, out = req(
+            "POST", "/api/pool/rolling_restart",
+            json.dumps({"timeout_per_replica": 60.0}).encode(),
+            {"Content-Type": "application/json"},
+            timeout=300,
+        )
+        if st != 200 or not out.get("ok"):
+            results["errors"].append(f"rolling restart not ok: {st} {out}")
+        else:
+            results["pool"] = {
+                "replicas": len(pool.get("replicas", [])),
+                "rolling_restart": "ok",
+                "drained": [s.get("drained") for s in out.get("replicas", [])],
+            }
+    except Exception as e:
+        results["errors"].append(f"rolling restart: {e!r}")
+
+
 threads = (
     [threading.Thread(target=uploader, args=(30,))]
     + [threading.Thread(target=asker, args=(25,)) for _ in range(3)]
     + [threading.Thread(target=deleter, args=(10,))]
+    + [threading.Thread(target=pool_restarter)]
 )
 t0 = time.time()
 for t in threads:
@@ -178,6 +217,7 @@ print(json.dumps({
     "live_docs_expected": live_expected,
     "queue_depths": status.get("queue_depths"),
     "dead_letters": status.get("dead_letters"),
+    "pool": results.get("pool"),
 }, indent=1))
 if results["errors"] or bad:
     dump_flight_recorder(

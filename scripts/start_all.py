@@ -31,6 +31,33 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _pool_rolling_restart(port: int, timeout_per_replica: float = 60.0) -> bool:
+    """POST /api/pool/rolling_restart — drain → rebuild → resume each
+    decode replica in turn, zero dropped requests (docs/OPERATIONS.md
+    "Replica pool").  Returns True when the server reports the restart
+    completed ok; False on any failure (no pool, wedged HTTP loop, a
+    replica that would not drain) — the caller escalates to a process
+    restart then."""
+    import json as _json
+
+    url = f"http://127.0.0.1:{port}/api/pool/rolling_restart"
+    body = _json.dumps(
+        {"timeout_per_replica": timeout_per_replica}
+    ).encode()
+    try:
+        r = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(r, timeout=timeout_per_replica * 4 + 30) as resp:
+            out = _json.loads(resp.read().decode() or "{}")
+        return resp.status == 200 and bool(out.get("ok"))
+    except Exception as e:
+        print(f"supervisor: pool rolling restart failed: {e!r}",
+              file=sys.stderr)
+        return False
+
+
 def supervise(child_args, port: int, pid_file: str | None) -> int:
     """Restart-on-failure loop: spawn the server, poll /health, restart on
     exit or sustained unresponsiveness.  Clean exit (rc 0) ends the loop.
@@ -39,6 +66,15 @@ def supervise(child_args, port: int, pid_file: str | None) -> int:
       first boot may train the PHI tagger, restore a large snapshot, and
       pay XLA compiles before binding the port; killing a booting server
       would loop forever.
+    * On sustained health failure the supervisor FIRST tries a replica
+      pool rolling restart (POST /api/pool/rolling_restart): a wedged
+      decode worker with a live HTTP loop recovers replica-by-replica
+      with zero dropped requests, where a process kill would drop every
+      in-flight one.  Only when the rolling restart cannot help (HTTP
+      loop itself wedged, no pool, restart reports failure) does it
+      escalate to the process kill.
+    * SIGHUP triggers a PLANNED rolling restart (hot restart / weight
+      reload) without touching the process.
     * SIGTERM/SIGINT to the supervisor are forwarded to the child (then
       escalated to SIGKILL after a grace) so stopping the supervisor never
       orphans a server holding the port.
@@ -49,6 +85,7 @@ def supervise(child_args, port: int, pid_file: str | None) -> int:
     backoff = 1.0
     current = {"proc": None}
     stopping = {"flag": False}
+    hup = {"flag": False}
 
     def _shutdown(signum, frame):
         del signum, frame
@@ -57,8 +94,13 @@ def supervise(child_args, port: int, pid_file: str | None) -> int:
         if proc is not None and proc.poll() is None:
             proc.terminate()
 
+    def _hup(signum, frame):
+        del signum, frame
+        hup["flag"] = True
+
     _signal.signal(_signal.SIGTERM, _shutdown)
     _signal.signal(_signal.SIGINT, _shutdown)
+    _signal.signal(_signal.SIGHUP, _hup)
 
     while not stopping["flag"]:
         proc = subprocess.Popen([sys.executable, *child_args])
@@ -70,6 +112,13 @@ def supervise(child_args, port: int, pid_file: str | None) -> int:
         misses = 0
         while proc.poll() is None and not stopping["flag"]:
             time.sleep(2.0)
+            if hup["flag"]:
+                hup["flag"] = False
+                print(
+                    "supervisor: SIGHUP — rolling replica restart",
+                    file=sys.stderr,
+                )
+                _pool_rolling_restart(port)
             try:
                 with urllib.request.urlopen(health, timeout=2) as r:
                     ok = r.status == 200
@@ -82,6 +131,16 @@ def supervise(child_args, port: int, pid_file: str | None) -> int:
             elif ever_healthy:  # was up, now unresponsive
                 misses += 1
                 if misses >= 5:  # ~10 s wedged
+                    # replica-level recovery first: zero dropped requests
+                    # if the wedge is a decode worker, not the HTTP loop
+                    if _pool_rolling_restart(port):
+                        print(
+                            "supervisor: pool rolling restart recovered "
+                            "the server; process kept",
+                            file=sys.stderr,
+                        )
+                        misses = 0
+                        continue
                     print(
                         "supervisor: health checks failing; restarting",
                         file=sys.stderr,

@@ -205,3 +205,52 @@ class TestDeidEngine:
         doc = " ".join(f"word{i}" for i in range(800))
         out = eng.deidentify_batch([doc])
         assert len(out) == 1 and len(out[0]) > 0
+
+
+class TestLanguageRegister:
+    """VERDICT item 8: ``language`` must DO something.  The chosen
+    behavior (pinned here): it selects the DATE_TIME pattern register —
+    default "fr" (the reference's actual data language, NLP_LANG)
+    keeps the combined French+English forms; "en" drops the French-only
+    month/weekday alternations.  Threaded cfg → engine → analyze."""
+
+    def test_default_is_fr_and_masks_french_dates(self):
+        eng = DeidEngine(CFG, use_ner_model=False)
+        assert eng.language == "fr"
+        out = eng.anonymize("Vu le 3 juin 2026 pour un suivi.")
+        assert "<DATE_TIME>" in out and "juin" not in out
+
+    def test_fr_register_keeps_english_forms(self):
+        # French clinical prose quotes English-labeled reports: the fr
+        # register must still mask English dates
+        eng = DeidEngine(CFG, use_ner_model=False)
+        out = eng.anonymize("Imaging report dated March 5, 2024.")
+        assert "<DATE_TIME>" in out
+
+    def test_en_register_drops_french_months(self):
+        eng = DeidEngine(CFG, use_ner_model=False)
+        spans = eng.analyze("Seen on 3 juin 2026.", language="en")
+        assert not any(r.entity_type == "DATE_TIME" for r in spans)
+        spans = eng.analyze("Seen on March 5, 2024.", language="en")
+        assert any(r.entity_type == "DATE_TIME" for r in spans)
+
+    def test_cfg_language_is_engine_default(self):
+        import dataclasses
+
+        en_cfg = dataclasses.replace(CFG, language="en")
+        eng = DeidEngine(en_cfg, use_ner_model=False)
+        assert eng.language == "en"
+        spans = eng.analyze("Le 3 juin 2026.")  # engine default applies
+        assert not any(r.entity_type == "DATE_TIME" for r in spans)
+
+    def test_explicit_language_overrides_default(self):
+        eng = DeidEngine(CFG, use_ner_model=False)  # default fr
+        spans = eng.analyze("Le 3 juin 2026.", language="fr")
+        assert any(r.entity_type == "DATE_TIME" for r in spans)
+
+    def test_weekday_register(self):
+        eng = DeidEngine(CFG, use_ner_model=False)
+        fr = eng.analyze("Retour mardi prochain.")
+        assert any(r.entity_type == "DATE_TIME" for r in fr)
+        en = eng.analyze("Retour mardi prochain.", language="en")
+        assert not any(r.entity_type == "DATE_TIME" for r in en)

@@ -11,6 +11,7 @@ serialized completely.  These tests pin the fix:
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -124,6 +125,15 @@ class TestConcurrentAsk:
                         return await r.json()
 
                 warmup = await one()  # compile prefill + decode programs
+                # on a loaded host the first ask can burn its whole
+                # request deadline inside residual compiles and come
+                # back degraded — that IS the production contract, so
+                # keep asking (bounded) until the path is genuinely
+                # warm and the real batcher answer arrives
+                t_end = time.monotonic() + 120
+                while warmup.get("degraded") and time.monotonic() < t_end:
+                    warmup = await one()
+                assert not warmup.get("degraded"), warmup
 
                 c0 = chunks.count
                 sequential = []
@@ -156,3 +166,117 @@ class TestConcurrentAsk:
         before = DEFAULT_REGISTRY.counter("serve_completed").value
         rt.qa.ask("lisinopril dose?")
         assert DEFAULT_REGISTRY.counter("serve_completed").value > before
+
+
+class TestPoolEndpoints:
+    """/api/pool surface (docs/OPERATIONS.md "Replica pool") against the
+    runtime's real EnginePool — status, drain/resume roundtrip under a
+    live ask, validation, and the fake-llm 404."""
+
+    def test_status_drain_resume_roundtrip(self, rt):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def drive():
+            client = TestClient(TestServer(make_app(rt)))
+            await client.start_server()
+            try:
+                resp = await client.get("/api/pool")
+                assert resp.status == 200
+                st = await resp.json()
+                assert len(st["replicas"]) == 1
+                assert st["replicas"][0]["state"] == "healthy"
+                assert st["replicas"][0]["worker_alive"] is True
+
+                # /api/status carries the pool summary too
+                resp = await client.get("/api/status")
+                assert (await resp.json())["pool"]["replicas"]
+
+                # validation: out-of-range replica is a 422, not a crash
+                resp = await client.post(
+                    "/api/pool/drain", json={"replica": 7}
+                )
+                assert resp.status == 422
+
+                resp = await client.post(
+                    "/api/pool/drain", json={"replica": 0, "timeout": 60}
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["drained"] is True
+                assert (await (await client.get("/api/pool")).json())[
+                    "replicas"
+                ][0]["state"] == "draining"
+
+                resp = await client.post(
+                    "/api/pool/resume", json={"replica": 0}
+                )
+                assert resp.status == 200
+                assert (await (await client.get("/api/pool")).json())[
+                    "replicas"
+                ][0]["state"] == "healthy"
+
+                # the pool serves after the drain/resume cycle
+                resp = await client.post(
+                    "/ask/", json={"question": "aspirin dose?"}
+                )
+                assert resp.status == 200
+                assert (await resp.json())["answer"]
+            finally:
+                await client.close()
+
+        asyncio.run(drive())
+
+    def test_rolling_restart_endpoint(self, rt):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def drive():
+            client = TestClient(TestServer(make_app(rt)))
+            await client.start_server()
+            try:
+                gen_before = (await (await client.get("/api/pool")).json())[
+                    "replicas"
+                ][0]["generation"]
+                resp = await client.post(
+                    "/api/pool/rolling_restart",
+                    json={"timeout_per_replica": 120},
+                )
+                assert resp.status == 200
+                out = await resp.json()
+                assert out["ok"] is True
+                st = (await (await client.get("/api/pool")).json())
+                assert st["replicas"][0]["generation"] == gen_before + 1
+                assert st["replicas"][0]["state"] == "healthy"
+                # fresh replica (fresh KV cache) answers identically
+                resp = await client.post(
+                    "/ask/", json={"question": "aspirin dose?"}
+                )
+                assert resp.status == 200
+                assert (await resp.json())["answer"]
+            finally:
+                await client.close()
+
+        asyncio.run(drive())
+
+    def test_fake_llm_runtime_404(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cfg = load_config(
+            env={}, overrides={**TINY, "flags.use_fake_llm": True}
+        )
+        fake_rt = DocQARuntime(cfg).start()
+
+        async def drive():
+            client = TestClient(TestServer(make_app(fake_rt)))
+            await client.start_server()
+            try:
+                assert (await client.get("/api/pool")).status == 404
+                assert (
+                    await client.post("/api/pool/drain", json={"replica": 0})
+                ).status == 404
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            fake_rt.stop()
