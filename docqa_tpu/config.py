@@ -457,6 +457,44 @@ class PoolConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Time-series telemetry + SLO burn-rate policy (``obs/telemetry.py``
+    / ``obs/slo.py``; docqa-telemetry, docs/OBSERVABILITY.md "Time
+    series, SLOs, and /metrics").
+
+    The sampler scrapes the live serving plane every ``sample_every_s``
+    into ``interval_s × points`` rollup windows (default 10 s × 360 =
+    one hour), serves them on ``GET /api/telemetry`` and as Prometheus
+    text on ``GET /metrics``, and evaluates the /ask SLOs once per
+    tick — a firing burn-rate alert flags the window's traces anomalous
+    in the flight recorder (the "SLO burning → exact timelines" loop)."""
+
+    enabled: bool = True
+    interval_s: float = 10.0
+    points: int = 360
+    sample_every_s: float = 2.0
+    # HBM working-set probe (GenerateEngine.decode_memory_analysis)
+    # re-lowers and re-compiles per call: refresh rarely (first probe
+    # one period after boot — never inside the warmup compile storm);
+    # 0 disables
+    hbm_refresh_s: float = 600.0
+    # /ask objectives: p95 latency threshold, availability (non-5xx)
+    # target, degraded-answer budget.  The p95 default tracks the
+    # resilience deadline economics: well under request_deadline_s (8 s)
+    # so the alert fires while requests still SUCCEED slowly, not only
+    # once they shed.
+    slo_ask_p95_ms: float = 2500.0
+    slo_ask_availability: float = 0.99
+    slo_ask_degraded_budget: float = 0.05
+    # burn-rate evaluation: both windows (in rollup-window units) must
+    # exceed burn_threshold to fire; short clears it after clear_windows
+    # calm windows
+    slo_short_windows: int = 2
+    slo_long_windows: int = 30
+    slo_burn_threshold: float = 4.0
+
+
+@dataclass(frozen=True)
 class GenerateConfig:
     """Decode-loop policy."""
 
@@ -509,6 +547,7 @@ class Config:
     generate: GenerateConfig = field(default_factory=GenerateConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     pool: PoolConfig = field(default_factory=PoolConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

@@ -227,10 +227,103 @@ _MARKED_TEST: Sequence[str] = (
     "stability and fall prevention.",
 )
 
+# ---- HELD-OUT split (ISSUE 7 satellite / ROADMAP carry-forward) ------------
+# Written fresh for PR 7 and NEVER scored during any tuning round: no
+# threshold, deny-word, cue, or recognizer change may be made against
+# these spans — the moment one is, this block must be renamed a dev set
+# and a new held-out split written (the fate that befell _MARKED_TEST in
+# round 5).  Registers and shapes beyond both earlier splits: radiology
+# and endoscopy reports, psychiatric/behavioral notes, discharge
+# instructions addressed to the patient in second person, lab-callback
+# and after-hours triage phone logs, school/work clearance forms,
+# dietitian and wound-care consults, French appointment-reminder prose,
+# diacritic and particle-heavy names, dotted/spaced phone formats,
+# quoted-speech attributions, and sentence-initial dates.
+_MARKED_HELDOUT: Sequence[str] = (
+    # radiology / procedure reports
+    "CT abdomen read by [PERSON:Dr. Søren Østergaard] on "
+    "[DATE_TIME:2026-07-14]; wet read phoned to the floor at "
+    "[PHONE_NUMBER:617.555.0155].",
+    "Endoscopy: [PERSON:Marguerite Beauchamp-Laurent] tolerated the "
+    "procedure; biopsies labeled and couriered to [LOCATION:Burlington] "
+    "for processing.",
+    "Comparison film from [DATE_TIME:November 2025] requested from the "
+    "imaging center in [LOCATION:Nashua]; release signed by "
+    "[PERSON:Mr. Takeshi Yamamoto].",
+    # psychiatric / behavioral health
+    # (the 988 crisis line is a public hotline, not PHI — deliberately
+    # unmarked; masking it would not reduce leak risk)
+    "Patient [PERSON:Caleb Wojciechowski] presents with low mood since "
+    "[DATE_TIME:early June]; safety plan reviewed, partner aware, "
+    "crisis line 988 provided.",
+    "Group session attended; [PERSON:Yolanda Mbeki] reports improved "
+    "sleep since relocating from [LOCATION:Dorchester] to her "
+    "cousin's place.",
+    # discharge instructions, second person
+    "You should call [PERSON:Dr. Anaïs Dupont-Rivière] at "
+    "[PHONE_NUMBER:413 555 0162] if the swelling returns before "
+    "[DATE_TIME:your visit on August 4].",
+    "Your follow-up is scheduled for [DATE_TIME:September 1, 2026] at "
+    "the clinic in [LOCATION:Pawtucket]; bring this sheet with you.",
+    # lab callback / after-hours phone log
+    "After-hours log: spoke with [PERSON:Mrs. Eun-Ji Park] regarding "
+    "the potassium result; she will recheck at the "
+    "[LOCATION:Woonsocket] lab [DATE_TIME:tomorrow at 8:15].",
+    "Critical value called to the covering resident, read back "
+    "confirmed; patient's spouse [PERSON:Gerald Okonkwo-Hughes] "
+    "notified at [PHONE_NUMBER:+1 (401) 555-0170].",
+    # school / work clearance
+    "Clearance form completed for [PERSON:Milo Castellanos Jr.]; may "
+    "return to school in [LOCATION:Cranston] on [DATE_TIME:May 5th] "
+    "with no gym for two weeks.",
+    "Work note faxed to the employer; [PERSON:Ingrid Svensson] is "
+    "restricted to light duty until [DATE_TIME:the 18th of July].",
+    # dietitian / wound care consults
+    "Dietitian consult: [PERSON:Fatima el-Amin] follows a [NRP:halal] "
+    "diet; menu adjusted and education materials sent to "
+    "[EMAIL_ADDRESS:f.elamin82@courriel.example].",
+    "Wound care: undermining at 3 o'clock reduced; photos uploaded by "
+    "[PERSON:Nurse Practitioner Dana Whitehorse] on "
+    "[DATE_TIME:07/22/2026].",
+    # French appointment-reminder prose (service language)
+    "Rappel: votre rendez-vous avec le [PERSON:Dr Pham Nguyen] est "
+    "fixé au [DATE_TIME:22 août 2026] à la clinique de "
+    "[LOCATION:Nantes]; en cas d'empêchement appelez le "
+    "[PHONE_NUMBER:02 40 55 01 44].",
+    "La famille de [PERSON:Mme Aïcha Benkirane] demande un interprète "
+    "arabe pour la consultation du [DATE_TIME:30/09/2026].",
+    "Patient pratiquant [NRP:orthodoxe], demande un régime sans viande "
+    "le vendredi; noté au dossier par l'infirmière [PERSON:Claire "
+    "Vasseur].",
+    # quoted speech / attribution shapes
+    "Per the patient: 'my daughter [PERSON:Renata]' manages the pillbox "
+    "and drives her from [LOCATION:Central Falls] every Thursday.",
+    "Sister states the patient 'has not been himself since "
+    "[DATE_TIME:the Fourth of July weekend]' and sleeps most days.",
+    # sentence-initial dates, machine identifiers
+    "[DATE_TIME:2026-08-02 06:40] vitals stable; overnight events none; "
+    "awaiting placement coordination with [LOCATION:Attleboro] rehab.",
+    "[DATE_TIME:March 1] labs reviewed with [PERSON:Dr. B. Okafor-"
+    "Smith]; repeat lipid panel in twelve weeks, results to "
+    "[EMAIL_ADDRESS:b.okaforsmith+labs@clinicmail.example].",
+    # clean sentences (false-positive pressure — no PHI at all)
+    "Increase the evening insulin by two units if fasting glucose "
+    "exceeds one-eighty on three consecutive mornings.",
+    "Gait steady with the rolling walker; stairs supervised only, "
+    "home PT to continue twice weekly.",
+    "No acute distress; lungs clear bilaterally; plan unchanged "
+    "pending the culture results.",
+    "Take the antibiotic with food and finish the full course even "
+    "if you feel better sooner.",
+)
+
 EXAMPLES: List[Tuple[str, List[GoldSpan]]] = [_parse(m) for m in _MARKED]
 DEV_EXAMPLES = EXAMPLES  # threshold-selection split (bench threshold_sweep)
 TEST_EXAMPLES: List[Tuple[str, List[GoldSpan]]] = [
     _parse(m) for m in _MARKED_TEST
+]
+HELDOUT_EXAMPLES: List[Tuple[str, List[GoldSpan]]] = [
+    _parse(m) for m in _MARKED_HELDOUT
 ]
 
 
@@ -360,7 +453,7 @@ def _bootstrap_f1_ci(
 def evaluate_deid_split(
     engine, n_boot: int = 1000, seed: int = 0
 ) -> Dict[str, object]:
-    """Dev / second-dev evaluation (VERDICT r4 item 5, relabeled).
+    """Three-split evaluation (VERDICT r4 item 5 → closed by ISSUE 7).
 
     * ``dev`` — the original 21-example split; the served acceptance
       threshold (``DEFAULT_NER_THRESHOLD``) was selected on its operating
@@ -370,18 +463,31 @@ def evaluate_deid_split(
       tuned deny-words and person-position cues against these spans, so
       ``test.entity_f1`` also carries tuning optimism — report it as a
       second dev number, never as held-out.
+    * ``heldout`` — written fresh for PR 7 and never used in any tuning
+      decision; THIS is the number to quote as generalization.  Both
+      are reported side by side (bench ``deid.f1`` = second-dev,
+      ``deid.f1_heldout`` = held-out) so the tuning-optimism gap is
+      itself measured instead of hidden.
     """
     dev_preds = _predict(engine, DEV_EXAMPLES)
     test_preds = _predict(engine, TEST_EXAMPLES)
     test = _score(TEST_EXAMPLES, test_preds)
     lo, hi = _bootstrap_f1_ci(TEST_EXAMPLES, test_preds, n_boot, seed)
     test["entity_f1_ci95"] = [lo, hi]
+    held_preds = _predict(engine, HELDOUT_EXAMPLES)
+    heldout = _score(HELDOUT_EXAMPLES, held_preds)
+    lo_h, hi_h = _bootstrap_f1_ci(HELDOUT_EXAMPLES, held_preds, n_boot, seed)
+    heldout["entity_f1_ci95"] = [lo_h, hi_h]
     return {
         "dev": _score(DEV_EXAMPLES, dev_preds),
         "test": test,
+        "heldout": heldout,
         "note": (
-            "threshold selected on dev; the 'test' split is a SECOND dev "
-            "set (r5 tuned deny-words/cues against its spans) — its F1 "
-            "carries tuning optimism and is not a held-out number"
+            "threshold selected on dev; 'test' is a SECOND dev set (r5 "
+            "tuned deny-words/cues against its spans) and carries tuning "
+            "optimism; 'heldout' was written for PR 7 and never scored "
+            "during tuning — quote heldout.entity_f1 as the "
+            "generalization number, and if any tuning decision is ever "
+            "made against it, relabel it dev and write a fresh one"
         ),
     }

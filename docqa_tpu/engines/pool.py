@@ -1068,6 +1068,19 @@ class EnginePool:
             parked = len(self._pending)
         return parked + sum(r.batcher.n_queued for r in self._replicas)
 
+    @property
+    def n_admitting(self) -> int:
+        return sum(r.batcher.n_admitting for r in self._replicas)
+
+    def kv_slot_occupancy(self) -> Dict[int, int]:
+        """Pool-wide active KV slots per prefill bucket (telemetry
+        scrape surface — same shape as the solo batcher's)."""
+        out: Dict[int, int] = {}
+        for r in self._replicas:
+            for bucket, n in r.batcher.kv_slot_occupancy().items():
+                out[bucket] = out.get(bucket, 0) + n
+        return out
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             parked = len(self._pending)

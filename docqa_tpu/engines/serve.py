@@ -379,6 +379,14 @@ class ContinuousBatcher:
         # host-side slot bookkeeping
         self._slot_req: List[Optional[_Request]] = [None] * self.n_slots
         self._slot_budget = np.zeros((self.n_slots,), np.int64)
+        # prefill bucket each occupied slot was admitted at — read only
+        # where _slot_req is non-None (freed slots keep stale values),
+        # so kv_slot_occupancy() needs no extra clearing on any of the
+        # retire/fail paths.  The telemetry sampler turns this into the
+        # per-bucket KV-occupancy gauges ROADMAP item 1 needs as its
+        # before/after evidence (today a slot pins worst-case bucket HBM
+        # for its whole lifetime; paged KV must show that shrinking).
+        self._slot_bucket = [0] * self.n_slots
 
         self._queue: collections.deque = collections.deque()
         self._cv = threading.Condition()
@@ -998,6 +1006,19 @@ class ContinuousBatcher:
         worker wedged here shows 0 queued AND 0 active."""
         return self._admitting
 
+    def kv_slot_occupancy(self) -> Dict[int, int]:
+        """Active KV slots per admission prefill bucket (telemetry
+        gauge ``serve_kv_slots_bucket_<N>``).  Unlocked snapshot of the
+        same host-side lists ``n_active`` reads — per-slot writes are
+        atomic reference stores, and a slot mid-transition miscounting
+        by one for one sample is fine for a 2 Hz occupancy series."""
+        out: Dict[int, int] = {}
+        for slot in range(self.n_slots):
+            if self._slot_req[slot] is not None:
+                b = self._slot_bucket[slot]
+                out[b] = out.get(b, 0) + 1
+        return out
+
     # ---- worker loop ---------------------------------------------------------
 
     def _admit_round(self, pairs: List[Tuple[int, "_Request"]]):
@@ -1110,6 +1131,7 @@ class ContinuousBatcher:
             budget = min(req.max_new, self.cache_len - n_ids - 1 - self.spec_k)
             self._slot_req[slot] = req
             self._slot_budget[slot] = budget
+            self._slot_bucket[slot] = bucket
             slots_np[i] = slot
             lens_np[i] = n_ids
             budget_ok[i] = budget >= 2

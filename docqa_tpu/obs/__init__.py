@@ -12,7 +12,12 @@ for the rest of the framework:
 * export: :func:`timeline_dict`, :func:`to_chrome_trace`,
   :func:`coverage`;
 * analysis: :func:`attribution`, :func:`format_table`,
-  :data:`DEFAULT_PROFILER` (on-demand ``jax.profiler`` window).
+  :data:`DEFAULT_PROFILER` (on-demand ``jax.profiler`` window);
+* telemetry (ISSUE 7): :class:`TelemetryStore` / :class:`WindowedDigest`
+  / :class:`TelemetrySampler` (time-series rollups of the serving
+  plane), :class:`BurnRateEvaluator` + :func:`default_ask_slos` (SLO
+  burn-rate alerting), :func:`prometheus_text` / :func:`telemetry_json`
+  / :func:`lint_prometheus_text` (exposition).
 
 Depends only on the stdlib (jax is imported lazily inside the profiler
 window), so ``runtime/metrics.py`` can import it without cycles.
@@ -45,6 +50,11 @@ from docqa_tpu.obs.profiler import (  # noqa: F401
     format_table,
     stage_kind,
 )
+from docqa_tpu.obs.expo import (  # noqa: F401
+    lint_prometheus_text,
+    prometheus_text,
+    telemetry_json,
+)
 from docqa_tpu.obs.recorder import (  # noqa: F401
     DEFAULT_RECORDER,
     FlightRecorder,
@@ -56,4 +66,14 @@ from docqa_tpu.obs.recorder import (  # noqa: F401
     new_trace,
     set_enabled,
 )
+from docqa_tpu.obs.slo import (  # noqa: F401
+    BurnRateEvaluator,
+    SLODef,
+    default_ask_slos,
+)
 from docqa_tpu.obs.spans import Span, Trace, start_span  # noqa: F401
+from docqa_tpu.obs.telemetry import (  # noqa: F401
+    TelemetrySampler,
+    TelemetryStore,
+    WindowedDigest,
+)

@@ -75,6 +75,10 @@ class FlightRecorder:
             maxlen=anomalous_capacity
         )
         self._durations: collections.deque = collections.deque(maxlen=512)
+        # lifetime count of traces that entered the anomalous ring — the
+        # telemetry sampler scrapes this as a counter series, so a burst
+        # of anomalies is visible even after the ring itself rotated
+        self.anomalous_total = 0
 
     # ---- trace lifecycle -----------------------------------------------------
 
@@ -145,11 +149,60 @@ class FlightRecorder:
                 trace.flag(f"slow_p{int(self.slow_percentile)}")
             self._durations.append(dur)
             self._ring.append(trace)
-            if trace.flags:
+            if trace.flags and not any(
+                t is trace for t in self._anomalous
+            ):
+                # membership check: flag_window() racing this completion
+                # may have promoted the trace already — a double insert
+                # would evict a real always-keep trace from the ring and
+                # over-count anomalous_total during exactly the incident
+                # the ring preserves evidence for
                 self._anomalous.append(trace)
+                self.anomalous_total += 1
 
     def _quantile_locked(self, q: float) -> float:
         return percentile_nearest_rank(sorted(self._durations), q)
+
+    def flag_window(
+        self,
+        t_lo_unix: float,
+        t_hi_unix: float,
+        flag: str,
+        names: Optional[List[str]] = None,
+    ) -> int:
+        """Flag every retained trace that STARTED inside the wall-clock
+        window ``[t_lo_unix, t_hi_unix)`` — the SLO burn-rate alert's
+        evidence hook (obs/slo.py): "the p95 objective burned between
+        14:02:10 and 14:02:30" becomes exactly those timelines in the
+        always-keep anomalous ring.  Completed traces that were healthy
+        at completion are promoted into the ring here; open traces get
+        the flag now and land in the ring at completion as usual.
+        Returns the number of traces newly flagged."""
+        n = 0
+        with self._lock:
+            anomalous_ids = {id(t) for t in self._anomalous}
+            pools = (
+                list(self._open.values())
+                + list(self._ring)
+                + list(self._anomalous)
+            )
+            seen: set = set()
+            for trace in pools:
+                if id(trace) in seen:
+                    continue
+                seen.add(id(trace))
+                if not (t_lo_unix <= trace.wall0 < t_hi_unix):
+                    continue
+                if names is not None and trace.name not in names:
+                    continue
+                if flag in trace.flags:
+                    continue
+                trace.flag(flag)
+                n += 1
+                if trace.finished and id(trace) not in anomalous_ids:
+                    self._anomalous.append(trace)
+                    self.anomalous_total += 1
+        return n
 
     # ---- lookup --------------------------------------------------------------
 
@@ -198,6 +251,7 @@ class FlightRecorder:
             self._ring.clear()
             self._anomalous.clear()
             self._durations.clear()
+            self.anomalous_total = 0
 
 
 DEFAULT_RECORDER = FlightRecorder()

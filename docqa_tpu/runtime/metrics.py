@@ -11,18 +11,17 @@ Thread-safe; lock-per-registry.  No global state except a default registry.
 
 from __future__ import annotations
 
-import bisect
 import contextlib
 import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 # stdlib-only subsystem (jax lazy inside its profiler) — no import cycle
 from docqa_tpu.obs.context import current_trace_id
-from docqa_tpu.obs.spans import percentile_nearest_rank
 from docqa_tpu.obs.spans import start_span as _trace_span
+from docqa_tpu.obs.telemetry import WindowedDigest
 
 
 class TraceLogFilter(logging.Filter):
@@ -96,21 +95,39 @@ class Gauge:
 
 
 class Histogram:
-    """Sorted-sample histogram with exact percentiles.
+    """Windowed-digest histogram: exact percentiles over *recent* time.
 
-    Keeps at most ``max_samples`` (reservoir of the most recent); exact for
-    bench-scale sample counts, bounded memory for long-running services.
+    Samples feed a :class:`~docqa_tpu.obs.telemetry.WindowedDigest` —
+    fixed-interval rollup windows, each sealed with count/sum/p50/p95/
+    p99 and recent windows keeping their samples.  ``percentile()`` /
+    ``summary()`` merge the sample-retention horizon, so a long-running
+    service's p95 reflects the last few minutes of traffic.  (The old
+    sorted reservoir trimmed by "drop an extreme alternately", which
+    drifted long-running percentiles toward the middle of ALL-TIME
+    history — exactly the soak-invisible failure ISSUE 7 names.)  When
+    no recent samples exist the last sealed window's digest answers, so
+    an idle service reports its last known percentiles, never NaN-after
+    -traffic.  ``count``/``mean`` stay lifetime totals — the shape of
+    ``summary()`` is unchanged.
     """
 
     MAX_EXEMPLARS = 8
 
-    def __init__(self, name: str, max_samples: int = 65536):
+    def __init__(
+        self,
+        name: str,
+        max_samples: int = 65536,
+        digest: Optional[WindowedDigest] = None,
+    ):
         self.name = name
-        self._samples: List[float] = []
-        self._recent: List[float] = []
+        # the windowed rollups behind percentile()/summary(); also
+        # registered with the telemetry store (obs/telemetry.py) so
+        # /api/telemetry serves the identical windows
+        self.digest = digest or WindowedDigest(
+            max_samples_per_window=min(max_samples, 4096)
+        )
         self._count = 0
         self._sum = 0.0
-        self._max_samples = max_samples
         self._exemplars: List[tuple] = []  # (value, trace_id), largest kept
         self._lock = threading.Lock()
 
@@ -118,10 +135,6 @@ class Histogram:
         with self._lock:
             self._count += 1
             self._sum += value
-            bisect.insort(self._samples, value)
-            if len(self._samples) > self._max_samples:
-                # drop an extreme alternately to stay bounded but unbiased-ish
-                self._samples.pop(0 if self._count % 2 else -1)
             if trace_id is not None:
                 # exemplars: the LARGEST traced samples keep their trace id,
                 # so the p95 on /api/status links to a real flight-recorder
@@ -135,20 +148,32 @@ class Histogram:
                     )
                     if value >= self._exemplars[lo][0]:
                         self._exemplars[lo] = (value, trace_id)
+        # digest has its own (strictly inner, never-held-together) lock
+        self.digest.observe(value)
 
     def percentile(self, q: float) -> float:
-        with self._lock:
-            if not self._samples:
-                return float("nan")
-            # shared nearest-rank definition (obs/spans.py) — histograms,
-            # the flight recorder's slow flag, and the attribution table
-            # must agree on what a percentile means
-            return percentile_nearest_rank(self._samples, q)
+        # windowed first (percentiles mean NOW); stale-idle falls back
+        # to the last sealed digest; NaN only before any observation.
+        # Percentile definition stays obs/spans.percentile_nearest_rank
+        # (inside the digest) — histograms, the recorder's slow flag,
+        # and the attribution table can never disagree about "p95".
+        recent = self.digest.recent_percentiles((q,))
+        if recent is not None:
+            return recent[f"p{int(q)}"]
+        last = self.digest.last_percentiles()
+        if last is not None:
+            return last.get(f"p{int(q)}", float("nan"))
+        return float("nan")
 
     @property
     def count(self) -> int:
         with self._lock:
             return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
 
     @property
     def mean(self) -> float:
@@ -163,12 +188,19 @@ class Histogram:
             ]
 
     def summary(self) -> Dict[str, object]:
+        ps = self.digest.recent_percentiles((50, 95, 99))
+        if ps is None:
+            ps = self.digest.last_percentiles() or {
+                "p50": float("nan"),
+                "p95": float("nan"),
+                "p99": float("nan"),
+            }
         out: Dict[str, object] = {
             "count": self.count,
             "mean": self.mean,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
+            "p50": ps["p50"],
+            "p95": ps["p95"],
+            "p99": ps["p99"],
         }
         ex = self.exemplars()
         if ex:
@@ -182,6 +214,9 @@ class MetricsRegistry:
     histograms: Dict[str, Histogram] = field(default_factory=dict)
     gauges: Dict[str, Gauge] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    # rollup parameters applied to every histogram's WindowedDigest
+    # (configure_windows aligns them with the telemetry store's clock)
+    _window_params: Optional[dict] = None
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -192,7 +227,12 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         with self._lock:
             if name not in self.histograms:
-                self.histograms[name] = Histogram(name)
+                digest = (
+                    WindowedDigest(**self._window_params)
+                    if self._window_params
+                    else None
+                )
+                self.histograms[name] = Histogram(name, digest=digest)
             return self.histograms[name]
 
     def gauge(self, name: str) -> Gauge:
@@ -200,6 +240,38 @@ class MetricsRegistry:
             if name not in self.gauges:
                 self.gauges[name] = Gauge(name)
             return self.gauges[name]
+
+    def configure_windows(
+        self,
+        interval_s: float,
+        points: int = 360,
+        sample_windows: Optional[int] = None,
+    ) -> None:
+        """Align every histogram's rollup windows with the telemetry
+        store's clock (``DocQARuntime`` calls this at boot, tests with
+        sub-second intervals).  Existing digests are REPLACED — sealed
+        history does not survive a re-window, which is why the runtime
+        does this before serving, never mid-flight."""
+        params = {"interval_s": float(interval_s), "points": int(points)}
+        if sample_windows is not None:
+            params["sample_windows"] = int(sample_windows)
+        with self._lock:
+            self._window_params = params
+            for h in self.histograms.values():
+                h.digest = WindowedDigest(**params)
+
+    def instruments(
+        self,
+    ) -> Tuple[Dict[str, Counter], Dict[str, Histogram], Dict[str, Gauge]]:
+        """Shallow copies of the three instrument maps — the telemetry
+        sampler's scrape surface (and the Prometheus renderer's), so
+        neither iterates a dict the serving threads are inserting into."""
+        with self._lock:
+            return (
+                dict(self.counters),
+                dict(self.histograms),
+                dict(self.gauges),
+            )
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:

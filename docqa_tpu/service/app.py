@@ -469,19 +469,76 @@ class DocQARuntime:
             retrieval=retrieval, summarizer=self.summarizer
         )
 
+        # ---- telemetry: time-series rollups + SLO burn-rate alerting
+        # (docqa-telemetry, docs/OBSERVABILITY.md).  Built last so the
+        # sampler scrapes fully-constructed components; started in
+        # start() and joined in stop() so it can never outlive the
+        # serving plane it observes.
+        tcfg = self.cfg.telemetry
+        self.telemetry = None
+        self.slo = None
+        self.sampler = None
+        if tcfg.enabled:
+            # align every histogram's rollup windows with the store's
+            # clock BEFORE serving (re-windowing drops sealed history)
+            DEFAULT_REGISTRY.configure_windows(tcfg.interval_s, tcfg.points)
+            self.telemetry = obs.TelemetryStore(
+                interval_s=tcfg.interval_s, points=tcfg.points
+            )
+            self.slo = obs.BurnRateEvaluator(
+                self.telemetry,
+                obs.default_ask_slos(
+                    p95_objective_ms=tcfg.slo_ask_p95_ms,
+                    availability=tcfg.slo_ask_availability,
+                    degraded_budget=tcfg.slo_ask_degraded_budget,
+                    short_windows=tcfg.slo_short_windows,
+                    long_windows=tcfg.slo_long_windows,
+                    burn_threshold=tcfg.slo_burn_threshold,
+                ),
+                registry=DEFAULT_REGISTRY,
+                recorder=obs.DEFAULT_RECORDER,
+            )
+            self.sampler = obs.TelemetrySampler(
+                self.telemetry,
+                registry=DEFAULT_REGISTRY,
+                batcher=self.batcher,
+                broker=self.broker,
+                queues=(
+                    self.cfg.broker.raw_queue,
+                    self.cfg.broker.clean_queue,
+                ),
+                recorder=obs.DEFAULT_RECORDER,
+                # HBM/jit-cache probes only make sense when decode is
+                # real — the fake-llm path never compiles the programs
+                # the probe would measure
+                engine=self.generator if self.batcher is not None else None,
+                slo_evaluator=self.slo,
+                sample_every_s=tcfg.sample_every_s,
+                hbm_refresh_s=tcfg.hbm_refresh_s,
+            )
+
     def start(self) -> "DocQARuntime":
         self.pipeline.start()
+        if self.sampler is not None:
+            self.sampler.start()
+        self._warmup_thread = None
         if self.batcher is not None:
             # warm the decode programs off the request path: the first
             # trace+compile costs tens of seconds on a real chip, and a
             # cold-start /ask would burn its whole request deadline
             # (resilience.request_deadline_s) inside the compiler —
-            # showing up as a phantom decoder outage on every deploy
+            # showing up as a phantom decoder outage on every deploy.
+            # The thread is KEPT and joined in stop(): a live XLA
+            # compile on a daemon thread at interpreter exit aborts the
+            # process (the hazard engines/pool.py already joins its
+            # rebuild warmups for — observed on the short-lived
+            # fault-drill drive in PR 7).
             import threading as _threading
 
-            _threading.Thread(
+            self._warmup_thread = _threading.Thread(
                 target=self._warmup_decode, daemon=True, name="warmup"
-            ).start()
+            )
+            self._warmup_thread.start()
         return self
 
     def _warmup_decode(self) -> None:
@@ -578,9 +635,25 @@ class DocQARuntime:
         return n
 
     def stop(self) -> None:
+        # sampler first: it reads the components torn down below (every
+        # probe is fenced, but a clean join beats relying on fences)
+        if self.sampler is not None:
+            self.sampler.stop()
         self.pipeline.stop()
         if self.batcher is not None:
             self.batcher.stop()
+        warmup = getattr(self, "_warmup_thread", None)
+        if warmup is not None and warmup.is_alive():
+            # the stopped batcher fails the warmup's submits fast, but a
+            # compile already inside XLA should be allowed to finish —
+            # abandoning it aborts the interpreter at exit.  The join is
+            # SHORT on purpose: a warmup thread can also be wedged in
+            # the known CPU-client capacity hazard (engines/pool.py PR 6
+            # notes), and a long join would convert that leaked-thread
+            # nuisance into a multi-second stall on every stop()
+            warmup.join(timeout=5)
+            if warmup.is_alive():
+                log.warning("decode warmup thread still alive after stop()")
         # final snapshot so a restart resumes exactly here (kill-and-restart
         # loses nothing; the reference lost everything after its last save)
         self._snapshot()
@@ -683,11 +756,52 @@ def make_app(rt: DocQARuntime):
                     if hasattr(rt.batcher, "status")
                     else None
                 ),
+                # SLO burn-rate state (obs/slo.py): a firing alert here
+                # is WHY /api/traces?anomalous=1 just grew — the
+                # evaluator flags the firing window's timelines
+                "slo": rt.slo.status() if rt.slo is not None else None,
             }
         )
 
-    async def metrics(_req):
+    async def metrics(req):
+        """Prometheus text exposition (scraper-facing; ISSUE 7), content
+        negotiated: plain 0.0.4 by default (exemplar-free — the legacy
+        parser rejects exemplar syntax), OpenMetrics 1.0 with exemplar
+        trace-ids when the Accept header asks for it.  The JSON snapshot
+        the docs' curl examples used lives on /api/metrics — same
+        registry, different serialization."""
+        openmetrics = "application/openmetrics-text" in req.headers.get(
+            "Accept", ""
+        )
+        text = obs.prometheus_text(
+            DEFAULT_REGISTRY, rt.telemetry, openmetrics=openmetrics
+        )
+        if openmetrics:
+            return web.Response(
+                text=text,
+                content_type="application/openmetrics-text",
+                charset="utf-8",
+                headers={"X-Prometheus-Format": "openmetrics-1.0"},
+            )
+        return web.Response(
+            text=text,
+            content_type="text/plain",
+            charset="utf-8",
+            headers={"X-Prometheus-Format": "0.0.4"},
+        )
+
+    async def api_metrics(_req):
         return web.json_response(DEFAULT_REGISTRY.snapshot())
+
+    async def api_telemetry(req):
+        """Rollup time series as JSON (?name= for one series) — the
+        soak/chaos drivers dump these next to trace timelines so a
+        violation carries its ten-minute history, not just the moment."""
+        if rt.telemetry is None:
+            return json_error(404, "telemetry disabled (telemetry.enabled)")
+        return web.json_response(
+            obs.telemetry_json(rt.telemetry, req.query.get("name"))
+        )
 
     # ---- decode-engine pool (docs/OPERATIONS.md "Replica pool") -------------
 
@@ -955,6 +1069,16 @@ def make_app(rt: DocQARuntime):
             return None, json_error(504, str(e), ctx)
         return pending, None
 
+    def _ask_outcome(status: int) -> None:
+        """SLO event accounting (obs/slo.py): every /ask admission is a
+        request; 5xx responses spend the availability budget.  Client
+        errors (422) are the caller's problem, not ours — they count as
+        requests (the objective is over served traffic) but never as
+        failures."""
+        DEFAULT_REGISTRY.counter("ask_requests").inc()
+        if status >= 500:
+            DEFAULT_REGISTRY.counter("ask_failures").inc()
+
     async def ask(req):
         # retrieval + submission on the device lane; decode wait on the gen
         # lane so N concurrent /ask share batcher slots (≈ solo latency)
@@ -964,6 +1088,7 @@ def make_app(rt: DocQARuntime):
             pending, err = await _ask_preamble(req, ctx)
             if err is not None:
                 obs.finish(ctx, status="error")
+                _ask_outcome(err.status)
                 return err
             try:
                 result = await on_gen(obs.call_in, ctx, pending.resolve)
@@ -972,15 +1097,18 @@ def make_app(rt: DocQARuntime):
                 # so reaching here means even the fallback was impossible
                 DEFAULT_REGISTRY.counter("qa_deadline_shed").inc()
                 obs.finish(ctx, status="error")
+                _ask_outcome(504)
                 return json_error(504, str(e), ctx)
             DEFAULT_REGISTRY.histogram("qa_e2e_ms").observe(
                 (time.perf_counter() - t0) * 1000,
                 trace_id=ctx.trace_id if ctx else None,
             )
             obs.finish(ctx)
+            _ask_outcome(200)
             return with_trace(web.json_response(result), ctx)
         except Exception:
             obs.finish(ctx, status="error")
+            _ask_outcome(500)
             raise
 
     async def ask_stream(req):
@@ -995,7 +1123,12 @@ def make_app(rt: DocQARuntime):
         pending, err = await _ask_preamble(req, ctx)
         if err is not None:
             obs.finish(ctx, status="error")
+            _ask_outcome(err.status)
             return err
+        # the stream commits to a 200 at prepare(); decode failures
+        # surface as SSE error events, so availability accounting for
+        # the stream variant happens here at admission
+        _ask_outcome(200)
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -1187,6 +1320,8 @@ def make_app(rt: DocQARuntime):
             web.get("/health", health),
             web.get("/api/status", api_status),
             web.get("/metrics", metrics),
+            web.get("/api/metrics", api_metrics),
+            web.get("/api/telemetry", api_telemetry),
             web.get("/api/traces", api_traces),
             web.get("/api/trace/{trace_id}", api_trace_one),
             web.get("/api/pool", api_pool),

@@ -36,9 +36,35 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+# the run's live telemetry store (a sampler scrapes the registry, pool
+# and broker during every chaos window) — set by each phase so dumps
+# carry the rollup SERIES next to the trace timelines: a zero-lost
+# violation shows the queue-depth/replica-health history that led to it
+_TELEMETRY_STORE = None
+
+
+def _start_telemetry(**kw):
+    """Phase-scoped sampler over the default registry + whatever live
+    components the phase passes (batcher=pool, broker=...)."""
+    global _TELEMETRY_STORE
+    from docqa_tpu import obs
+    from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY
+
+    _TELEMETRY_STORE = obs.TelemetryStore(interval_s=1.0, points=900)
+    return obs.TelemetrySampler(
+        _TELEMETRY_STORE,
+        registry=DEFAULT_REGISTRY,
+        recorder=obs.DEFAULT_RECORDER,
+        sample_every_s=0.25,
+        hbm_refresh_s=0,
+        **kw,
+    ).start()
+
+
 def _dump_traces(path: str, extra: dict) -> None:
-    """Flight-recorder dump (open + anomalous + recent timelines) so a
-    red chaos run is replayable AND inspectable post-hoc."""
+    """Flight-recorder dump (open + anomalous + recent timelines, plus
+    the run's telemetry rollup series) so a red chaos run is replayable
+    AND inspectable post-hoc."""
     from docqa_tpu import obs
 
     try:
@@ -46,6 +72,11 @@ def _dump_traces(path: str, extra: dict) -> None:
             json.dump(
                 {
                     **extra,
+                    "telemetry": (
+                        _TELEMETRY_STORE.snapshot()
+                        if _TELEMETRY_STORE is not None
+                        else None
+                    ),
                     "open": [
                         obs.timeline_dict(t)
                         for t in obs.DEFAULT_RECORDER.open_traces()
@@ -154,6 +185,7 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
         return waiters
 
     t0 = time.monotonic()
+    sampler = _start_telemetry(batcher=pool, engine=engine)
     try:
         pool.warmup()
         # -- window 1: seeded worker crash under load
@@ -192,6 +224,7 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
             w.join()
     finally:
         status = pool.status()
+        sampler.stop()
         pool.stop()
 
     hung = [o for o in outcomes if o[2] == "HUNG"]
@@ -311,6 +344,10 @@ def main() -> int:
         seed=args.seed,
     )
 
+    sampler = _start_telemetry(
+        broker=broker,
+        queues=(cfg.broker.raw_queue, cfg.broker.clean_queue),
+    )
     pipeline.start()
     doc_ids = []
     t0 = time.monotonic()
@@ -338,6 +375,7 @@ def main() -> int:
                     break
                 time.sleep(0.05)
     finally:
+        sampler.stop()
         pipeline.stop()
 
     from docqa_tpu import obs
@@ -384,6 +422,11 @@ def main() -> int:
                         "seed": args.seed,
                         "stuck": stuck,
                         "missing_vectors": missing_vectors,
+                        "telemetry": (
+                            _TELEMETRY_STORE.snapshot()
+                            if _TELEMETRY_STORE is not None
+                            else None
+                        ),
                         "open": [
                             obs.timeline_dict(t)
                             for t in obs.DEFAULT_RECORDER.open_traces()

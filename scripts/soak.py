@@ -191,11 +191,27 @@ def dump_flight_recorder(reason):
                 timelines.append(tl)
             except Exception:
                 pass
+        telemetry = None
+        try:
+            # the rollup series alongside the timelines: a violation
+            # carries its ten-minute history (queue depth creep, p95
+            # drift, replica flaps), not just the terminal state
+            _, telemetry = req("GET", "/api/telemetry")
+        except Exception as e:
+            telemetry = {"error": repr(e)}
+        slo = None
+        try:
+            _, st_now = req("GET", "/api/status")
+            slo = st_now.get("slo")
+        except Exception:
+            pass
         out = {
             "reason": reason,
             "anomalous_summaries": anomalous,
             "recent_summaries": recent,
             "anomalous_timelines": timelines,
+            "telemetry": telemetry,
+            "slo": slo,
         }
         path = "soak_traces.json"
         with open(path, "w", encoding="utf-8") as f:

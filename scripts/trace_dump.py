@@ -17,7 +17,9 @@ process, drive one ingest + one /ask over real HTTP, and export the
     python scripts/trace_dump.py --smoke --out ask_trace.json
 
 Exits non-zero when the smoke trace is structurally broken (no events,
-no linked spans) so CI fails loudly instead of archiving an empty file.
+no linked spans), when ``GET /metrics`` fails the strict Prometheus
+line-lint (``obs/expo.py``), or when ``GET /api/telemetry`` serves no
+series — so CI fails loudly instead of archiving an empty file.
 """
 
 import argparse
@@ -118,14 +120,38 @@ def smoke(out: str) -> int:
                     )
                 ).json()
                 listing = await (await s.get(f"{base}/api/traces")).json()
+                # Prometheus exposition over REAL HTTP bytes, strict
+                # line-lint (CI has no promtool; the grammar lives in
+                # obs/expo.py and tests/test_telemetry.py pins it)
+                async with s.get(f"{base}/metrics") as r:
+                    assert r.status == 200, await r.text()
+                    prom = await r.text()
+                tele = await (await s.get(f"{base}/api/telemetry")).json()
         finally:
             await runner.cleanup()
-        return timeline, chrome, listing
+        return timeline, chrome, listing, prom, tele
 
     try:
-        timeline, chrome, listing = asyncio.run(drive())
+        timeline, chrome, listing, prom, tele = asyncio.run(drive())
     finally:
         rt.stop()
+
+    from docqa_tpu.obs.expo import lint_prometheus_text
+
+    problems = lint_prometheus_text(prom)
+    n_series = len(tele.get("series", {}))
+    print(
+        f"/metrics: {len(prom.splitlines())} line(s), "
+        f"{len(problems)} lint problem(s); /api/telemetry: "
+        f"{n_series} series"
+    )
+    if problems:
+        for p in problems[:10]:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    if n_series == 0:
+        print("telemetry served no series", file=sys.stderr)
+        return 1
 
     with open(out, "w", encoding="utf-8") as f:
         json.dump(chrome, f, indent=1)

@@ -99,6 +99,15 @@ class TestSSEEndpoint:
             client = TestClient(TestServer(make_app(rt)))
             await client.start_server()
             try:
+                # warm the decode path first: on a contended full-suite
+                # machine the FIRST /ask can pay its prefill compile
+                # past the 8 s request deadline and legitimately serve
+                # the DEGRADED extractive answer — this test pins
+                # stream==non-stream token equality, not cold-start
+                # resilience (test_resilience owns that contract)
+                await (await client.post(
+                    "/ask/", json={"question": "aspirin dose?"}
+                )).json()
                 expect = (await (await client.post(
                     "/ask/", json={"question": "aspirin dose?"}
                 )).json())["answer"]

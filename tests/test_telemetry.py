@@ -1,0 +1,881 @@
+"""docqa-telemetry: time-series rollups, SLO burn rates, exposition,
+the serving-plane sampler, and the perf-regression gate (ISSUE 7).
+
+Window arithmetic runs on an injectable clock — every rollup/burn test
+steps time explicitly instead of sleeping.  The one end-to-end test
+boots a fake-mode runtime at a sub-second rollup interval, induces a
+latency spike on /ask, and asserts the p95 burn-rate alert fires within
+two windows AND the firing window's traces land in the flight
+recorder's anomalous ring (the acceptance loop: "SLO burning" → "here
+are the exact timelines").
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from docqa_tpu import obs
+from docqa_tpu.obs.expo import lint_prometheus_text, prometheus_text
+from docqa_tpu.obs.slo import BurnRateEvaluator, SLODef
+from docqa_tpu.obs.telemetry import (
+    TelemetrySampler,
+    TelemetryStore,
+    WindowedDigest,
+)
+from docqa_tpu.runtime.metrics import Histogram, MetricsRegistry
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts"),
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# rollup window arithmetic
+# ---------------------------------------------------------------------------
+
+
+class TestWindowArithmetic:
+    def test_counter_deltas_across_windows(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=4, now_fn=clock)
+        store.record_counter("c", 5)
+        clock.tick(10)
+        store.record_counter("c", 9)
+        clock.tick(10)
+        store.record_counter("c", 9)  # idle window: delta 0
+        pts = store.series("c")["points"]
+        assert [p["value"] for p in pts] == [5, 4, 0]
+        assert [p["cumulative"] for p in pts] == [5, 9, 9]
+
+    def test_counter_delta_across_ring_wrap(self):
+        """Windows older than ``points`` drop off; deltas at the
+        retained edge stay correct relative to the previous RETAINED
+        window — a wrap must never produce a negative or inflated
+        delta."""
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=3, now_fn=clock)
+        for i in range(8):  # cumulative 10, 20, ... over 8 windows
+            store.record_counter("c", (i + 1) * 10)
+            clock.tick(10)
+        pts = store.series("c")["points"]
+        assert len(pts) == 3  # pruned to the ring
+        # the trailing edge re-anchors on the last PRUNED window's
+        # cumulative, so every retained delta is a true delta — no
+        # from-zero spike artifact at the wrap
+        assert [p["value"] for p in pts] == [10, 10, 10]
+
+    def test_counter_reset_reads_as_restart(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=8, now_fn=clock)
+        store.record_counter("c", 100)
+        clock.tick(10)
+        store.record_counter("c", 3)  # process restarted
+        pts = store.series("c")["points"]
+        assert pts[-1]["value"] == 3  # never negative
+
+    def test_gauge_last_sample_wins(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=4, now_fn=clock)
+        store.record_gauge("g", 1.0)
+        store.record_gauge("g", 7.0)  # same window: last sample wins
+        clock.tick(10)
+        store.record_gauge("g", 2.0)
+        pts = store.series("g")["points"]
+        assert [p["value"] for p in pts] == [7.0, 2.0]
+
+    def test_window_delta_trailing_sum(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=8, now_fn=clock)
+        for cum in (5, 9, 14, 14):
+            store.record_counter("c", cum)
+            clock.tick(10)
+        # last 2 windows: the idle 14->14 window plus the current empty
+        assert store.window_delta("c", 2) == 0.0
+        assert store.window_delta("c", 4) == 9.0  # 9->14 plus idle
+
+    def test_digest_windows_seal_and_percentiles(self):
+        clock = FakeClock()
+        d = WindowedDigest(
+            interval_s=10, points=5, sample_windows=3, now_fn=clock
+        )
+        for v in (1.0, 2.0, 3.0, 100.0):
+            d.observe(v)
+        clock.tick(10)
+        d.observe(50.0)
+        clock.tick(10)
+        wins = d.windows()
+        assert [w["count"] for w in wins] == [4, 1]
+        # nearest-rank over [1,2,3,100]: idx round(1.5) banker's -> 2
+        assert wins[0]["p50"] == 3.0 and wins[0]["max"] == 100.0
+        merged = d.recent_percentiles()
+        assert merged["p50"] == 3.0  # merged across both windows
+
+    def test_digest_sample_retention_horizon(self):
+        """Beyond ``sample_windows`` the digests stay but the samples
+        go — merged percentiles then fall back to the last sealed
+        digest, never NaN after traffic."""
+        clock = FakeClock()
+        d = WindowedDigest(
+            interval_s=10, points=10, sample_windows=2, now_fn=clock
+        )
+        d.observe(5.0)
+        clock.tick(50)  # far past the sample horizon
+        d.roll()
+        assert d.recent_percentiles() is None
+        assert d.last_percentiles()["p50"] == 5.0
+
+    def test_histogram_percentiles_reflect_now_not_alltime(self):
+        """The satellite fix: the old reservoir trimmed extremes
+        alternately, so a long-running p95 drifted toward the middle of
+        ALL-TIME history.  Windowed digests must report the recent
+        regime."""
+        clock = FakeClock()
+        h = Histogram(
+            "x",
+            digest=WindowedDigest(
+                interval_s=10, points=400, sample_windows=3, now_fn=clock
+            ),
+        )
+        for _ in range(500):  # a long healthy history at ~10ms
+            h.observe(10.0)
+        clock.tick(200)  # healthy history ages out of the sample horizon
+        for _ in range(20):  # the current degraded regime at ~600ms
+            h.observe(600.0)
+        s = h.summary()
+        assert s["p50"] == 600.0, "p50 must reflect the current regime"
+        assert s["count"] == 520  # lifetime count unchanged (compat)
+        assert set(s) >= {"count", "mean", "p50", "p95", "p99"}
+
+    def test_snapshot_contains_all_kinds(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=4, now_fn=clock)
+        store.record_counter("c", 1)
+        store.record_gauge("g", 2.0)
+        d = WindowedDigest(interval_s=10, now_fn=clock)
+        d.observe(3.0)
+        store.register_digest("h_ms", d)
+        snap = store.snapshot()
+        kinds = {k: v["kind"] for k, v in snap["series"].items()}
+        assert kinds == {
+            "c": "counter", "g": "gauge", "h_ms": "histogram"
+        }
+        json.dumps(snap)  # JSON-ready end to end
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+
+
+def _latency_slo(**kw):
+    base = dict(
+        name="p95",
+        kind="latency",
+        objective=0.95,
+        digest_name="lat_ms",
+        threshold_ms=50.0,
+        short_windows=2,
+        long_windows=6,
+        burn_threshold=4.0,
+        clear_windows=2,
+        min_events=4,
+    )
+    base.update(kw)
+    return SLODef(**base)
+
+
+class TestBurnRate:
+    def _setup(self, slo=None):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=60, now_fn=clock)
+        reg = MetricsRegistry()
+        reg.configure_windows(10, 60)
+        # the registry's digest must run on the SAME fake clock
+        h = reg.histogram("lat_ms")
+        h.digest = WindowedDigest(
+            interval_s=10, points=60, sample_windows=8, now_fn=clock
+        )
+        ev = BurnRateEvaluator(
+            store, [slo or _latency_slo()], registry=reg,
+            recorder=obs.FlightRecorder(),
+        )
+        return clock, store, reg, ev
+
+    def test_latency_burn_fires_within_two_windows(self):
+        clock, store, reg, ev = self._setup()
+        h = reg.histogram("lat_ms")
+        # window 1: all requests over the 50ms objective
+        for _ in range(10):
+            h.observe(600.0)
+        assert ev.evaluate() == [{"slo": "p95", "event": "fired"}]
+        st = ev.status()[0]
+        assert st["firing"] and st["short_burn"] == pytest.approx(20.0)
+        assert reg.gauge("slo_p95_burning").value == 1.0
+        assert reg.counter("slo_p95_fired").value == 1
+
+    def test_below_traffic_floor_never_fires(self):
+        clock, store, reg, ev = self._setup(_latency_slo(min_events=50))
+        h = reg.histogram("lat_ms")
+        for _ in range(10):
+            h.observe(600.0)
+        assert ev.evaluate() == []
+        assert not ev.firing()
+
+    def test_within_objective_never_fires(self):
+        clock, store, reg, ev = self._setup()
+        h = reg.histogram("lat_ms")
+        for _ in range(100):
+            h.observe(10.0)
+        for _ in range(3):  # 3% over-threshold < 5% budget -> burn < 1
+            h.observe(600.0)
+        assert ev.evaluate() == []
+
+    def test_clears_after_calm_windows(self):
+        clock, store, reg, ev = self._setup()
+        h = reg.histogram("lat_ms")
+        for _ in range(10):
+            h.observe(600.0)
+        ev.evaluate()
+        assert ev.firing() == ["p95"]
+        # burn continues one window: stays firing
+        clock.tick(10)
+        for _ in range(10):
+            h.observe(600.0)
+        ev.evaluate()
+        assert ev.firing() == ["p95"]
+        # short window must fully age past the bad data (short=2), then
+        # clear_windows calm windows in a row resolve the alert
+        cleared = False
+        for _ in range(6):
+            clock.tick(10)
+            for _ in range(10):
+                h.observe(10.0)
+            if any(
+                t["event"] == "cleared" for t in ev.evaluate()
+            ):
+                cleared = True
+                break
+        assert cleared
+        assert not ev.firing()
+        assert reg.gauge("slo_p95_burning").value == 0.0
+
+    def test_ratio_slo_counts_counter_deltas(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=60, now_fn=clock)
+        reg = MetricsRegistry()
+        slo = SLODef(
+            name="avail", kind="ratio", objective=0.99,
+            total_series="ask_requests", bad_series="ask_failures",
+            short_windows=2, long_windows=6, burn_threshold=4.0,
+            min_events=4,
+        )
+        ev = BurnRateEvaluator(store, [slo], registry=reg)
+        store.record_counter("ask_requests", 20)
+        store.record_counter("ask_failures", 10)  # 50% errors vs 1% budget
+        assert ev.evaluate() == [{"slo": "avail", "event": "fired"}]
+
+    def test_firing_flags_window_traces_anomalous(self):
+        recorder = obs.FlightRecorder()
+        clock, store, reg, _ = self._setup()
+        ev = BurnRateEvaluator(
+            store,
+            [_latency_slo(trace_names=("ask",))],
+            registry=reg,
+            recorder=recorder,
+        )
+        # two completed HEALTHY traces inside the firing window, one
+        # with a non-matching name
+        ctx1 = recorder.new_trace("ask")
+        recorder.complete(ctx1.trace)
+        ctx2 = recorder.new_trace("ingest")
+        recorder.complete(ctx2.trace)
+        h = reg.histogram("lat_ms")
+        for _ in range(10):
+            h.observe(600.0)
+        ev.evaluate()
+        anomalous = recorder.summaries(anomalous=True)
+        assert [t["name"] for t in anomalous] == ["ask"]
+        assert "slo_p95_burn" in anomalous[0]["flags"]
+
+
+class TestRecorderFlagWindow:
+    def test_flag_window_promotes_completed_traces(self):
+        r = obs.FlightRecorder()
+        ctx = r.new_trace("ask")
+        r.complete(ctx.trace)
+        assert r.summaries(anomalous=True) == []
+        t0 = ctx.trace.wall0
+        n = r.flag_window(t0 - 1, t0 + 1, "slo_test_burn")
+        assert n == 1
+        assert r.anomalous_total == 1
+        rows = r.summaries(anomalous=True)
+        assert rows[0]["flags"] == ["slo_test_burn"]
+        # idempotent: re-flagging the same window adds nothing
+        assert r.flag_window(t0 - 1, t0 + 1, "slo_test_burn") == 0
+        assert len(r.summaries(anomalous=True)) == 1
+
+    def test_flag_window_respects_bounds_and_names(self):
+        r = obs.FlightRecorder()
+        ctx = r.new_trace("ask")
+        r.complete(ctx.trace)
+        t0 = ctx.trace.wall0
+        assert r.flag_window(t0 + 10, t0 + 20, "f") == 0
+        assert r.flag_window(t0 - 1, t0 + 1, "f", names=["other"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (strict line-lint — CI has no promtool)
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusExposition:
+    def _render(self, openmetrics=False):
+        reg = MetricsRegistry()
+        reg.counter("ask_requests").inc(3)
+        reg.gauge("pool_pending").set(2.0)
+        h = reg.histogram("qa_e2e_ms")
+        h.observe(12.5, trace_id="t-00000a")
+        h.observe(80.0)
+        store = TelemetryStore(interval_s=10, points=4)
+        store.record_gauge("broker_depth_raw-docs", 5.0)  # needs sanitizing
+        return prometheus_text(reg, store, openmetrics=openmetrics)
+
+    def test_lint_clean_both_dialects(self):
+        for om in (False, True):
+            text = self._render(openmetrics=om)
+            assert lint_prometheus_text(text) == [], text
+
+    def test_structure_plain_004(self):
+        text = self._render()
+        lines = text.splitlines()
+        assert "docqa_ask_requests_total 3" in lines
+        assert "docqa_pool_pending 2" in lines
+        assert 'docqa_qa_e2e_ms{quantile="0.5"} 12.5' in lines
+        # NO exemplars in the 0.0.4 dialect: the legacy parser treats
+        # `# {...}` after a value as a syntax error and one exemplar
+        # would fail the entire scrape
+        assert " # {" not in text
+        assert "# EOF" not in text
+        # dashes sanitized for the store-only gauge
+        assert any("docqa_broker_depth_raw_docs 5" == ln for ln in lines)
+        # HELP/TYPE precede every sample family, and counters are typed
+        # under their `_total` name (the family the samples use — a
+        # 0.0.4 scraper drops metadata typed under a sample-less name)
+        assert lines.index("# TYPE docqa_ask_requests_total counter") < (
+            lines.index("docqa_ask_requests_total 3")
+        )
+
+    def test_structure_openmetrics(self):
+        text = self._render(openmetrics=True)
+        lines = text.splitlines()
+        # families typed under the BASE name, samples suffixed _total
+        assert "# TYPE docqa_ask_requests counter" in lines
+        assert "docqa_ask_requests_total 3" in lines
+        # the exemplar rides a dedicated counter family (legal on
+        # counter samples; summaries may not carry exemplars)
+        ex = [
+            ln for ln in lines
+            if ln.startswith("docqa_qa_e2e_ms_samples_total")
+        ]
+        assert ex and '# {trace_id="t-00000a"} 12.5' in ex[0], lines
+        assert lines[-1] == "# EOF"
+
+    def test_lint_catches_malformations(self):
+        bad = "\n".join(
+            [
+                "# TYPE docqa_x counter",  # TYPE without HELP
+                "docqa_x_total notanumber",  # bad value
+                'docqa_y{label="v"} 1',  # sample before TYPE
+                "# TYPE docqa_x counter",  # duplicate TYPE (2nd family)
+            ]
+        ) + "\n"
+        problems = lint_prometheus_text(bad)
+        assert len(problems) >= 3
+        assert any("malformed sample" in p for p in problems)
+        assert any("before TYPE" in p for p in problems)
+        assert any("TYPE without HELP" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# sampler mechanics (manual ticks; the thread path rides the pool test)
+# ---------------------------------------------------------------------------
+
+
+class TestSampler:
+    def test_tick_scrapes_registry_and_probes(self):
+        clock = FakeClock()
+        store = TelemetryStore(interval_s=10, points=8, now_fn=clock)
+        reg = MetricsRegistry()
+        reg.counter("serve_completed").inc(4)
+        reg.gauge("breaker_decoder").set(1.0)
+        reg.histogram("qa_e2e_ms").observe(7.0)
+        sampler = TelemetrySampler(
+            store,
+            registry=reg,
+            extra_probes=[lambda: {"custom_gauge": 42.0}],
+        )
+        sampler.tick(now=clock())
+        assert store.series("serve_completed")["points"][-1]["value"] == 4
+        assert store.latest_gauge("breaker_decoder") == 1.0
+        assert store.latest_gauge("custom_gauge") == 42.0
+        assert store.series("qa_e2e_ms")["kind"] == "histogram"
+
+    def test_probe_failure_is_fenced(self):
+        store = TelemetryStore(interval_s=10, points=8)
+
+        def bad_probe():
+            raise RuntimeError("dead component")
+
+        sampler = TelemetrySampler(store, extra_probes=[bad_probe])
+        sampler.tick()
+        sampler.tick()  # still alive; failure counted, not raised
+        assert sampler.ticks == 2
+
+    def test_recorder_scrape(self):
+        store = TelemetryStore(interval_s=10, points=8)
+        recorder = obs.FlightRecorder()
+        ctx = recorder.new_trace("x")
+        ctx.trace.flag("bad")
+        recorder.complete(ctx.trace)
+        TelemetrySampler(store, recorder=recorder).tick()
+        assert (
+            store.series("trace_anomalous_total")["points"][-1][
+                "cumulative"
+            ]
+            == 1
+        )
+        assert store.latest_gauge("trace_open") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# perf gate mechanics (scripts/perf_gate.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPerfGate:
+    def _baseline(self):
+        return {
+            "metrics": {
+                "load_p50_ms": {
+                    "baseline": 100.0,
+                    "direction": "lower",
+                    "noise_band_pct": 50,
+                },
+                "decode_tok_s": {
+                    "baseline": 200.0,
+                    "direction": "higher",
+                    "noise_band_pct": 50,
+                },
+            }
+        }
+
+    def test_accepts_within_band(self):
+        import perf_gate
+
+        result = {
+            "degraded": False,
+            "metrics": {"load_p50_ms": 140.0, "decode_tok_s": 110.0},
+        }
+        report = perf_gate.gate(result, self._baseline())
+        assert report["status"] == "pass", report
+
+    def test_rejects_beyond_band_regression(self):
+        import perf_gate
+
+        result = {
+            "degraded": False,
+            "metrics": {"load_p50_ms": 151.0, "decode_tok_s": 210.0},
+        }
+        report = perf_gate.gate(result, self._baseline())
+        assert report["status"] == "fail"
+        assert any("load_p50_ms" in f for f in report["failures"])
+        # and for higher-is-better metrics
+        result = {
+            "degraded": False,
+            "metrics": {"load_p50_ms": 90.0, "decode_tok_s": 99.0},
+        }
+        report = perf_gate.gate(result, self._baseline())
+        assert report["status"] == "fail"
+        assert any("decode_tok_s" in f for f in report["failures"])
+
+    def test_degraded_run_skips_with_reason(self):
+        import perf_gate
+
+        result = {"degraded": True, "degraded_reason": "tunnel down"}
+        report = perf_gate.gate(result, self._baseline())
+        assert report["status"] == "skipped"
+        assert "tunnel down" in report["reason"]
+        assert "DEGRADED" in report["reason"]
+
+    def test_missing_metric_fails(self):
+        import perf_gate
+
+        report = perf_gate.gate(
+            {"degraded": False, "metrics": {"load_p50_ms": 100.0}},
+            self._baseline(),
+        )
+        assert report["status"] == "fail"
+        assert any("decode_tok_s" in f for f in report["failures"])
+
+    def test_todo_justification_rejected(self):
+        import perf_gate
+
+        base = self._baseline()
+        base["metrics"]["load_p50_ms"]["justification"] = (
+            "TODO: explain this regression"
+        )
+        report = perf_gate.gate(
+            {
+                "degraded": False,
+                "metrics": {"load_p50_ms": 100.0, "decode_tok_s": 200.0},
+            },
+            base,
+        )
+        assert report["status"] == "fail"
+        assert any("TODO" in f for f in report["failures"])
+
+    def test_write_baseline_stamps_worsened_budgets(self, tmp_path):
+        import perf_gate
+
+        path = str(tmp_path / "perf_baseline.json")
+        old = self._baseline()
+        result = {
+            "degraded": False,
+            "mode": "test",
+            # p50 worsened, tok/s improved
+            "metrics": {"load_p50_ms": 180.0, "decode_tok_s": 250.0},
+        }
+        new = perf_gate.write_baseline(result, path, old)
+        assert new["metrics"]["load_p50_ms"]["baseline"] == 180.0
+        assert "TODO" in new["metrics"]["load_p50_ms"]["justification"]
+        assert "justification" not in new["metrics"]["decode_tok_s"]
+        # the freshly-written file is rejected until the TODO is edited
+        report = perf_gate.gate(result, new)
+        assert report["status"] == "fail"
+        # a human replaces the TODO with a reason -> gate passes
+        new["metrics"]["load_p50_ms"]["justification"] = (
+            "accepted: sampler now runs inside the measured window"
+        )
+        assert perf_gate.gate(result, new)["status"] == "pass"
+
+    def test_bench_details_dotted_paths(self):
+        import perf_gate
+
+        baseline = {
+            "metrics": {
+                "rag_qps": {
+                    "baseline": 16.0,
+                    "direction": "higher",
+                    "noise_band_pct": 25,
+                    "path": "rag_load.sustained_qps",
+                }
+            }
+        }
+        bench = {"degraded": False, "rag_load": {"sustained_qps": 18.3}}
+        assert perf_gate.gate(bench, baseline)["status"] == "pass"
+        bench["rag_load"]["sustained_qps"] = 1.0
+        assert perf_gate.gate(bench, baseline)["status"] == "fail"
+
+    def test_checked_in_baseline_is_gateable(self):
+        """The repo's perf_baseline.json must be structurally valid and
+        carry no unresolved TODO justifications (the CI step would
+        reject it) — without running the measurement."""
+        import perf_gate
+
+        with open(perf_gate.BASELINE_DEFAULT, encoding="utf-8") as f:
+            baseline = json.load(f)
+        assert baseline["metrics"], "baseline must gate something"
+        for name, spec in baseline["metrics"].items():
+            assert "baseline" in spec, name
+            assert spec.get("direction") in ("lower", "higher"), name
+            assert perf_gate.TODO_MARK not in spec.get(
+                "justification", ""
+            ), f"{name} carries an unresolved TODO"
+        # a synthetic result matching the baseline exactly passes
+        result = {
+            "degraded": False,
+            "metrics": {
+                n: s["baseline"] for n, s in baseline["metrics"].items()
+            },
+        }
+        assert perf_gate.gate(result, baseline)["status"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# live serving plane: sampler vs a real decode pool (drain / restart)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from docqa_tpu.config import DecoderConfig, GenerateConfig
+    from docqa_tpu.engines.generate import GenerateEngine
+
+    return GenerateEngine(
+        DecoderConfig(
+            vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=256,
+            dtype="float32",
+        ),
+        GenerateConfig(temperature=0.0, prefill_buckets=(16, 32), eos_id=2),
+        seed=7,
+    )
+
+
+class TestSamplerAgainstPool:
+    def test_kv_slot_occupancy_shape(self, tiny_engine):
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        b = ContinuousBatcher(
+            tiny_engine, n_slots=2, chunk=4, cache_len=128
+        )
+        try:
+            b.warmup(buckets=[16])
+            assert b.kv_slot_occupancy() == {}
+            handles = [
+                b.submit_ids([3 + i, 5, 9], max_new_tokens=48)
+                for i in range(2)
+            ]
+            seen = {}
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                occ = b.kv_slot_occupancy()
+                if occ:
+                    seen = occ
+                    break
+                time.sleep(0.002)
+            for h in handles:
+                h.result(timeout=60)
+            assert seen, "occupancy never became visible during decode"
+            assert all(
+                isinstance(k, int) and v >= 1 for k, v in seen.items()
+            )
+            assert sum(seen.values()) <= 2
+            # drained: freed slots leave the occupancy map
+            deadline = time.monotonic() + 10
+            while b.kv_slot_occupancy() and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert b.kv_slot_occupancy() == {}
+        finally:
+            b.stop()
+
+    def test_sampler_joins_cleanly_across_drain_and_rolling_restart(
+        self, tiny_engine
+    ):
+        """The ISSUE's shutdown contract: a sampler scraping a pool must
+        keep ticking THROUGH a drain + rolling restart (its probes only
+        read bounded surfaces, so it can never deadlock one) and its
+        stop() must join the thread."""
+        from docqa_tpu.engines.pool import EnginePool
+
+        pool = EnginePool(
+            tiny_engine, replicas=2, n_slots=2, chunk=4, cache_len=128,
+            canary_interval_s=600.0, health_interval_s=0.05,
+        )
+        store = TelemetryStore(interval_s=0.2, points=200)
+        sampler = TelemetrySampler(
+            store, batcher=pool, sample_every_s=0.02, hbm_refresh_s=0
+        ).start()
+        try:
+            pool.warmup(buckets=[16])
+            for h in [
+                pool.submit_ids([3, 5, 9], max_new_tokens=8)
+                for _ in range(4)
+            ]:
+                h.result(timeout=60)
+            ticks_before = sampler.ticks
+            out = pool.rolling_restart(timeout_per_replica=30.0)
+            assert out["ok"], out
+            # poll the GAUGES, not the tick counter: ticks increments at
+            # tick() entry, before the pool scrape writes — and the last
+            # full scrape may have caught a replica mid-rebuild (0.0)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if (
+                    sampler.ticks > ticks_before
+                    and store.latest_gauge("pool_replica0_alive") == 1.0
+                    and store.latest_gauge("pool_replica1_alive") == 1.0
+                ):
+                    break
+                time.sleep(0.01)
+            assert sampler.ticks > ticks_before, (
+                "sampler stopped ticking across the rolling restart"
+            )
+            # the pool series exist and carried the restart window
+            assert store.latest_gauge("pool_replica0_alive") == 1.0
+            assert store.latest_gauge("pool_replica1_alive") == 1.0
+            assert store.series("serve_queue_depth") is not None
+        finally:
+            sampler.stop(join_timeout=30.0)
+            alive_after = sampler.running
+            pool.stop()
+        assert not alive_after, "sampler thread failed to join on stop()"
+
+    def test_sampler_survives_pool_stop_first(self, tiny_engine):
+        """Teardown-order tolerance: probes against an already-stopped
+        pool are fenced, and stop() still joins."""
+        from docqa_tpu.engines.pool import EnginePool
+
+        pool = EnginePool(
+            tiny_engine, replicas=1, n_slots=2, chunk=4, cache_len=128,
+            canary_interval_s=600.0, health_interval_s=0.05,
+        )
+        store = TelemetryStore(interval_s=0.2, points=50)
+        sampler = TelemetrySampler(
+            store, batcher=pool, sample_every_s=0.02, hbm_refresh_s=0
+        ).start()
+        pool.stop()  # wrong order on purpose
+        time.sleep(0.1)  # a few ticks against the dead pool
+        sampler.stop(join_timeout=30.0)
+        assert not sampler.running
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: booted fake-mode runtime, /metrics +
+# /api/telemetry live, induced latency spike -> burn alert -> anomalous
+# traces (ISSUE 7 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestServedTelemetryE2E:
+    @pytest.fixture()
+    def rt(self):
+        from docqa_tpu.config import load_config
+        from docqa_tpu.service.app import DocQARuntime
+
+        obs.DEFAULT_RECORDER.clear()
+        cfg = load_config(env={}, overrides={
+            "flags.use_fake_llm": True,
+            "flags.use_fake_encoder": True,
+            "encoder.embed_dim": 64,
+            "store.dim": 64,
+            "store.shard_capacity": 256,
+            "ner.hidden_dim": 32,
+            "ner.num_layers": 1,
+            "ner.num_heads": 2,
+            "ner.mlp_dim": 64,
+            "ner.train_steps": 0,
+            # sub-second rollups so "within two windows" is test-speed
+            "telemetry.interval_s": 0.5,
+            "telemetry.sample_every_s": 0.05,
+            "telemetry.slo_ask_p95_ms": 30.0,
+            "telemetry.slo_short_windows": 2,
+            "telemetry.slo_long_windows": 8,
+        })
+        runtime = DocQARuntime(cfg).start()
+        rec = runtime.pipeline.ingest_document(
+            "t.txt", b"Aspirin 100 mg daily for prevention.",
+            patient_id="p1",
+        )
+        assert runtime.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        yield runtime
+        runtime.stop()
+
+    def test_burn_alert_fires_and_flags_traces(self, rt):
+        import asyncio
+
+        from docqa_tpu.service.app import make_app
+
+        # induce the spike INSIDE the served path: every /ask spends
+        # ~60ms against a 30ms p95 objective
+        orig = rt.qa.ask_submit
+
+        def slow_submit(*a, **kw):
+            time.sleep(0.04)
+            return orig(*a, **kw)
+
+        rt.qa.ask_submit = slow_submit
+
+        async def drive():
+            import aiohttp
+            from aiohttp import web
+
+            app = make_app(rt)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            base = f"http://127.0.0.1:{port}"
+            fired_at = None
+            spike_t0 = time.monotonic()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for i in range(60):
+                        async with s.post(
+                            f"{base}/ask/",
+                            json={"question": "aspirin dose?"},
+                        ) as r:
+                            assert r.status == 200, await r.text()
+                        async with s.get(f"{base}/api/status") as r:
+                            slo = (await r.json())["slo"]
+                        row = next(
+                            x for x in slo
+                            if x["name"] == "ask_p95_latency"
+                        )
+                        if row["firing"]:
+                            fired_at = time.monotonic() - spike_t0
+                            break
+                    assert fired_at is not None, (
+                        f"p95 burn alert never fired; slo={slo}"
+                    )
+                    # acceptance: the alert fires while the spike is
+                    # still HAPPENING.  The exact two-window edge is
+                    # pinned deterministically by TestBurnRate's
+                    # fake-clock tests; this wall-clock bound only
+                    # guards against an alert that never reacts — a
+                    # contended full-suite CPU stretches each 40 ms ask
+                    # several-fold, so the slack is deliberately wide.
+                    assert fired_at < 10.0, fired_at
+                    async with s.get(
+                        f"{base}/api/traces?anomalous=1&limit=100"
+                    ) as r:
+                        anomalous = await r.json()
+                    async with s.get(f"{base}/metrics") as r:
+                        assert r.status == 200
+                        prom = await r.text()
+                    async with s.get(f"{base}/api/telemetry") as r:
+                        tele = await r.json()
+                    async with s.get(
+                        f"{base}/api/telemetry?name=qa_e2e_ms"
+                    ) as r:
+                        one = await r.json()
+            finally:
+                await runner.cleanup()
+            return anomalous, prom, tele, one
+
+        anomalous, prom, tele, one = asyncio.run(drive())
+        # the firing window's /ask traces are in the always-keep ring,
+        # flagged with the SLO that burned
+        flagged = [
+            t for t in anomalous
+            if "slo_ask_p95_latency_burn" in t["flags"]
+        ]
+        assert flagged, anomalous
+        assert all(t["name"] == "ask" for t in flagged)
+        # live exposition: lint-clean Prometheus text, burning gauge up
+        assert lint_prometheus_text(prom) == []
+        assert "docqa_slo_ask_p95_latency_burning 1" in prom.splitlines()
+        # live rollups: the qa histogram series carries windowed
+        # digests with over-threshold counts for the registered SLO
+        pts = one["series"]["qa_e2e_ms"]["points"]
+        assert pts and any(
+            p.get("over", {}).get("30") for p in pts
+        ), pts
+        assert "ask_requests" in tele["series"]
