@@ -1,15 +1,22 @@
 """docqa-lint: AST invariant analysis for the docqa_tpu tree.
 
-Ten project-specific checkers (docs/STATIC_ANALYSIS.md):
+Fourteen project-specific checkers (docs/STATIC_ANALYSIS.md):
 
+* ``cv-protocol``     — condition waits in predicate loops, notify under
+  the lock, request-path waits carry a Deadline.
 * ``deadline-flow``   — request deadlines thread through; waits clamp.
+* ``dispatch-streams``— thread entry points that can reach a jax dispatch
+  are ledgered in ``dispatch_streams.json`` under a concurrency budget.
 * ``donation``        — buffers donated to jitted calls aren't read after.
 * ``dtype-flow``      — bf16/int8 matmuls accumulate f32; bf16 reductions
   upcast; no float64 / silent widening in device code.
+* ``guarded-state``   — a field written under a lock anywhere is accessed
+  under that lock everywhere (per-class + cross-object bridge facts).
 * ``host-sync``       — no blocking device→host syncs on the /ask path
   outside jit (jit-purity's deliberate blind spot).
 * ``jit-purity``      — no side effects / host syncs in traced code.
-* ``lock-discipline`` — one lock order; no blocking I/O under a lock.
+* ``lock-discipline`` — one lock order (full-DFS cycles over a transitive
+  acquisition graph); no blocking I/O under a lock.
 * ``mesh-axes``       — sharding/collective axis names resolve to the
   declared mesh; collectives stay inside their ``shard_map``.
 * ``phi-taint``       — raw pre-deid text never reaches logs/metrics/
@@ -17,18 +24,25 @@ Ten project-specific checkers (docs/STATIC_ANALYSIS.md):
 * ``retrace-hazard``  — jit wrappers are built once and reused; static
   arguments stay hashable and stable.
 * ``spec-shape``      — PartitionSpec arity matches the annotated rank.
+* ``thread-lifecycle``— every thread has a reachable join on its owner's
+  stop/close path (daemon threads that can reach jax especially).
 
 Tier B lives in ``analysis/shard_audit.py`` (docs/SHARDING.md) — lower
 the device-plane programs on virtual meshes, hold their collective counts
-to the checked-in ``shard_budget.json`` — and in
+to the checked-in ``shard_budget.json`` — in
 ``analysis/compile_audit.py``: drive the canonical serving workloads
 under compile counting, AOT-measure each root's ``memory_analysis()``
 bytes, and hold both to ``compile_budget.json`` (zero steady-state
-retraces, per-root HBM ceilings).
+retraces, per-root HBM ceilings) — and in ``analysis/race_witness.py``
+(docs/STATIC_ANALYSIS.md "Concurrency witness"): opt-in runtime
+instrumentation of lock acquisition whose witnessed order graph is
+cross-checked edge-for-edge against lock-discipline's static graph by
+the chaos/soak gates.
 
 Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` /
-``scripts/compile_audit.py`` (CLIs) and ``pytest -m lint`` (tier-1 gate,
-tests/test_analysis.py, tests/test_numcheck.py, tests/test_shardcheck.py,
+``scripts/compile_audit.py`` / ``scripts/serve_cluster_loop.py`` (CLIs)
+and ``pytest -m lint`` (tier-1 gate, tests/test_analysis.py,
+tests/test_numcheck.py, tests/test_shardcheck.py, tests/test_racecheck.py,
 tests/test_shard_audit.py, tests/test_compile_audit.py).
 """
 

@@ -486,9 +486,12 @@ class Baseline:
 
 def all_checkers() -> Dict[str, object]:
     """Rule name -> checker instance (import here to avoid cycles)."""
+    from docqa_tpu.analysis.cv_protocol import CvProtocolChecker
     from docqa_tpu.analysis.deadline_flow import DeadlineFlowChecker
+    from docqa_tpu.analysis.dispatch_streams import DispatchStreamsChecker
     from docqa_tpu.analysis.donation import DonationChecker
     from docqa_tpu.analysis.dtype_flow import DtypeFlowChecker
+    from docqa_tpu.analysis.guarded_state import GuardedStateChecker
     from docqa_tpu.analysis.host_sync import HostSyncChecker
     from docqa_tpu.analysis.jit_purity import JitPurityChecker
     from docqa_tpu.analysis.lock_discipline import LockDisciplineChecker
@@ -496,11 +499,15 @@ def all_checkers() -> Dict[str, object]:
     from docqa_tpu.analysis.phi_taint import PhiTaintChecker
     from docqa_tpu.analysis.retrace_hazard import RetraceHazardChecker
     from docqa_tpu.analysis.spec_shape import SpecShapeChecker
+    from docqa_tpu.analysis.thread_lifecycle import ThreadLifecycleChecker
 
     checkers = [
+        CvProtocolChecker(),
         DeadlineFlowChecker(),
+        DispatchStreamsChecker(),
         DonationChecker(),
         DtypeFlowChecker(),
+        GuardedStateChecker(),
         HostSyncChecker(),
         JitPurityChecker(),
         LockDisciplineChecker(),
@@ -508,6 +515,7 @@ def all_checkers() -> Dict[str, object]:
         PhiTaintChecker(),
         RetraceHazardChecker(),
         SpecShapeChecker(),
+        ThreadLifecycleChecker(),
     ]
     return {c.rule: c for c in checkers}
 

@@ -51,6 +51,9 @@ REQUEST_PATH_MODULES = frozenset(
         "docqa_tpu.engines.retrieve",
         "docqa_tpu.engines.rag_fused",
         "docqa_tpu.engines.serve",
+        # the pool fronts the batcher on every /ask since PR 6 — its
+        # waits are request waits (cv-protocol holds them to a Deadline)
+        "docqa_tpu.engines.pool",
     }
 )
 

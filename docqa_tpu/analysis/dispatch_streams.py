@@ -1,0 +1,185 @@
+"""dispatch-streams: every thread that can reach the device is ledgered.
+
+The still-reproducing CPU-client capacity deadlock (PRs 6–7: batcher
+admission + a concurrent sharded retrieve + one more stream — a rebuild
+warmup, a canary, the next request's device ops — exceed the virtual-
+device client's collective scheduling capacity and the process parks at
+0% CPU) is a budget problem: the process grew device-dispatching threads
+one PR at a time, and nobody could NAME them all.  This rule enumerates
+them statically and holds the set to a checked-in ledger,
+``dispatch_streams.json`` — the jit-root-ledger idea applied to threads:
+
+* **entry points** — ``threading.Thread(target=…)``, ``executor
+  .submit(…)``, ``loop.run_in_executor(…)`` and ``obs.call_in(…)``
+  sites, targets resolved where the package can (``self.method``, bare
+  names, ``partial``, lambdas wrapping one resolvable call);
+* **dispatch-capable** — the resolved target's transitive package call
+  graph reaches a jax dispatch (a ``jax.*``/``jnp.*`` call, a jit root,
+  or a class construction that allocates device state); an entry whose
+  target CANNOT be resolved (an executor lane running caller-supplied
+  functions) is conservatively capable — it must be ledgered with a
+  justification saying what it actually runs;
+* **the gate** — every dispatch-capable entry point must appear in the
+  ledger (with a human justification); stale ledger entries fail like
+  stale baselines; and the count of entries marked
+  ``concurrent_with_serving`` must stay within the ledger's
+  ``max_concurrent_device_streams`` budget — adding a stream means
+  bumping a number a reviewer sees, next to the recorded deadlock
+  evidence, instead of silently adding the Nth concurrent dispatcher.
+
+The ledger's ``budget.evidence`` carries the recorded stream/lock
+witness of the capacity deadlock (``scripts/serve_cluster_loop.py``), so
+the precondition is a named, gated number instead of tribal knowledge.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from docqa_tpu.analysis.concurrency import (
+    ThreadEntry,
+    dispatch_reachable,
+    enumerate_thread_entries,
+)
+from docqa_tpu.analysis.core import Finding, Package
+
+LEDGER_NAME = "dispatch_streams.json"
+
+
+def default_ledger_path() -> str:
+    """The checked-in ledger: ``<repo>/dispatch_streams.json``."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_dir), LEDGER_NAME)
+
+
+def _package_ledger_path(package: Package) -> Optional[str]:
+    """Ledger next to the analyzed package's root (fixture trees carry
+    their own or none; the real runs resolve to the repo's)."""
+    for module in package.modules:
+        rel = module.relpath.replace("/", os.sep)
+        if module.path.endswith(rel):
+            base = module.path[: -len(rel)].rstrip(os.sep)
+            cand = os.path.join(os.path.dirname(base), LEDGER_NAME)
+            if os.path.exists(cand):
+                return cand
+            cand = os.path.join(base, LEDGER_NAME)
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def load_ledger(path: Optional[str]) -> Dict:
+    if not path or not os.path.exists(path):
+        return {"streams": {}, "budget": {}}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    data.setdefault("streams", {})
+    data.setdefault("budget", {})
+    return data
+
+
+class DispatchStreamsChecker:
+    rule = "dispatch-streams"
+
+    def __init__(self, ledger_path: Optional[str] = None) -> None:
+        self.ledger_path = ledger_path
+
+    def check(self, package: Package) -> List[Finding]:
+        ledger_path = self.ledger_path or _package_ledger_path(package)
+        ledger = load_ledger(ledger_path)
+        streams: Dict[str, Dict] = ledger["streams"]
+        reach = dispatch_reachable(package)
+        out: List[Finding] = []
+
+        present: Dict[str, ThreadEntry] = {}
+        for entry in enumerate_thread_entries(package):
+            capable, why = self._capability(entry, reach)
+            if not capable:
+                continue
+            present.setdefault(entry.key, entry)
+            row = streams.get(entry.key)
+            if row is None:
+                out.append(
+                    Finding(
+                        self.rule,
+                        entry.module_relpath,
+                        entry.lineno,
+                        entry.site_qualname,
+                        f"unledgered device-dispatch stream {entry.key!r} "
+                        f"({why}) — add it to {LEDGER_NAME} with a "
+                        "justification and account for it in the "
+                        "concurrency budget",
+                    )
+                )
+
+        analyzed = {m.relpath for m in package.modules}
+        if ledger_path is not None:
+            for key, row in sorted(streams.items()):
+                rel = key.split(":", 1)[0]
+                if rel not in analyzed:
+                    continue  # another package's entries (scripts vs pkg)
+                if key not in present:
+                    out.append(
+                        Finding(
+                            self.rule,
+                            rel,
+                            1,
+                            "<ledger>",
+                            f"stale {LEDGER_NAME} entry {key!r}: no such "
+                            "dispatch-capable thread entry point exists "
+                            "any more — remove it (and reclaim its "
+                            "budget slot)",
+                        )
+                    )
+            budget = ledger["budget"].get("max_concurrent_device_streams")
+            if budget is not None and present:
+                # PROCESS-WIDE count: entries this package run verified
+                # as present, plus every declared entry belonging to
+                # another package (docqa_tpu vs scripts/ run over the
+                # same ledger — each prunes only its own stale entries,
+                # so a scripts-side stream must still count against the
+                # one budget here, or splitting the analysis into two
+                # Package runs would silently split the budget too)
+                concurrent = [
+                    key
+                    for key, row in sorted(streams.items())
+                    if row.get("concurrent_with_serving")
+                    and (
+                        key in present
+                        or key.split(":", 1)[0] not in analyzed
+                    )
+                ]
+                if len(concurrent) > int(budget):
+                    anchor = next(
+                        (present[k] for k in concurrent if k in present),
+                        next(iter(present.values())),
+                    )
+                    out.append(
+                        Finding(
+                            self.rule,
+                            anchor.module_relpath,
+                            anchor.lineno,
+                            "<ledger>",
+                            f"{len(concurrent)} streams marked "
+                            "concurrent_with_serving exceed the ledger "
+                            f"budget max_concurrent_device_streams="
+                            f"{budget} — the client-capacity deadlock's "
+                            "precondition (see budget.evidence); raise "
+                            "the budget only with new capacity evidence",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _capability(entry: ThreadEntry, reach: Dict[int, str]):
+        if entry.target is not None:
+            why = reach.get(id(entry.target.node))
+            if why is None:
+                return False, ""
+            return True, f"target {entry.target.qualname} dispatches: {why}"
+        return True, (
+            f"dynamic target {entry.target_text!r} — unresolvable "
+            "statically, conservatively dispatch-capable"
+        )

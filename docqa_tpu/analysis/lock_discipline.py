@@ -9,12 +9,20 @@ regress silently:
   acquires B then A: a deadlock that only fires under load.  The checker
   discovers lock attributes (``self.X = threading.Lock()/RLock()/
   Condition()``, plus module-level ones), builds the acquisition graph
-  (edges from every held lock to each lock acquired under it, including
-  one level through package-resolvable calls), and flags every 2-cycle.
-  Lock identity is ``Class.attr`` for ``self`` attributes and the
-  receiver text otherwise — an approximation without types, so two
-  *instances* of one class's lock are one node (conservative: flags the
-  pattern, which is what ordering discipline is about).
+  (edges from every held lock to each lock acquired under it, through
+  the TRANSITIVE closure of package-resolvable calls — a helper that
+  takes a lock three frames down still orders against whatever its
+  caller holds), and flags every cycle via full DFS (the original
+  2-cycle-only scan missed any A→B→C→A inversion by construction; the
+  dynamic witness in ``analysis/race_witness.py`` cross-checks its
+  *witnessed* edges against exactly this graph, so the two views use one
+  edge and one cycle definition).  ``Condition(self._lock)`` aliases
+  canonicalize to the underlying lock — an "edge" between a cv and the
+  lock it wraps is not an ordering fact.  Lock identity is
+  ``Class.attr`` for ``self`` attributes and the receiver text
+  otherwise — an approximation without types, so two *instances* of one
+  class's lock are one node (conservative: flags the pattern, which is
+  what ordering discipline is about).
 * **blocking while holding a lock** — broker publishes, journal fsyncs,
   registry/DB writes, checkpoint loads, thread joins, sleeps, decode
   waits performed inside a critical section stall every other thread
@@ -42,11 +50,15 @@ from docqa_tpu.analysis.core import (
     call_name,
     stmt_walk as _stmt_walk,
 )
-
-LOCK_FACTORY_RE = re.compile(
-    r"threading\.(?:Lock|RLock|Condition)\b|multiprocessing\.Lock\b"
+from docqa_tpu.analysis.concurrency import (
+    LOCKISH_ATTR_RE,
+    canonical,
+    discover_lock_attr_names,
+    discover_locks,
+    find_cycles,
+    known_lock_attrs,
+    lock_aliases,
 )
-LOCKISH_ATTR_RE = re.compile(r"(?:^|_)(?:lock|cv|mutex|rlock)$|_lock$|_cv$")
 
 # Attribute names whose calls block the calling thread.  Deliberately
 # curated for this codebase (broker publishes, registry writes, journal
@@ -105,33 +117,11 @@ class LockDisciplineChecker:
     # -- lock discovery -------------------------------------------------------
 
     def _discover_locks(self, package: Package) -> Set[str]:
-        """Attribute/variable names assigned a threading primitive."""
-        names: Set[str] = set()
-        for module in package.modules:
-            for node in ast.walk(module.tree):
-                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-                    continue
-                value = getattr(node, "value", None)
-                if value is None:
-                    continue
-                text = ""
-                try:
-                    text = ast.unparse(value)
-                except Exception:
-                    pass
-                if not LOCK_FACTORY_RE.search(text):
-                    continue
-                targets = (
-                    node.targets
-                    if isinstance(node, ast.Assign)
-                    else [node.target]
-                )
-                for t in targets:
-                    if isinstance(t, ast.Attribute):
-                        names.add(t.attr)
-                    elif isinstance(t, ast.Name):
-                        names.add(t.id)
-        return names
+        """Attribute/variable names assigned a threading primitive —
+        delegated to the shared concurrency model (one regex, one
+        implementation) so this classification can never drift from the
+        witness id-map."""
+        return discover_lock_attr_names(package)
 
     def _lock_id(
         self, fn: FunctionInfo, expr_text: str
@@ -193,35 +183,163 @@ class LockDisciplineChecker:
                         changed = True
         return blocking
 
+    # -- transitive acquisition closure ---------------------------------------
+
+    # Generic method names whose unresolved calls UNION into the lock
+    # closure anyway.  Curated by the dynamic witness: each entry is a
+    # name the cross-check caught acquiring a lock the static graph
+    # didn't know about (store.add under the pipeline suppress lock,
+    # gauge.set from the breaker board, histogram/digest observe under
+    # everything).  Do NOT widen casually — a name like ``get`` or
+    # ``close`` unions wildly unrelated classes and manufactures phantom
+    # cycles; grow this set exactly when the witness gate reports a new
+    # missing edge through a generic name.
+    UNION_FALLBACK_ATTRS = frozenset({"add", "set", "observe"})
+
+    def _lock_callees(
+        self, package: Package, fn: FunctionInfo, node: ast.Call
+    ) -> List[FunctionInfo]:
+        """Callees for LOCK-CLOSURE purposes.  Exact resolution first;
+        when it abstains: a class construction reaches its ``__init__``,
+        and a call to one of the witness-curated generic names unions
+        every same-named package METHOD.  For an acquisition CLOSURE,
+        over-approximating which locks a call may take is the
+        conservative direction — it can only add edges the cycle scan
+        must then prove consistent."""
+        exact = package.resolve_call(fn, node)
+        if exact is not None:
+            return [exact]
+        name = call_name(node)
+        if not name:
+            return []
+        attr = name.rsplit(".", 1)[-1]
+        # ClassName(...) -> ClassName.__init__
+        if "." not in name and name[:1].isupper():
+            cands = [
+                f
+                for f in package.by_bare_name.get("__init__", ())
+                if f.class_name == name
+            ]
+            if len(cands) == 1:
+                return cands
+        # receiver-name hint: `self.registry.get(...)` resolves to a
+        # method of a class whose NAME matches the receiver (Document-
+        # Registry), even for generic attrs.  The witness caught
+        # `wait_indexed` holding _done_cv into DocumentRegistry.get this
+        # way.  ≥4 chars so `d.get`/`r.state` can't match everything.
+        if "." in name:
+            recv_tail = name.rsplit(".", 2)[-2].lstrip("_").lower()
+            if len(recv_tail) >= 4:
+                hinted = [
+                    f
+                    for f in package.by_bare_name.get(attr, ())
+                    if f.class_name is not None
+                    and recv_tail in f.class_name.lower()
+                ]
+                if 0 < len(hinted) <= 4:
+                    return hinted
+        if attr in self.UNION_FALLBACK_ATTRS:
+            # bare names included: `registry.gauge(...).set(...)` chains
+            # collapse to a bare `set` (the receiver is a Call), and the
+            # witness caught exactly that edge.  Phantom matches (a
+            # builtin `set()` constructor) only add edges INTO leaf
+            # metric locks, which have no out-edges to cycle through.
+            head = name.split(".")[0]
+            origin = fn.module.imports.get(head) if "." in name else None
+            if origin is not None and origin.split(".")[0] != (
+                fn.module.name.split(".")[0]
+            ):
+                return []  # external-module receiver never enters the pkg
+            methods = [
+                f
+                for f in package.by_bare_name.get(attr, ())
+                if f.class_name is not None
+            ]
+            if 0 < len(methods) <= 6:
+                return methods
+        return []
+
+    def _locks_closure(
+        self, package: Package, known_locks: Set[str]
+    ) -> Dict[int, Set[str]]:
+        """fn-node-id -> every lock id the function may acquire, through
+        the TRANSITIVE closure of package calls (``_lock_callees``).  The
+        direct version missed e.g. ``_pop_free_slots -> _finish -> with
+        req.cv`` (two frames down) — exactly the edges the dynamic
+        witness sees at runtime, so without the closure every witnessed
+        deep edge would fail the witness-vs-static cross-check."""
+        closure: Dict[int, Set[str]] = {}
+        for fn in package.functions:
+            direct = self._direct_locks(fn, known_locks)
+            if direct:
+                closure[id(fn.node)] = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for fn in package.functions:
+                for node in _stmt_walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee in self._lock_callees(package, fn, node):
+                        sub = closure.get(id(callee.node))
+                        if not sub:
+                            continue
+                        cur = closure.setdefault(id(fn.node), set())
+                        if not sub <= cur:
+                            cur |= sub
+                            changed = True
+        return closure
+
     # -- main -----------------------------------------------------------------
 
     def check(self, package: Package) -> List[Finding]:
-        known_locks = self._discover_locks(package)
-        blocking = self._blocking_closure(package)
         out: List[Finding] = []
-        # acquisition-order edges: (A, B) -> first example site
+        edges = self.build_graph(package, out)
+        # full DFS cycle detection over the canonicalized graph (the
+        # 2-cycle-only scan this replaces is the PR-8 satellite fix,
+        # validated against the dynamic witness's own cycle scan)
+        for cycle in find_cycles(edges.keys()):
+            path, line, sym = edges[(cycle[0], cycle[1])]
+            pretty = " -> ".join(cycle)
+            others = "; ".join(
+                f"{a} -> {b} in {edges[(a, b)][2]} "
+                f"({edges[(a, b)][0]}:{edges[(a, b)][1]})"
+                for a, b in zip(cycle[1:], cycle[2:])
+            )
+            out.append(
+                Finding(
+                    self.rule,
+                    path,
+                    line,
+                    sym,
+                    f"inconsistent lock order: cycle {pretty} "
+                    f"({cycle[0]} -> {cycle[1]} here; {others})",
+                )
+            )
+        return out
+
+    def build_graph(
+        self, package: Package, out: Optional[List[Finding]] = None
+    ) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+        """The static acquisition-order graph: (A, B) -> first example
+        site where B was acquired (directly or through calls) while A
+        was held.  Edge endpoints are canonicalized through the
+        Condition→lock alias map.  ``analysis/race_witness.py`` holds its
+        witnessed edges to membership in THIS graph."""
+        decls = discover_locks(package)
+        aliases = lock_aliases(decls)
+        known_locks = self._discover_locks(package) | known_lock_attrs(decls)
+        blocking = self._blocking_closure(package)
+        closure = self._locks_closure(package, known_locks)
+        findings: List[Finding] = out if out is not None else []
         edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
 
         for fn in package.functions:
-            self._check_fn(package, fn, known_locks, blocking, edges, out)
-
-        # 2-cycles in the acquisition graph
-        reported: Set[frozenset] = set()
-        for (a, b), (path, line, sym) in sorted(edges.items()):
-            if (b, a) in edges and frozenset((a, b)) not in reported:
-                reported.add(frozenset((a, b)))
-                p2, l2, s2 = edges[(b, a)]
-                out.append(
-                    Finding(
-                        self.rule,
-                        path,
-                        line,
-                        sym,
-                        f"inconsistent lock order: {a} -> {b} here but "
-                        f"{b} -> {a} in {s2} ({p2}:{l2})",
-                    )
-                )
-        return out
+            self._check_fn(
+                package, fn, known_locks, blocking, closure, aliases,
+                edges, findings,
+            )
+        return edges
 
     def _check_fn(
         self,
@@ -229,10 +347,20 @@ class LockDisciplineChecker:
         fn: FunctionInfo,
         known_locks: Set[str],
         blocking: Dict[int, Set[str]],
+        closure: Dict[int, Set[str]],
+        aliases: Dict[str, str],
         edges: Dict,
         out: List[Finding],
     ) -> None:
         module = fn.module
+
+        def add_edge(held_id: str, lock: str, line: int) -> None:
+            a = canonical(held_id, aliases)
+            b = canonical(lock, aliases)
+            if a != b:
+                edges.setdefault(
+                    (a, b), (module.relpath, line, fn.qualname)
+                )
 
         def visit(node: ast.AST, held: List[Tuple[str, str]]) -> None:
             # held: list of (lock_id, receiver_text)
@@ -256,12 +384,7 @@ class LockDisciplineChecker:
                             # canonical deadlock pair against
                             # `with b: with a:` elsewhere)
                             for h, _r in held + acquired:
-                                if h != lock:
-                                    edges.setdefault(
-                                        (h, lock),
-                                        (module.relpath, child.lineno,
-                                         fn.qualname),
-                                    )
+                                add_edge(h, lock, child.lineno)
                             acquired.append((lock, text))
                     visit(child, held + acquired)
                     continue
@@ -301,25 +424,21 @@ class LockDisciplineChecker:
                                         f"{held[-1][0]}",
                                     )
                                 )
-                            # cross-call lock-order edges
-                            for lock in self._locks_acquired(
-                                callee, known_locks
-                            ):
+                        # cross-call lock-order edges, through the
+                        # TRANSITIVE acquisition closures of everything
+                        # the call may reach (over-approximating callees
+                        # — see _lock_callees)
+                        for cand in self._lock_callees(
+                            package, fn, child
+                        ):
+                            for lock in closure.get(id(cand.node), ()):
                                 for h, _r in held:
-                                    if h != lock:
-                                        edges.setdefault(
-                                            (h, lock),
-                                            (
-                                                module.relpath,
-                                                child.lineno,
-                                                fn.qualname,
-                                            ),
-                                        )
+                                    add_edge(h, lock, child.lineno)
                 visit(child, held)
 
         visit(fn.node, [])
 
-    def _locks_acquired(
+    def _direct_locks(
         self, fn: FunctionInfo, known_locks: Set[str]
     ) -> Set[str]:
         out: Set[str] = set()
@@ -335,3 +454,9 @@ class LockDisciplineChecker:
                     if self._is_lock_expr(text, known_locks):
                         out.add(self._lock_id(fn, text))
         return out
+
+
+def build_acquisition_graph(package: Package):
+    """Module-level convenience for the dynamic witness and tests: the
+    canonicalized static acquisition-order graph, without findings."""
+    return LockDisciplineChecker().build_graph(package)

@@ -716,6 +716,23 @@ class EnginePool:
 
     # ---- health monitor ------------------------------------------------------
 
+    def _transition(self, r: _Replica, from_states, to_state: str) -> bool:
+        """Compare-and-set a replica state under the pool lock.  Every
+        state-changing path (monitor wedge/death/rebuild, operator
+        drain/resume) goes through this: the pre-PR-8 pattern read
+        ``r.state`` lock-free and then wrote it under the lock, so the
+        monitor's DEAD→REBUILDING and an operator's
+        ``resume(rebuild=True)`` could BOTH decide to rebuild one
+        replica — two fresh batchers, one leaked with a live worker
+        thread and a pinned KV cache (guarded-state true positive;
+        regression-tested in tests/test_racecheck.py)."""
+        with self._lock:
+            if r.state not in from_states:
+                return False
+            r.state = to_state
+            self._cv.notify_all()
+            return True
+
     def _monitor_loop(self) -> None:
         while not self._monitor_stop.wait(self.health_interval_s):
             try:
@@ -759,13 +776,15 @@ class EnginePool:
             # a CRASHED worker already ran the failover hook (which set
             # DEAD under the lock, so this path never sees it); reaching
             # here means the worker exited WITHOUT the hook — external
-            # kill/stop — so the death is counted here instead
-            with self._lock:
-                r.state = DEAD
-            r.deaths += 1
-            r.breaker.record_failure()
-            DEFAULT_REGISTRY.counter("pool_replica_deaths").inc()
-            log.error("replica %d worker found dead by monitor", r.idx)
+            # kill/stop — so the death is counted here instead.  CAS:
+            # an operator drain/resume that won the race owns the state.
+            if self._transition(r, (HEALTHY,), DEAD):
+                r.deaths += 1
+                r.breaker.record_failure()
+                DEFAULT_REGISTRY.counter("pool_replica_deaths").inc()
+                log.error(
+                    "replica %d worker found dead by monitor", r.idx
+                )
         if (
             r.state == HEALTHY
             and not b.cold  # a cold iteration is an XLA compile, not a wedge
@@ -779,13 +798,18 @@ class EnginePool:
             # WEDGE: the loop is stuck inside one iteration with work
             # pending.  Queued requests are still rescuable; admitted
             # ones fail fast into the degraded path instead of hanging.
+            # CAS from HEALTHY: if an operator drain() set DRAINING
+            # between the (lock-free) wedge evaluation above and here,
+            # the drain owns the replica — killing a draining batcher
+            # would fail the very in-flight requests the drain promised
+            # to finish.
+            if not self._transition(r, (HEALTHY,), DEAD):
+                return
             log.error(
                 "replica %d wedged (heartbeat %.1fs stale, %d active, "
                 "%d queued) — failing over",
                 r.idx, b.heartbeat_age_s, b.n_active, b.n_queued,
             )
-            with self._lock:
-                r.state = DEAD
             r.deaths += 1
             r.breaker.record_failure()
             DEFAULT_REGISTRY.counter("pool_replica_wedges").inc()
@@ -801,10 +825,12 @@ class EnginePool:
         if r.state == DEAD:
             # rebuild gated by the breaker: a crash-looping replica sits
             # out its reset window, then one half-open probe rebuild whose
-            # canary outcome closes or re-opens the circuit
-            if r.breaker.allow():
-                with self._lock:
-                    r.state = REBUILDING
+            # canary outcome closes or re-opens the circuit.  CAS: an
+            # operator resume(rebuild=True) that won the race is already
+            # rebuilding — a second rebuild would leak its worker.
+            if r.breaker.allow() and self._transition(
+                r, (DEAD,), REBUILDING
+            ):
                 try:
                     self._rebuild_replica(r)
                     # the post-rebuild canary below reports the probe
@@ -812,8 +838,7 @@ class EnginePool:
                     r.last_canary_at = 0.0
                 except Exception:
                     log.exception("replica %d rebuild failed", r.idx)
-                    with self._lock:
-                        r.state = DEAD
+                    self._transition(r, (REBUILDING,), DEAD)
                     r.breaker.record_failure()
             return
         if r.state != HEALTHY:
@@ -1014,8 +1039,16 @@ class EnginePool:
         multi-replica pool a drain is invisible to clients; a 1-replica
         pool parks arrivals until :meth:`resume`."""
         r = self._replicas[replica]
-        with self._lock:
-            r.state = DRAINING
+        if not self._transition(r, (HEALTHY, DRAINING, DEAD), DRAINING):
+            # mid-rebuild: there is no batcher to quiesce yet — report
+            # honestly instead of stomping the monitor's REBUILDING state
+            return {
+                "replica": replica,
+                "drained": False,
+                "skipped": "rebuild in flight",
+                "n_queued": r.batcher.n_queued,
+                "n_active": r.batcher.n_active,
+            }
         drained = r.batcher.drain(timeout)
         DEFAULT_REGISTRY.counter("pool_drains").inc()
         return {
@@ -1028,15 +1061,30 @@ class EnginePool:
     def resume(self, replica: int, rebuild: bool = False) -> Dict[str, Any]:
         """Re-open a drained replica — in place (``rebuild=False``) or as
         a fresh batcher (fresh KV cache + worker + recompiled programs;
-        the hot-restart / weight-reload path)."""
+        the hot-restart / weight-reload path).  Rebuilds are CAS-gated:
+        if the monitor already moved this replica into REBUILDING, a
+        concurrent operator resume reports that instead of building a
+        second batcher over the first (which leaked a live worker thread
+        and its KV cache)."""
         r = self._replicas[replica]
         if rebuild or not r.batcher.worker_alive:
-            self._rebuild_replica(r)
+            if not self._transition(
+                r, (HEALTHY, DRAINING, DEAD), REBUILDING
+            ):
+                return {
+                    "replica": replica,
+                    "state": r.state,
+                    "generation": r.generation,
+                    "skipped": "rebuild already in flight",
+                }
+            try:
+                self._rebuild_replica(r)
+            except Exception:
+                self._transition(r, (REBUILDING,), DEAD)
+                raise
         else:
             r.batcher.resume()
-            with self._lock:
-                r.state = HEALTHY
-                self._cv.notify_all()
+            self._transition(r, (DRAINING, HEALTHY), HEALTHY)
         return {"replica": replica, "state": r.state,
                 "generation": r.generation}
 

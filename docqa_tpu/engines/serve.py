@@ -862,7 +862,25 @@ class ContinuousBatcher:
             self._stopped = True
             self._cv.notify_all()
         self._worker.join(timeout=10)
-        for req in list(self._queue) + [r for r in self._slot_req if r]:
+        # Sweep UNDER the lock, and include the admission window: a
+        # worker that outlived the join (wedged in a device fetch) can
+        # still mutate the deque mid-iteration, and requests it had
+        # popped but not yet made slot-resident live in NEITHER _queue
+        # nor _slot_req — the pre-PR-8 sweep read both lock-free and
+        # missed the window entirely, so a stop() against a wedged
+        # worker stranded those requests to their ResultTimeout
+        # (guarded-state true positive; regression-tested in
+        # tests/test_racecheck.py).
+        with self._cv:
+            swept = (
+                self._admitting_reqs
+                + list(self._queue)
+                + [r for r in self._slot_req if r]
+            )
+            self._admitting_reqs = []
+            self._admitting = 0
+            self._queue.clear()
+        for req in swept:
             if not req.done.is_set():
                 req.error = RuntimeError("batcher stopped")
                 _finish(req)

@@ -383,11 +383,16 @@ class VectorStore:
 
     def token_sidecar(self):
         """(tokens [capacity, W] int32, lengths [capacity] int32) device
-        arrays, or None when the sidecar is disabled.  Call under the same
-        locking discipline as search (the fused program reads them)."""
+        arrays, or None when the sidecar is disabled.  The PAIR is
+        snapshotted under the store lock: each reference store is atomic
+        under the GIL, but reading them back-to-back lock-free could
+        pair a post-append token table with a pre-append length vector
+        (guarded-state, PR 8) — the fused program would then score one
+        phantom row."""
         if not self.cfg.token_width:
             return None
-        return self._tok_dev, self._tok_len_dev
+        with self._lock:
+            return self._tok_dev, self._tok_len_dev
 
     def _get_search_fn(self, q: int, k: int, masked: bool) -> Callable:
         key = (self._capacity, q, k, masked)

@@ -76,6 +76,13 @@ class TieredIndex:
         self._tier: Optional[tuple] = None  # (IVFIndex, covered_rows)
         self._rebuild_lock = threading.Lock()
         self._rebuilding = False
+        # the in-flight background rebuild thread, KEPT so close() can
+        # join it: the old fire-and-forget `Thread(...).start()` left a
+        # daemon thread whose IVF build (a jit kmeans) could still be
+        # inside an XLA compile at interpreter exit — the same
+        # std::terminate abort the pool joins its rebuild warmups for
+        # (thread-lifecycle true positive, PR 8)
+        self._rebuild_thread: Optional[threading.Thread] = None
         # bumped by reset(): a rebuild begun against a pre-reset snapshot
         # must NOT publish (it would resurrect erased vectors and set a
         # stale covered watermark that hides newer rows)
@@ -140,7 +147,22 @@ class TieredIndex:
                 with self._rebuild_lock:
                     self._rebuilding = False
 
-        threading.Thread(target=run, daemon=True, name="ivf-rebuild").start()
+        t = threading.Thread(target=run, daemon=True, name="ivf-rebuild")
+        self._rebuild_thread = t
+        t.start()
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Join an in-flight background rebuild.  Call on shutdown — an
+        IVF build still inside XLA on a daemon thread at interpreter
+        exit aborts the process.  The bound is generous because a
+        legitimate rebuild is minutes of kmeans at 10M rows; an exceeded
+        bound logs and leaks (the pre-close behavior) rather than
+        hanging shutdown forever."""
+        t = self._rebuild_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+            if t.is_alive():
+                log.warning("ivf-rebuild still alive after close() join")
 
     # ---- search --------------------------------------------------------------
 
@@ -286,6 +308,7 @@ class TieredIndex:
         if cache is not None and cache[0] == covered:
             if cache[1] == self.store.count:
                 return cache
+        gen = self._gen
         vecs, meta = self.store.vectors_snapshot(start=covered)
         n_live = len(vecs)
         bucket = round_up(max(n_live, 1), 4096)  # stable jit shapes
@@ -298,7 +321,15 @@ class TieredIndex:
             n_live,
             meta,
         )
-        self._tail_cache = cache
+        # generation-checked publish UNDER the rebuild lock: a serving
+        # thread that snapshotted before a concurrent reset() (erasure /
+        # compaction) must not write its stale tail back — the pre-PR-8
+        # lock-free store could resurrect erased vectors and serve them
+        # until the next append invalidated the cache (guarded-state
+        # true positive; regression-tested in tests/test_racecheck.py)
+        with self._rebuild_lock:
+            if gen == self._gen:
+                self._tail_cache = cache
         return cache
 
     # ---- store passthroughs (QAService drop-in) -----------------------------

@@ -61,6 +61,18 @@ class DocQARuntime:
         cfg: Optional[Config] = None,
         journal_dir: Optional[str] = None,
     ) -> None:
+        # With DOCQA_RACE_WITNESS=1 every named lock/cv constructed
+        # from here on is instrumented and GET /api/witness serves the
+        # witnessed lock-order graph (docs/STATIC_ANALYSIS.md
+        # "Concurrency witness"; soak pulls it into its dump).  This is
+        # the FALLBACK install point (embedding/test boots): locks built
+        # at app.py IMPORT time (obs.DEFAULT_RECORDER,
+        # metrics.DEFAULT_REGISTRY) predate it and stay unwrapped here —
+        # scripts/start_all.py installs at process entry, before any
+        # docqa_tpu import, for full coverage in a served process.
+        from docqa_tpu.analysis.race_witness import maybe_install_from_env
+
+        maybe_install_from_env()
         import jax
 
         from docqa_tpu.deid.engine import DeidEngine
@@ -642,6 +654,12 @@ class DocQARuntime:
         self.pipeline.stop()
         if self.batcher is not None:
             self.batcher.stop()
+        # a tiered index may have a background ivf-rebuild mid-compile;
+        # join it before the interpreter can exit (VectorStore has no
+        # close — only the tiered composition owns a thread)
+        index_close = getattr(self.search_index, "close", None)
+        if index_close is not None:
+            index_close()
         warmup = getattr(self, "_warmup_thread", None)
         if warmup is not None and warmup.is_alive():
             # the stopped batcher fails the warmup's submits fast, but a
@@ -901,6 +919,22 @@ def make_app(rt: DocQARuntime):
         return web.json_response(
             obs.DEFAULT_RECORDER.summaries(n=limit, anomalous=anomalous)
         )
+
+    async def api_witness(_req):
+        """The concurrency witness's lock-order graph (locks seen,
+        witnessed edges, held-lock blocking events, cycles, and the
+        cross-check against the static acquisition graph).  404 unless
+        the process booted with DOCQA_RACE_WITNESS=1 — the witness must
+        wrap locks at creation, so it cannot be enabled after boot."""
+        from docqa_tpu.analysis.race_witness import witness_snapshot
+
+        snap = witness_snapshot()
+        if snap is None:
+            return json_error(
+                404,
+                "witness not installed (boot with DOCQA_RACE_WITNESS=1)",
+            )
+        return web.json_response(snap)
 
     async def api_trace_one(req):
         """One request's full timeline — JSON by default, Chrome-trace
@@ -1323,6 +1357,7 @@ def make_app(rt: DocQARuntime):
             web.get("/api/metrics", api_metrics),
             web.get("/api/telemetry", api_telemetry),
             web.get("/api/traces", api_traces),
+            web.get("/api/witness", api_witness),
             web.get("/api/trace/{trace_id}", api_trace_one),
             web.get("/api/pool", api_pool),
             web.post("/api/pool/drain", api_pool_drain),

@@ -294,9 +294,14 @@ class MemoryBroker:
         return False
 
     def close(self) -> None:
-        for f in self._journals.values():
-            f.close()
-        self._journals.clear()
+        # under the broker lock: a consumer mid-nack may be appending a
+        # journal record on another thread — closing its file underneath
+        # it turns an orderly shutdown into a ValueError inside the
+        # journal write (guarded-state, PR 8)
+        with self._lock:
+            for f in self._journals.values():
+                f.close()
+            self._journals.clear()
 
 
 class Consumer(threading.Thread):

@@ -19,6 +19,15 @@ either completes with tokens or fails with a TYPED error
 request that HANGS past its deadline is a loss — the exact failure mode
 the pool's failover exists to prevent.
 
+Both phases run under the **concurrency witness** (docqa-racecheck,
+docs/STATIC_ANALYSIS.md "Concurrency witness"): every named lock/cv the
+static analyzer knows is instrumented, the witnessed lock-order graph is
+dumped to ``witness_lockgraph_seed<N>.json`` (a CI trend artifact next
+to the trace dumps), and the run FAILS on a witnessed cycle or on a
+witnessed edge the static acquisition-order graph missed — chaos load
+is exactly when order inversions happen, and a run that survived one by
+timing luck must still go red.  ``--no-witness`` opts out.
+
 Deterministic: the same --seed perturbs the same calls every run, so a
 failure here is replayable with the printed command line.
 
@@ -264,6 +273,44 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
     return 0
 
 
+def _witness_gate(seed: int) -> int:
+    """Dump the witnessed lock-order graph (always — it is the CI trend
+    artifact) and fail on cycles or static-graph blind spots."""
+    from docqa_tpu.analysis.race_witness import witness_snapshot
+
+    snap = witness_snapshot()
+    if snap is None:
+        return 0
+    path = f"witness_lockgraph_seed{seed}.json"
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+        print(
+            f"witness: {len(snap['edges'])} lock-order edge(s), "
+            f"{len(snap['blocking'])} held-lock blocking event(s) -> {path}"
+        )
+    except Exception as e:
+        print(f"witness dump failed: {e!r}", file=sys.stderr)
+    if snap["cycles"]:
+        print(
+            f"WITNESSED LOCK-ORDER CYCLE(S): {snap['cycles']} — a real "
+            "deadlock this run happened not to lose the coin-flip on",
+            file=sys.stderr,
+        )
+        return 1
+    missing = snap.get("edges_missing_from_static") or []
+    if missing:
+        print(
+            f"WITNESSED EDGES MISSING FROM THE STATIC GRAPH: {missing} — "
+            "lock-discipline has a blind spot; fix the resolution or "
+            "declare the lock so the static gate stops vouching for "
+            "orderings it never checked",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -286,7 +333,19 @@ def main() -> int:
     ap.add_argument("--index-p", type=float, default=0.2,
                     help="probability an index batch fails (per call)")
     ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument(
+        "--no-witness", action="store_true",
+        help="skip the concurrency-witness instrumentation and its "
+        "cycle / static-cross-check gate",
+    )
     args = ap.parse_args()
+
+    if not args.no_witness:
+        # BEFORE any component constructs its locks: only primitives
+        # created after install() are wrapped
+        from docqa_tpu.analysis.race_witness import install_witness
+
+        install_witness()
 
     import jax
 
@@ -446,15 +505,22 @@ def main() -> int:
             print(f"flight recorder dumped to {dump_path}", file=sys.stderr)
         except Exception as e:
             print(f"flight-recorder dump failed: {e!r}", file=sys.stderr)
+        _witness_gate(args.seed)  # dump even on a lost-docs failure
         return 1
     n_anom = len(obs.DEFAULT_RECORDER.anomalous(100))
     print(
         "zero lost documents — every doc acked, dead-lettered, or indexed "
         f"({n_anom} anomalous timeline(s) in the flight recorder)"
     )
+    rc = 0
     if args.replica_kill:
-        return replica_kill_chaos(args.seed, args.replica_requests)
-    return 0
+        rc = replica_kill_chaos(args.seed, args.replica_requests)
+    # one witness dump covering BOTH phases (the replica phase is where
+    # the serve/pool lock interleavings actually happen) — run the gate
+    # UNCONDITIONALLY: a failed replica phase is exactly the run whose
+    # lock-order graph the trend artifact must keep for triage
+    wrc = _witness_gate(args.seed)
+    return rc or wrc
 
 
 if __name__ == "__main__":

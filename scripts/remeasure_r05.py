@@ -106,7 +106,8 @@ def main():
                     qd.append(b.n_queued)
                     time.sleep(0.05)
 
-            threading.Thread(target=sampler, daemon=True).start()
+            sampler_thread = threading.Thread(target=sampler, daemon=True)
+            sampler_thread.start()
             waiters = []
             t0 = time.perf_counter()
 
@@ -136,6 +137,7 @@ def main():
                 w.join()
             wall = time.perf_counter() - t0
             done.set()
+            sampler_thread.join(timeout=5)
         finally:
             b.stop()
         good = [l for l, k in zip(lat, ok) if k]

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Serve-cluster loop harness: reproduce/monitor the CPU-client capacity
+deadlock with the concurrency witness attached.
+
+PRs 6–7 cornered a pre-existing process deadlock with faulthandler under
+an ad-hoc loop: batcher admission + a CONCURRENT sharded retrieve (plus
+any third stream — a warmup, a canary, the next request's device ops) on
+the 8-virtual-device CPU client can exceed the client's collective
+scheduling capacity and park the process at 0% CPU.  This script is that
+loop made repeatable, with evidence capture:
+
+* a tiny sharded decoder behind a ``ContinuousBatcher`` serves request
+  waves while a second thread drives sharded ``VectorStore.search``
+  dispatches and (optionally, ``--warm-thread``) a third thread runs a
+  batcher warmup — the documented deadlock preconditions;
+* the **race witness** (``analysis/race_witness.py``) records the
+  lock-order graph and held-lock blocking calls throughout;
+* a **stream sampler** walks ``sys._current_frames()`` every 100 ms and
+  counts threads inside jax dispatch/compile frames — the *measured*
+  concurrent device-stream count the ``dispatch_streams.json`` budget
+  gates statically;
+* a **watchdog**: no decode/retrieve progress for ``--hang-s`` seconds
+  dumps every thread's stack + the witness + stream history to the
+  evidence file and exits 2 — a reproduction, recorded.
+
+Evidence lands in ``serve_cluster_evidence.json`` either way; the
+interesting fields are ``max_concurrent_device_streams`` (feeds the
+ledger budget), ``witness`` (lock orderings under the exact preconditions)
+and, on a hang, ``stacks_at_hang``.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/serve_cluster_loop.py --runs 3
+"""
+
+import argparse
+import faulthandler
+import io
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# witness BEFORE any component builds a lock
+from docqa_tpu.analysis.race_witness import (  # noqa: E402
+    install_witness,
+    witness_snapshot,
+)
+
+EVIDENCE_PATH = "serve_cluster_evidence.json"
+
+# frames whose filename/function mean "this thread holds a device stream"
+_DISPATCH_FILE_HINTS = ("/jax/", "/jaxlib/")
+_DISPATCH_FN_HINTS = (
+    "backend_compile", "_execute", "execute_sharded", "ExecuteSharded",
+    "lower", "compile", "_call_impl", "cache_miss", "device_put",
+)
+
+
+def _thread_in_dispatch(frame) -> bool:
+    while frame is not None:
+        fname = frame.f_code.co_filename
+        if any(h in fname for h in _DISPATCH_FILE_HINTS):
+            return True
+        if any(h in frame.f_code.co_name for h in _DISPATCH_FN_HINTS) and (
+            "site-packages" in fname or "/jax" in fname
+        ):
+            return True
+        frame = frame.f_back
+    return False
+
+
+class StreamSampler(threading.Thread):
+    """100 ms sampler of how many threads are inside jax dispatch."""
+
+    def __init__(self) -> None:
+        super().__init__(daemon=True, name="stream-sampler")
+        self.stop_ev = threading.Event()
+        self.max_streams = 0
+        self.histogram = {}  # concurrent-stream count -> samples
+        self.peak_threads = []
+
+    def run(self) -> None:
+        while not self.stop_ev.wait(0.1):
+            frames = sys._current_frames()
+            me = threading.get_ident()
+            busy = []
+            for tid, frame in frames.items():
+                if tid == me:
+                    continue
+                if _thread_in_dispatch(frame):
+                    busy.append(tid)
+            n = len(busy)
+            self.histogram[n] = self.histogram.get(n, 0) + 1
+            if n > self.max_streams:
+                self.max_streams = n
+                names = {t.ident: t.name for t in threading.enumerate()}
+                self.peak_threads = [
+                    names.get(tid, str(tid)) for tid in busy
+                ]
+
+
+def _all_stacks() -> dict:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        buf = io.StringIO()
+        traceback.print_stack(frame, file=buf)
+        out[names.get(tid, str(tid))] = buf.getvalue().splitlines()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runs", type=int, default=3,
+                    help="iterations of the wave+retrieve loop")
+    ap.add_argument("--requests", type=int, default=8,
+                    help="batcher requests per wave")
+    ap.add_argument("--searches", type=int, default=12,
+                    help="sharded retrieve dispatches per wave")
+    ap.add_argument("--hang-s", type=float, default=90.0,
+                    help="no-progress watchdog bound (a hang == the "
+                    "capacity deadlock reproduced)")
+    ap.add_argument("--warm-thread", action="store_true",
+                    help="add a concurrent warmup thread per wave (the "
+                    "third stream the PR-6 deadlock needed)")
+    ap.add_argument("--out", default=EVIDENCE_PATH)
+    args = ap.parse_args()
+
+    witness = install_witness()
+    faulthandler.enable()
+
+    import numpy as np
+
+    from docqa_tpu.config import DecoderConfig, GenerateConfig, StoreConfig
+    from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.engines.serve import ContinuousBatcher
+    from docqa_tpu.index.store import VectorStore
+    from docqa_tpu.runtime.mesh import host_cpu_mesh
+
+    mesh = host_cpu_mesh(8)
+    evidence = {
+        "argv": sys.argv[1:],
+        "devices": 8,
+        "runs_requested": args.runs,
+        "runs_completed": 0,
+        "hang": False,
+        "waves": [],
+    }
+    progress = {"t": time.monotonic(), "note": "boot"}
+
+    def mark(note: str) -> None:
+        progress["t"] = time.monotonic()
+        progress["note"] = note
+
+    sampler = StreamSampler()
+    sampler.start()
+
+    def finish(rc: int, extra=None) -> int:
+        sampler.stop_ev.set()
+        # join the helper threads (skip whichever of them is the caller:
+        # the watchdog itself calls finish on a hang); the sampler/
+        # watchdog loops exit at the next stop_ev tick
+        me = threading.current_thread()
+        for t in (sampler, watchdog_thread):
+            if t is not None and t is not me and t.is_alive():
+                t.join(timeout=5)
+        evidence["max_concurrent_device_streams"] = sampler.max_streams
+        evidence["stream_concurrency_histogram"] = {
+            str(k): v for k, v in sorted(sampler.histogram.items())
+        }
+        evidence["peak_stream_threads"] = sampler.peak_threads
+        evidence["witness"] = witness_snapshot()
+        if extra:
+            evidence.update(extra)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(evidence, f, indent=1, sort_keys=True)
+        print(
+            f"evidence -> {args.out} (max concurrent device streams: "
+            f"{sampler.max_streams}; witnessed edges: "
+            f"{len(evidence['witness']['edges']) if evidence['witness'] else 0})"
+        )
+        return rc
+
+    # watchdog: a reproduction must record itself, not just hang CI
+    def watchdog() -> None:
+        while not sampler.stop_ev.wait(1.0):
+            idle = time.monotonic() - progress["t"]
+            if idle > args.hang_s:
+                print(
+                    f"HANG: no progress for {idle:.0f}s after "
+                    f"{progress['note']!r} — the CPU-client capacity "
+                    "deadlock reproduced; dumping evidence",
+                    file=sys.stderr,
+                )
+                finish(
+                    2,
+                    {
+                        "hang": True,
+                        "hang_after": progress["note"],
+                        "stacks_at_hang": _all_stacks(),
+                    },
+                )
+                faulthandler.dump_traceback(file=sys.stderr)
+                os._exit(2)
+
+    watchdog_thread = threading.Thread(
+        target=watchdog, daemon=True, name="watchdog"
+    )
+    watchdog_thread.start()
+
+    # tiny sharded plane: decoder on the (1,8) mesh, store sharded too
+    engine = GenerateEngine(
+        DecoderConfig(
+            vocab_size=128, hidden_dim=64, num_layers=2, num_heads=8,
+            num_kv_heads=8, head_dim=8, mlp_dim=128, max_seq_len=128,
+            dtype="float32",
+        ),
+        GenerateConfig(temperature=0.0, prefill_buckets=(16,), eos_id=2),
+        seed=3,
+        mesh=mesh,
+    )
+    store = VectorStore(StoreConfig(dim=64, shard_capacity=512), mesh=mesh)
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((256, 64)).astype(np.float32)
+    store.add(vecs, [{"doc_id": f"d{i}"} for i in range(len(vecs))])
+    mark("components built")
+
+    batcher = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=128)
+    t0_all = time.monotonic()
+    rc = 0
+    try:
+        for run in range(args.runs):
+            t0 = time.monotonic()
+            errors = []
+
+            def retrieve_loop():
+                q = rng.standard_normal((4, 64)).astype(np.float32)
+                for i in range(args.searches):
+                    try:
+                        store.search(q, k=4)
+                        mark(f"run {run} search {i}")
+                    except Exception as e:  # recorded, not fatal
+                        errors.append(f"search {i}: {e!r}")
+
+            threads = [
+                threading.Thread(
+                    target=retrieve_loop, name=f"retrieve-{run}"
+                )
+            ]
+            if args.warm_thread:
+                threads.append(
+                    threading.Thread(
+                        target=batcher.warmup, name=f"warmup-{run}"
+                    )
+                )
+            for t in threads:
+                t.start()
+            handles = [
+                batcher.submit_ids([3 + i % 9, 5, 7], max_new_tokens=4)
+                for i in range(args.requests)
+            ]
+            ok = 0
+            for h in handles:
+                try:
+                    h.result(timeout=args.hang_s)
+                    ok += 1
+                    mark(f"run {run} result {ok}")
+                except Exception as e:
+                    errors.append(f"result: {e!r}")
+            for t in threads:
+                t.join(timeout=args.hang_s)
+            evidence["waves"].append(
+                {
+                    "run": run,
+                    "ok": ok,
+                    "errors": errors,
+                    "elapsed_s": round(time.monotonic() - t0, 2),
+                }
+            )
+            evidence["runs_completed"] = run + 1
+            print(
+                f"run {run}: {ok}/{args.requests} ok, "
+                f"{len(errors)} error(s), "
+                f"{evidence['waves'][-1]['elapsed_s']}s"
+            )
+            if errors:
+                rc = 1
+    finally:
+        mark("stopping")
+        batcher.stop()
+    evidence["elapsed_s"] = round(time.monotonic() - t0_all, 2)
+    return finish(rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

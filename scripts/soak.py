@@ -175,6 +175,35 @@ for d in results["doc_ids"]:
         bad.append(f"{d}: expected INDEXED got {rec['status']}")
 st, status = req("GET", "/api/status")
 live_expected = len(results["doc_ids"]) - len(set(results["deleted"]))
+# concurrency-witness gate (when the service booted with
+# DOCQA_RACE_WITNESS=1): a witnessed lock-order cycle, or an edge the
+# static acquisition graph missed, is a consistency violation — the
+# soak's interleavings are the evidence the static gate can't generate
+_witness_probe = None
+try:
+    _, _witness_probe = req("GET", "/api/witness", timeout=10)
+except Exception:
+    _witness_probe = None
+if _witness_probe is not None:
+    if _witness_probe.get("cycles"):
+        bad.append(f"witnessed lock-order cycles: {_witness_probe['cycles']}")
+    if _witness_probe.get("edges_missing_from_static"):
+        bad.append(
+            "witnessed edges missing from the static graph: "
+            f"{_witness_probe['edges_missing_from_static']}"
+        )
+
+
+def fetch_witness():
+    """The service's witnessed lock-order graph (GET /api/witness), or a
+    note when the service wasn't booted with DOCQA_RACE_WITNESS=1."""
+    try:
+        _, snap = req("GET", "/api/witness", timeout=10)
+        return snap
+    except urllib.error.HTTPError as e:
+        return {"unavailable": f"HTTP {e.code} (boot with DOCQA_RACE_WITNESS=1)"}
+    except Exception as e:
+        return {"unavailable": repr(e)}
 
 
 def dump_flight_recorder(reason):
@@ -212,6 +241,10 @@ def dump_flight_recorder(reason):
             "anomalous_timelines": timelines,
             "telemetry": telemetry,
             "slo": slo,
+            # witnessed lock-order graph (service booted with
+            # DOCQA_RACE_WITNESS=1): which locks contended and in what
+            # order during the soak — 404s quietly when not enabled
+            "witness": fetch_witness(),
         }
         path = "soak_traces.json"
         with open(path, "w", encoding="utf-8") as f:

@@ -30,6 +30,15 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# DOCQA_RACE_WITNESS=1: install the lock witness at PROCESS ENTRY,
+# before any other docqa_tpu import — module-level singletons
+# (obs.DEFAULT_RECORDER, runtime.metrics.DEFAULT_REGISTRY) construct
+# their locks at import time, so an install deferred to runtime init
+# would leave exactly those two out of the witnessed graph
+from docqa_tpu.analysis.race_witness import maybe_install_from_env  # noqa: E402
+
+maybe_install_from_env()
+
 
 def _pool_rolling_restart(port: int, timeout_per_replica: float = 60.0) -> bool:
     """POST /api/pool/rolling_restart — drain → rebuild → resume each

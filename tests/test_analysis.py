@@ -3,7 +3,7 @@
 Each rule gets three fixture classes: a seeded violation (detected), the
 same violation with a ``# docqa-lint: disable=<rule>`` suppression
 (silent), and a clean/sanctioned variant (silent).  The gate tests then
-run the full ten-checker suite over the real ``docqa_tpu`` tree and
+run the full fourteen-checker suite over the real ``docqa_tpu`` tree and
 assert it is exactly in sync with the committed baseline — zero new
 findings AND zero stale entries (the acceptance contract of
 ``scripts/lint.py``).
@@ -837,9 +837,12 @@ class TestBaseline:
 class TestTreeGate:
     def test_all_rules_active(self):
         assert sorted(all_checkers()) == [
+            "cv-protocol",
             "deadline-flow",
+            "dispatch-streams",
             "donation",
             "dtype-flow",
+            "guarded-state",
             "host-sync",
             "jit-purity",
             "lock-discipline",
@@ -847,6 +850,7 @@ class TestTreeGate:
             "phi-taint",
             "retrace-hazard",
             "spec-shape",
+            "thread-lifecycle",
         ]
 
     def test_tree_in_sync_with_baseline(self):

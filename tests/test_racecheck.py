@@ -1,0 +1,1303 @@
+"""docqa-racecheck: fixture tests for the four thread-safety rules
+(guarded-state, thread-lifecycle, cv-protocol, dispatch-streams), the
+lock-discipline DFS/transitive upgrade, the dynamic witness and its
+witness-vs-static cross-check, plus regression tests for the true
+positives the rules surfaced and PR 8 fixed.
+
+Same shape as tests/test_numcheck.py: per rule a seeded violation
+(detected), the violation under a ``# docqa-lint: disable=<rule>``
+suppression (silent), and a clean/sanctioned variant (silent) — plus the
+rule-specific mechanics the docstrings promise (guard-fact intersection,
+caller-holds-lock inference, Condition→lock aliasing, the stream ledger
+and its concurrency budget).
+"""
+
+import importlib.util
+import json
+import textwrap
+import threading
+
+import pytest
+
+from docqa_tpu.analysis import run
+from docqa_tpu.analysis.core import Package
+
+pytestmark = pytest.mark.lint
+
+
+def run_fixture(tmp_path, rule, sources):
+    for name, src in sources.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return run(str(tmp_path), rules=[rule], package_name="fixture")
+
+
+def load_fixture_package(tmp_path, sources):
+    for name, src in sources.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return Package.load(str(tmp_path), package_name="fixture")
+
+
+# ---------------------------------------------------------------------------
+# guarded-state
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedState:
+    def test_unguarded_read_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._depth = 0
+
+                    def push(self):
+                        with self._lock:
+                            self._depth += 1
+
+                    def peek(self):
+                        return self._depth
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "guarded by Q._lock" in findings[0].message
+        assert findings[0].symbol == "Q.peek"
+
+    def test_unguarded_write_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._depth = 0
+
+                    def push(self):
+                        with self._lock:
+                            self._depth += 1
+
+                    def reset(self):
+                        self._depth = 0
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "written without it" in findings[0].message
+
+    def test_all_guarded_clean(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._depth = 0
+
+                    def push(self):
+                        with self._lock:
+                            self._depth += 1
+
+                    def peek(self):
+                        with self._lock:
+                            return self._depth
+                """
+            },
+        )
+        assert findings == []
+
+    def test_mutating_method_is_a_write(self, tmp_path):
+        # .append under the lock establishes the guard even though the
+        # attribute is never rebound; the lock-free list() read flags
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = []
+
+                    def push(self, x):
+                        with self._lock:
+                            self._items.append(x)
+
+                    def snapshot(self):
+                        return list(self._items)
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "'_items'" in findings[0].message
+        assert findings[0].symbol == "Q.snapshot"
+
+    def test_caller_holds_lock_inference(self, tmp_path):
+        # the serve._pop_free_slots contract: a helper invoked only
+        # under the lock is analyzed as holding it
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._depth = 0
+
+                    def _bump(self):
+                        self._depth += 1
+
+                    def push(self):
+                        with self._lock:
+                            self._bump()
+
+                    def push_two(self):
+                        with self._lock:
+                            self._bump()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_locked_suffix_convention(self, tmp_path):
+        # *_locked methods are caller-holds-the-lock by convention even
+        # when one call site can't be resolved
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._depth = 0
+
+                    def _bump_locked(self):
+                        self._depth += 1
+
+                    def push(self):
+                        with self._lock:
+                            self._bump_locked()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_mixed_lock_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._a_lock = threading.Lock()
+                        self._b_lock = threading.Lock()
+                        self._depth = 0
+
+                    def one(self):
+                        with self._a_lock:
+                            self._depth = 1
+
+                    def two(self):
+                        with self._b_lock:
+                            self._depth = 2
+                """
+            },
+        )
+        assert any("mixed-lock" in f.message for f in findings)
+
+    def test_intersection_is_the_guard_not_mixed(self, tmp_path):
+        # a write under {A, B} and a write under {A} are consistently
+        # guarded by A (the recorder.flag_window shape) — NOT mixed-lock
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._a_lock = threading.Lock()
+                        self._b_lock = threading.Lock()
+                        self._depth = 0
+
+                    def one(self):
+                        with self._a_lock:
+                            self._depth = 1
+
+                    def two(self):
+                        with self._b_lock:
+                            with self._a_lock:
+                                self._depth = 2
+
+                    def read(self):
+                        with self._a_lock:
+                            return self._depth
+                """
+            },
+        )
+        assert findings == []
+
+    def test_cross_object_bridge_fact(self, tmp_path):
+        # the pool/_Replica shape: state written through `r.` under the
+        # manager's lock, read via `self.` in the owner class
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Replica:
+                    def __init__(self):
+                        self.state = "ok"
+
+                    def routable(self):
+                        return self.state == "ok"
+
+                class Pool:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.replicas = [Replica()]
+
+                    def kill(self, r):
+                        with self._lock:
+                            r.state = "dead"
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert findings[0].symbol == "Replica.routable"
+        assert "'state'" in findings[0].message
+
+    def test_published_reference_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = []
+
+                    def push(self, x):
+                        with self._lock:
+                            self._items.append(x)
+
+                    def raw(self):
+                        with self._lock:
+                            return self._items
+                """
+            },
+        )
+        assert any("published by reference" in f.message for f in findings)
+
+    def test_suppression(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "guarded-state",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._depth = 0
+
+                    def push(self):
+                        with self._lock:
+                            self._depth += 1
+
+                    def peek(self):
+                        return self._depth  # docqa-lint: disable=guarded-state
+                """
+            },
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestThreadLifecycle:
+    def test_unjoined_dispatching_daemon_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "thread-lifecycle",
+            {
+                "mod.py": """
+                import threading
+                import jax.numpy as jnp
+
+                class W:
+                    def _loop(self):
+                        return jnp.zeros((4,))
+
+                    def start(self):
+                        self._t = threading.Thread(
+                            target=self._loop, daemon=True
+                        )
+                        self._t.start()
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "jax dispatch" in findings[0].message
+        assert "aborts the process" in findings[0].message
+
+    def test_unbound_thread_detected(self, tmp_path):
+        # the fire-and-forget idiom the tiered index shipped with
+        findings = run_fixture(
+            tmp_path,
+            "thread-lifecycle",
+            {
+                "mod.py": """
+                import threading
+
+                def kick(fn):
+                    threading.Thread(target=fn, daemon=True).start()
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "no reachable join()" in findings[0].message
+
+    def test_joined_attr_clean(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "thread-lifecycle",
+            {
+                "mod.py": """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=print)
+                        self._t.start()
+
+                    def stop(self):
+                        self._t.join(timeout=5)
+                """
+            },
+        )
+        assert findings == []
+
+    def test_getattr_alias_join_clean(self, tmp_path):
+        # the DocQARuntime.stop() idiom: t = getattr(self, "_t", None)
+        findings = run_fixture(
+            tmp_path,
+            "thread-lifecycle",
+            {
+                "mod.py": """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=print)
+                        self._t.start()
+
+                    def stop(self):
+                        t = getattr(self, "_t", None)
+                        if t is not None:
+                            t.join(timeout=5)
+                """
+            },
+        )
+        assert findings == []
+
+    def test_container_flow_join_clean(self, tmp_path):
+        # waiters.append(t) ... for w in waiters: w.join() — and the
+        # append-the-Thread-directly variant
+        findings = run_fixture(
+            tmp_path,
+            "thread-lifecycle",
+            {
+                "mod.py": """
+                import threading
+
+                def fan_out(n):
+                    waiters = []
+                    for _ in range(n):
+                        t = threading.Thread(target=print)
+                        t.start()
+                        waiters.append(t)
+                    waiters.append(threading.Thread(target=print))
+                    for w in waiters:
+                        w.join()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "thread-lifecycle",
+            {
+                "mod.py": """
+                import threading
+
+                def kick(fn):
+                    threading.Thread(target=fn, daemon=True).start()  # docqa-lint: disable=thread-lifecycle
+                """
+            },
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# cv-protocol
+# ---------------------------------------------------------------------------
+
+
+class TestCvProtocol:
+    def test_wait_outside_loop_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def pop(self):
+                        with self._cv:
+                            if not self.items:
+                                self._cv.wait(1.0)
+                            return self.items.pop()
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "outside a while-predicate loop" in findings[0].message
+
+    def test_wait_in_while_clean(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def pop(self):
+                        with self._cv:
+                            while not self.items:
+                                self._cv.wait(1.0)
+                            return self.items.pop()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_notify_without_lock_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def push(self, x):
+                        self.items.append(x)
+                        self._cv.notify_all()
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "without holding" in findings[0].message
+
+    def test_notify_under_cv_clean(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def push(self, x):
+                        with self._cv:
+                            self.items.append(x)
+                            self._cv.notify_all()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_notify_under_aliased_lock_clean(self, tmp_path):
+        # Condition(self._lock): holding the LOCK is holding the cv
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cv = threading.Condition(self._lock)
+
+                    def push(self, x):
+                        with self._lock:
+                            self.items.append(x)
+                            self._cv.notify_all()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_notify_in_caller_held_helper_clean(self, tmp_path):
+        # the serve._pop_free_slots contract again, for notify
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def _wake(self):
+                        self._cv.notify_all()
+
+                    def push(self, x):
+                        with self._cv:
+                            self.items.append(x)
+                            self._wake()
+
+                    def close(self):
+                        with self._cv:
+                            self._wake()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_request_path_wait_without_deadline_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                # docqa-lint: request-path
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def pull(self):
+                        with self._cv:
+                            while not self.items:
+                                self._cv.wait(0.5)
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "without a Deadline" in findings[0].message
+
+    def test_request_path_clamped_wait_clean(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                # docqa-lint: request-path
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def pull(self, req):
+                        timeout = req.deadline.bound(30.0)
+                        with self._cv:
+                            while not self.items:
+                                self._cv.wait(timeout)
+                """
+            },
+        )
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "cv-protocol",
+            {
+                "mod.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def push(self, x):
+                        self._cv.notify_all()  # docqa-lint: disable=cv-protocol
+                """
+            },
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# dispatch-streams (ledger + budget mechanics)
+# ---------------------------------------------------------------------------
+
+_DISPATCHING_THREAD_SRC = """
+import threading
+import jax.numpy as jnp
+
+class W:
+    def _loop(self):
+        return jnp.zeros((4,))
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def stop(self):
+        self._t.join()
+"""
+
+
+class TestDispatchStreams:
+    def _checker(self, ledger_path):
+        from docqa_tpu.analysis.dispatch_streams import (
+            DispatchStreamsChecker,
+        )
+
+        return DispatchStreamsChecker(ledger_path=str(ledger_path))
+
+    def test_unledgered_stream_detected(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "dispatch-streams",
+            {"mod.py": _DISPATCHING_THREAD_SRC},
+        )
+        assert len(findings) == 1
+        assert "unledgered device-dispatch stream" in findings[0].message
+        assert "mod.py:W._loop" in findings[0].message
+
+    def test_non_dispatching_thread_ignored(self, tmp_path):
+        findings = run_fixture(
+            tmp_path,
+            "dispatch-streams",
+            {
+                "mod.py": """
+                import threading
+
+                class W:
+                    def _loop(self):
+                        return 1
+
+                    def start(self):
+                        self._t = threading.Thread(target=self._loop)
+                        self._t.start()
+
+                    def stop(self):
+                        self._t.join()
+                """
+            },
+        )
+        assert findings == []
+
+    def test_ledgered_stream_clean(self, tmp_path):
+        pkg = load_fixture_package(
+            tmp_path, {"mod.py": _DISPATCHING_THREAD_SRC}
+        )
+        ledger = tmp_path / "ledger.json"
+        ledger.write_text(
+            json.dumps(
+                {
+                    "streams": {
+                        "mod.py:W._loop": {
+                            "justification": "test stream",
+                            "concurrent_with_serving": True,
+                        }
+                    },
+                    "budget": {"max_concurrent_device_streams": 1},
+                }
+            )
+        )
+        assert self._checker(ledger).check(pkg) == []
+
+    def test_stale_ledger_entry_detected(self, tmp_path):
+        pkg = load_fixture_package(
+            tmp_path, {"mod.py": _DISPATCHING_THREAD_SRC}
+        )
+        ledger = tmp_path / "ledger.json"
+        ledger.write_text(
+            json.dumps(
+                {
+                    "streams": {
+                        "mod.py:W._loop": {"justification": "test"},
+                        "mod.py:W._gone": {"justification": "stale"},
+                    },
+                    "budget": {},
+                }
+            )
+        )
+        findings = self._checker(ledger).check(pkg)
+        assert len(findings) == 1
+        assert "stale" in findings[0].message
+
+    def test_budget_exceeded_detected(self, tmp_path):
+        src = _DISPATCHING_THREAD_SRC + textwrap.dedent(
+            """
+            class V:
+                def _loop2(self):
+                    return jnp.ones((2,))
+
+                def start(self):
+                    self._t = threading.Thread(target=self._loop2)
+                    self._t.start()
+
+                def stop(self):
+                    self._t.join()
+            """
+        )
+        pkg = load_fixture_package(tmp_path, {"mod.py": src})
+        ledger = tmp_path / "ledger.json"
+        ledger.write_text(
+            json.dumps(
+                {
+                    "streams": {
+                        "mod.py:W._loop": {
+                            "justification": "a",
+                            "concurrent_with_serving": True,
+                        },
+                        "mod.py:V._loop2": {
+                            "justification": "b",
+                            "concurrent_with_serving": True,
+                        },
+                    },
+                    "budget": {"max_concurrent_device_streams": 1},
+                }
+            )
+        )
+        findings = self._checker(ledger).check(pkg)
+        assert len(findings) == 1
+        assert "exceed the ledger budget" in findings[0].message
+
+    def test_real_ledger_entries_justified(self):
+        """Every dispatch_streams.json entry carries a real justification
+        and the budget carries recorded evidence (the baseline-ledger
+        contract, applied to streams)."""
+        from docqa_tpu.analysis.dispatch_streams import (
+            default_ledger_path,
+            load_ledger,
+        )
+
+        ledger = load_ledger(default_ledger_path())
+        assert ledger["streams"], "real stream ledger must not be empty"
+        for key, row in ledger["streams"].items():
+            j = row.get("justification", "")
+            assert j and "TODO" not in j, f"unjustified stream {key}"
+        budget = ledger["budget"]
+        assert budget["max_concurrent_device_streams"] >= 1
+        evidence = budget.get("evidence", {})
+        assert "deadlock_at_3_streams" in evidence, (
+            "the capacity-deadlock evidence must stay attached to the "
+            "budget (see scripts/serve_cluster_loop.py)"
+        )
+
+    def test_suppression(self, tmp_path):
+        src = _DISPATCHING_THREAD_SRC.replace(
+            "self._t = threading.Thread(target=self._loop)",
+            "self._t = threading.Thread(target=self._loop)  # docqa-lint: disable=dispatch-streams",
+        )
+        findings = run_fixture(tmp_path, "dispatch-streams", {"mod.py": src})
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline: full DFS + transitive closure + aliasing
+# ---------------------------------------------------------------------------
+
+
+class TestLockDisciplineDFS:
+    def test_three_cycle_detected(self, tmp_path):
+        # A->B, B->C, C->A: invisible to the old 2-cycle-only scan
+        findings = run_fixture(
+            tmp_path,
+            "lock-discipline",
+            {
+                "mod.py": """
+                import threading
+
+                class T:
+                    def __init__(self):
+                        self._a_lock = threading.Lock()
+                        self._b_lock = threading.Lock()
+                        self._c_lock = threading.Lock()
+
+                    def one(self):
+                        with self._a_lock:
+                            with self._b_lock:
+                                return 1
+
+                    def two(self):
+                        with self._b_lock:
+                            with self._c_lock:
+                                return 2
+
+                    def three(self):
+                        with self._c_lock:
+                            with self._a_lock:
+                                return 3
+                """
+            },
+        )
+        cycles = [f for f in findings if "inconsistent lock order" in f.message]
+        assert len(cycles) == 1
+        assert "T._a_lock" in cycles[0].message
+        assert "T._c_lock" in cycles[0].message
+
+    def test_transitive_closure_cycle_detected(self, tmp_path):
+        # one side takes B two CALLS deep under A — the direct-only
+        # closure missed exactly this (the witness proved it at runtime)
+        findings = run_fixture(
+            tmp_path,
+            "lock-discipline",
+            {
+                "mod.py": """
+                import threading
+
+                class T:
+                    def __init__(self):
+                        self._a_lock = threading.Lock()
+                        self._b_lock = threading.Lock()
+
+                    def _inner(self):
+                        with self._b_lock:
+                            return 1
+
+                    def _middle(self):
+                        return self._inner()
+
+                    def one(self):
+                        with self._a_lock:
+                            return self._middle()
+
+                    def two(self):
+                        with self._b_lock:
+                            with self._a_lock:
+                                return 2
+                """
+            },
+        )
+        cycles = [f for f in findings if "inconsistent lock order" in f.message]
+        assert len(cycles) == 1
+
+    def test_condition_alias_not_an_edge(self, tmp_path):
+        # Condition(self._lock) is the same lock — holding one then
+        # "acquiring" the other via a helper must not self-edge or
+        # double-count a node
+        findings = run_fixture(
+            tmp_path,
+            "lock-discipline",
+            {
+                "mod.py": """
+                import threading
+
+                class T:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cv = threading.Condition(self._lock)
+
+                    def one(self):
+                        with self._cv:
+                            return 1
+
+                    def two(self):
+                        with self._lock:
+                            return 2
+                """
+            },
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the dynamic witness + witness-vs-static cross-check
+# ---------------------------------------------------------------------------
+
+_WITNESS_SRC = """
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def ordered(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+"""
+
+
+def _load_module(tmp_path, name="witmod"):
+    spec = importlib.util.spec_from_file_location(
+        name, str(tmp_path / "mod.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRaceWitness:
+    def _build(self, tmp_path, src=_WITNESS_SRC):
+        from docqa_tpu.analysis.race_witness import (
+            LockOrderWitness,
+            build_lock_id_map,
+        )
+
+        (tmp_path / "mod.py").write_text(textwrap.dedent(src))
+        id_map, aliases, edges = build_lock_id_map([str(tmp_path)])
+        return LockOrderWitness(id_map, aliases), edges
+
+    def test_witnessed_edges_match_static(self, tmp_path):
+        witness, static_edges = self._build(tmp_path)
+        witness.install()
+        try:
+            mod = _load_module(tmp_path)
+            p = mod.Pair()
+            p.ordered()
+        finally:
+            witness.uninstall()
+        snap = witness.snapshot(static_edges=static_edges)
+        assert ("Pair._a_lock", "Pair._b_lock") in witness.edges
+        assert snap["cycles"] == []
+        assert snap["edges_missing_from_static"] == []
+
+    def test_cross_check_flags_static_blind_spot(self, tmp_path):
+        # acquire in an order the SOURCE never shows: the witness sees
+        # it, the static graph doesn't — the gate must flag it
+        witness, static_edges = self._build(tmp_path)
+        witness.install()
+        try:
+            mod = _load_module(tmp_path)
+            p = mod.Pair()
+            with p._b_lock:
+                with p._a_lock:
+                    pass
+        finally:
+            witness.uninstall()
+        snap = witness.snapshot(static_edges=static_edges)
+        assert ["Pair._b_lock", "Pair._a_lock"] in (
+            snap["edges_missing_from_static"]
+        )
+
+    def test_witnessed_cycle_detected(self, tmp_path):
+        witness, _static = self._build(tmp_path)
+        witness.install()
+        try:
+            mod = _load_module(tmp_path)
+            p = mod.Pair()
+            p.ordered()
+            with p._b_lock:
+                with p._a_lock:
+                    pass
+        finally:
+            witness.uninstall()
+        assert witness.cycles() == [
+            ["Pair._a_lock", "Pair._b_lock", "Pair._a_lock"]
+        ]
+
+    def test_condition_alias_canonicalizes(self, tmp_path):
+        src = """
+        import threading
+
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._other_lock = threading.Lock()
+
+            def work(self):
+                with self._cv:
+                    with self._other_lock:
+                        return 1
+        """
+        witness, static_edges = self._build(tmp_path, src)
+        witness.install()
+        try:
+            mod = _load_module(tmp_path, "witmod_alias")
+            q = mod.Q()
+            q.work()
+        finally:
+            witness.uninstall()
+        snap = witness.snapshot(static_edges=static_edges)
+        # the edge is recorded under the LOCK's id, not the cv alias
+        assert ("Q._lock", "Q._other_lock") in witness.edges
+        assert snap["edges_missing_from_static"] == []
+
+    def test_cv_wait_under_held_lock_is_blocking_event(self, tmp_path):
+        src = """
+        import threading
+
+
+        class Q:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def bad_wait(self):
+                with self._a_lock:
+                    with self._cv:
+                        self._cv.wait(0.01)
+        """
+        witness, _static = self._build(tmp_path, src)
+        witness.install()
+        try:
+            mod = _load_module(tmp_path, "witmod_wait")
+            q = mod.Q()
+            q.bad_wait()
+        finally:
+            witness.uninstall()
+        events = [b for b in witness.blocking if b["op"] == "cv_wait"]
+        assert events and events[0]["held"] == ["Q._a_lock"]
+        assert events[0]["lock"] == "Q._cv"
+
+    def test_unmapped_locks_stay_plain(self, tmp_path):
+        from docqa_tpu.analysis import race_witness as rw
+
+        witness, _static = self._build(tmp_path)
+        witness.install()
+        try:
+            lock = threading.Lock()  # creation site not in the id map
+            assert type(lock).__name__ != "_WitnessLock"
+            ev = threading.Event()  # Condition built inside threading.py
+            ev.set()
+        finally:
+            witness.uninstall()
+        # uninstall restored the real factories
+        assert threading.Lock is rw._REAL_LOCK
+        assert threading.RLock is rw._REAL_RLOCK
+        assert threading.Condition is rw._REAL_CONDITION
+
+    def test_reentrant_rlock_no_self_edge(self, tmp_path):
+        src = """
+        import threading
+
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    return self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+        """
+        witness, _static = self._build(tmp_path, src)
+        witness.install()
+        try:
+            mod = _load_module(tmp_path, "witmod_rlock")
+            q = mod.Q()
+            q.outer()
+        finally:
+            witness.uninstall()
+        assert witness.edges == {}
+        assert witness.cycles() == []
+
+
+# ---------------------------------------------------------------------------
+# true-positive regressions (the fixes PR 8 shipped for findings the new
+# rules surfaced in engines/ and index/)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from docqa_tpu.config import DecoderConfig, GenerateConfig
+    from docqa_tpu.engines.generate import GenerateEngine
+
+    return GenerateEngine(
+        DecoderConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            num_kv_heads=1, head_dim=16, mlp_dim=64, max_seq_len=128,
+            dtype="float32",
+        ),
+        GenerateConfig(temperature=0.0, prefill_buckets=(16,), eos_id=2),
+        seed=11,
+    )
+
+
+class TestTruePositiveRegressions:
+    def test_stop_sweeps_admission_window(self, tiny_engine):
+        """guarded-state TP (engines/serve.py): stop() used to sweep only
+        _queue + _slot_req, lock-free — a request in the admission window
+        (popped but not yet slot-resident, e.g. under a wedged worker)
+        was stranded to its ResultTimeout."""
+        from docqa_tpu.engines.serve import ContinuousBatcher, make_request
+
+        b = ContinuousBatcher(
+            tiny_engine, n_slots=2, chunk=4, cache_len=128
+        )
+        req = make_request([3, 5, 7], 4)
+        with b._cv:
+            b._admitting_reqs = [req]
+            b._admitting = 1
+        b.stop()
+        assert req.done.is_set(), (
+            "admission-window request stranded by stop()"
+        )
+        assert isinstance(req.error, RuntimeError)
+
+    def test_resume_refuses_concurrent_rebuild(self, tiny_engine):
+        """guarded-state TP (engines/pool.py): resume(rebuild=True) read
+        replica state lock-free, so it could start a second rebuild while
+        the monitor's was in flight — leaking a live worker thread and a
+        KV cache.  Transitions are CAS-gated now."""
+        from docqa_tpu.engines.pool import HEALTHY, REBUILDING, EnginePool
+
+        pool = EnginePool(
+            tiny_engine, replicas=1, n_slots=2, chunk=4, cache_len=128,
+            health_interval_s=5.0,
+        )
+        try:
+            r = pool._replicas[0]
+            gen0 = r.generation
+            assert pool._transition(r, (HEALTHY,), REBUILDING)
+            out = pool.resume(0, rebuild=True)
+            assert out.get("skipped") == "rebuild already in flight"
+            assert r.generation == gen0, "second rebuild ran anyway"
+            assert pool._transition(r, (REBUILDING,), HEALTHY)
+        finally:
+            pool.stop()
+
+    def test_wedge_kill_defers_to_drain(self, tiny_engine):
+        """The wedge path CAS: a replica an operator moved to DRAINING
+        between the monitor's (lock-free) wedge evaluation and its kill
+        must NOT be killed — the drain owns its in-flight requests."""
+        from docqa_tpu.engines.pool import DRAINING, HEALTHY, EnginePool
+
+        pool = EnginePool(
+            tiny_engine, replicas=1, n_slots=2, chunk=4, cache_len=128,
+            health_interval_s=5.0,
+        )
+        try:
+            r = pool._replicas[0]
+            assert pool._transition(r, (HEALTHY,), DRAINING)
+            # the CAS the wedge path now performs first:
+            assert not pool._transition(r, (HEALTHY,), "dead")
+            assert r.state == DRAINING
+            assert r.batcher.worker_alive
+        finally:
+            pool.stop()
+
+    def test_tail_cache_not_resurrected_after_reset(self):
+        """guarded-state TP (index/tiered.py): a serving thread computing
+        the device tail from a pre-reset() snapshot used to publish it
+        lock-free AFTER the reset cleared it — resurrecting erased
+        vectors until the next append.  The publish is generation-checked
+        under the rebuild lock now."""
+        import numpy as np
+
+        from docqa_tpu.config import StoreConfig
+        from docqa_tpu.index.store import VectorStore
+        from docqa_tpu.index.tiered import TieredIndex
+
+        store = VectorStore(StoreConfig(dim=8, shard_capacity=64))
+        store.add(
+            np.ones((4, 8), np.float32),
+            [{"doc_id": f"d{i}"} for i in range(4)],
+        )
+        tiered = TieredIndex(store, min_rows=10**9)
+        orig = store.vectors_snapshot
+        fired = []
+
+        def racy_snapshot(start=0):
+            out = orig(start=start)
+            if not fired:
+                fired.append(1)
+                tiered.reset()  # erasure lands mid-_tail_device
+            return out
+
+        store.vectors_snapshot = racy_snapshot
+        try:
+            tiered._tail_device(0)
+        finally:
+            store.vectors_snapshot = orig
+        assert fired, "the race window never opened"
+        assert tiered._tail_cache is None, (
+            "stale pre-reset tail cache was resurrected"
+        )
+
+    def test_tiered_close_joins_rebuild_thread(self):
+        """thread-lifecycle TP (index/tiered.py): the ivf-rebuild thread
+        was fire-and-forget; it is now tracked and close() joins it."""
+        import numpy as np
+
+        from docqa_tpu.config import StoreConfig
+        from docqa_tpu.index.store import VectorStore
+        from docqa_tpu.index.tiered import TieredIndex
+
+        store = VectorStore(StoreConfig(dim=8, shard_capacity=64))
+        store.add(
+            np.random.default_rng(0)
+            .standard_normal((64, 8))
+            .astype(np.float32),
+            [{"doc_id": f"d{i}"} for i in range(64)],
+        )
+        tiered = TieredIndex(
+            store, min_rows=16, rebuild_tail_rows=1, n_clusters=4
+        )
+        tiered._maybe_background_rebuild()
+        assert tiered._rebuild_thread is not None
+        tiered.close()
+        assert not tiered._rebuild_thread.is_alive()
