@@ -871,23 +871,27 @@ def main() -> None:
             "stage_attribution": rows,
         }
 
-    def run_load(engine, n_slots, chunk, n_req, cache_len):
+    def run_load(engine, n_slots, chunk, n_req, cache_len,
+                 kv_pool_tokens=None):
         """Closed-loop load: n_req concurrent requests, max_new tokens
         each, through a ContinuousBatcher.  Returns (qps, wall_s, lat_ms,
         traces, telemetry) where lat_ms are submit->done completion
         latencies, traces are the per-request obs timelines (queue-wait /
         prefill / decode-chunk / result-wait attribution), and telemetry
-        is the live sampler's view of the run: queue depth / slot
-        occupancy / per-bucket KV series plus the sampler's own CPU
+        is the live sampler's view of the run: queue depth / block-pool
+        occupancy / per-token KV bytes series plus the sampler's own CPU
         share, asserted against the 2% observability budget (soft —
-        recorded and logged, bench keeps measuring)."""
+        recorded and logged, bench keeps measuring).  ``kv_pool_tokens``
+        overcommits the paged KV pool below worst case (the kv_paging
+        sweep's fixed-HBM knob)."""
         import threading as _threading
 
         from docqa_tpu import obs
         from docqa_tpu.engines.serve import ContinuousBatcher
 
         b = ContinuousBatcher(
-            engine, n_slots=n_slots, chunk=chunk, cache_len=cache_len
+            engine, n_slots=n_slots, chunk=chunk, cache_len=cache_len,
+            kv_pool_tokens=kv_pool_tokens,
         )
         # the sampler runs DURING the measured window deliberately: the
         # serving config ships with it on, so the measured QPS includes
@@ -935,6 +939,7 @@ def main() -> None:
             for w in waiters:
                 w.join()
             wall = time.perf_counter() - t0
+            kv_static = b.kv_block_occupancy()  # pool geometry (post-run)
         finally:
             sampler.stop()
             b.stop()
@@ -948,7 +953,34 @@ def main() -> None:
             if wall > 0
             else 0.0
         )
+
+        def _series_max(name):
+            s = tstore.series(name)
+            vals = [
+                p.get("value") for p in (s or {}).get("points", [])
+                if isinstance(p.get("value"), (int, float))
+            ]
+            return max(vals) if vals else 0.0
+
+        peak_blocks = _series_max("serve_kv_blocks_used")
+        kv = {
+            # per-token KV HBM at block granularity — the paged
+            # accounting ROADMAP item 1 demands instead of per-bucket
+            "bytes_per_token": kv_static["bytes_per_token"],
+            "block_size": kv_static["block_size"],
+            "blocks_total": kv_static["blocks_total"],
+            "pool_bytes": kv_static["pool_bytes"],
+            "peak_blocks_used": int(peak_blocks),
+            "peak_kv_bytes": int(
+                peak_blocks * kv_static["block_size"]
+                * kv_static["bytes_per_token"]
+            ),
+            "peak_utilization": round(
+                peak_blocks / max(kv_static["blocks_total"], 1), 3
+            ),
+        }
         telemetry = {
+            "kv": kv,
             "sampler_ticks": sampler.ticks,
             "sampler_cpu_share_pct": round(share_pct, 3),
             "sampler_budget_pct": 2.0,
@@ -1005,7 +1037,11 @@ def main() -> None:
             "request_p95_ms": round(float(np.percentile(lat, 95)), 1),
             "best_knobs": {"n_slots": best["n_slots"], "chunk": best["chunk"]},
             "attempts": attempts,
-            # the winner run's live telemetry: queue/slot/KV-bucket
+            # first-class paged-KV accounting for the winner run:
+            # per-token bytes, block-pool peak occupancy (the ROADMAP
+            # item 1 before/after evidence)
+            "kv": telem.get("kv"),
+            # the winner run's live telemetry: queue/block-pool/KV
             # series + the sampler's measured CPU share vs its 2% budget
             "telemetry": telem,
         }
@@ -1611,10 +1647,23 @@ def main() -> None:
                 }
             )
             log(f"pool_scaling: {rows[-1]}")
+        kv = None
+        if S["gen1"] is not None:
+            from docqa_tpu.engines.paged import kv_bytes_per_token
+
+            kv = {
+                "bytes_per_token": kv_bytes_per_token(S["gen1"].cfg),
+                "note": (
+                    "per-replica paged block pools; per-token HBM at "
+                    "block granularity (see kv_paging for the fixed-HBM "
+                    "n_slots frontier)"
+                ),
+            }
         DETAILS["pool_scaling"] = {
             "arrival": "closed-loop burst",
             "requests": n_req,
             "n_slots_per_replica": n_slots,
+            "kv": kv,
             "placement": (
                 "same-host lanes, one shared device — dispatch overhead "
                 "and replication cost, not per-slice hardware scaling"
@@ -1623,9 +1672,98 @@ def main() -> None:
             "rows": rows,
         }
 
+    def sec_kv_paging():
+        """The r04 ``n_slots`` knob sweep RE-RUN under paged KV at FIXED
+        KV HBM (ROADMAP item 1's before/after evidence).  r04's best was
+        18.3 QPS at n_slots=32 with the bucket-padded slot model, where
+        every slot pinned worst-case-bucket HBM for its lifetime; here
+        the pool is pinned to the HBM 16 worst-case slots would have
+        taken, and the sweep shows how many MORE slots the same bytes
+        sustain when blocks free at retirement — per-token KV bytes and
+        block-pool occupancy are first-class columns."""
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        gen1 = S["gen1"]
+        cache_len = 1024 if not small else 256
+        n_req = 48 if not small else 8
+        # fix the pool at 16 worst-case sequences' worth of KV — the
+        # HBM the OLD model needed for n_slots=16 — and sweep the slot
+        # count PAST what that HBM could previously hold
+        base_slots = 16 if not small else 2
+        fixed_pool_tokens = base_slots * cache_len
+        sweep = (16, 32, 48) if not small else (2, 4)
+        from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY as _REG
+
+        rows = []
+        for ns in sweep:
+            if remaining() < 60 and rows:
+                log(f"kv_paging: budget stop before n_slots={ns}")
+                break
+            shed0 = _REG.counter("serve_block_shed").value
+            try:
+                qps, wall, lat, _traces, telem = run_load(
+                    gen1, ns, 16, n_req, cache_len,
+                    kv_pool_tokens=fixed_pool_tokens,
+                )
+            except Exception as e:
+                log(f"kv_paging at n_slots={ns} failed: {e!r}")
+                continue
+            if not lat:
+                continue
+            kv = telem.get("kv") or {}
+            rows.append(
+                {
+                    "n_slots": ns,
+                    "sustained_qps": round(qps, 2),
+                    "request_p50_ms": round(float(np.percentile(lat, 50)), 1),
+                    "request_p95_ms": round(float(np.percentile(lat, 95)), 1),
+                    "kv_peak_blocks_used": kv.get("peak_blocks_used"),
+                    "kv_peak_bytes": kv.get("peak_kv_bytes"),
+                    "kv_peak_utilization": kv.get("peak_utilization"),
+                    # overcommit honesty: typed pool-exhaustion sheds
+                    # during this run (0 = the fixed pool truly held
+                    # this slot count)
+                    "block_sheds": int(
+                        _REG.counter("serve_block_shed").value - shed0
+                    ),
+                }
+            )
+            log(f"kv_paging: {rows[-1]}")
+        from docqa_tpu.engines.paged import kv_bytes_per_token
+
+        bpt = kv_bytes_per_token(gen1.cfg)
+        best = max(rows, key=lambda r: r["sustained_qps"]) if rows else None
+        DETAILS["kv_paging"] = {
+            "arrival": "closed-loop burst",
+            "requests": n_req,
+            "fixed_pool_tokens": fixed_pool_tokens,
+            "fixed_pool_bytes": fixed_pool_tokens * bpt,
+            "bytes_per_token": bpt,
+            "n_slots_sweep": rows,
+            "best": best,
+            "reference_r04": {
+                "best_qps": 18.3,
+                "n_slots": 32,
+                "model": (
+                    "bucket-padded slot model: per-slot worst-case-bucket "
+                    "HBM pinned for the slot's lifetime (BENCH_r04)"
+                ),
+            },
+        }
+        if best:
+            log(
+                f"kv_paging: best {best['sustained_qps']} QPS at "
+                f"n_slots={best['n_slots']} with the pool fixed at "
+                f"{fixed_pool_tokens} KV tokens "
+                f"({fixed_pool_tokens * bpt / 1e6:.1f} MB)"
+            )
+
     run_section("e2e_1b", sec_1b, 240)
     run_section("load_1b", sec_load_1b, 200)
     run_section("pool_scaling", sec_pool_scaling, 150)
+    run_section("kv_paging", sec_kv_paging, 180)
     run_section("trace_overhead", sec_trace_overhead, 90)
     run_section("telemetry_overhead", sec_telemetry_overhead, 90)
 

@@ -152,14 +152,18 @@ def _audit_encoder_cfg():
 
 
 def _audit_serve() -> Dict[str, Any]:
-    """Decoder prefill across ALL admitted shapes — both batch families
-    (4-lane trickle + full n_slots) x every prefill bucket — plus the
-    decode chunk, through a real batcher.  Steady state = one trickle
-    round and one full round AFTER warmup; both must hit warm programs."""
+    """The PAGED batcher's whole compile surface: one ragged prefill
+    program per packed token budget (<= 2) plus the one block-table
+    decode chunk — the collapse from the pre-paged (2 shape families x
+    prompt buckets) matrix that ROADMAP item 1 demanded.  Steady state =
+    a trickle round (1 request) and a full round (n_slots requests) of
+    MIXED prompt lengths AFTER warmup; both must hit warm programs (mixed
+    lengths sharing one program is the point of ragged prefill)."""
     import jax
     import jax.numpy as jnp
 
     from docqa_tpu.engines.generate import GenerateEngine
+    from docqa_tpu.engines.paged import kv_bytes_per_token
     from docqa_tpu.engines.serve import ContinuousBatcher
 
     cfg, gen = _audit_decoder_cfg(), _audit_gen_cfg()
@@ -172,66 +176,84 @@ def _audit_serve() -> Dict[str, Any]:
         warm_prefill = jit_cache_size(prefill_fn)
         warm_decode = jit_cache_size(decode_fn)
 
-        # steady state: a trickle round (1 request) and a full round
-        # (n_slots requests) against warm programs
+        # steady state: a trickle round, then a full round of MIXED
+        # lengths (the shape-family x bucket matrix this would have
+        # retraced across before paging), against warm programs
         batcher.submit_ids([1] * 10, max_new_tokens=3).result(timeout=120)
         handles = [
-            batcher.submit_ids([1] * 10, max_new_tokens=3)
-            for _ in range(batcher.n_slots)
+            batcher.submit_ids([1] * (4 + 5 * (i % 5)), max_new_tokens=3)
+            for i in range(batcher.n_slots)
         ]
         for h in handles:
             h.result(timeout=120)
         retrace_prefill = jit_cache_size(prefill_fn) - warm_prefill
         retrace_decode = jit_cache_size(decode_fn) - warm_decode
 
-        # AOT memory per shape family at the largest bucket (counting is
-        # done — lowering can no longer pollute the numbers)
-        # mirror warmup()'s bucket derivation EXACTLY (clamp, dedupe) so
-        # expected_shapes can never drift from what warmup compiles
-        usable = batcher.cache_len - 2 - batcher.spec_k
-        buckets = sorted({min(b, usable) for b in gen.prefill_buckets})
-        bucket = max(buckets)
-        cache_struct = {
+        # AOT memory per packed token budget (counting is done —
+        # lowering can no longer pollute the numbers).  Shapes mirror
+        # warmup() EXACTLY so expected_shapes can never drift from what
+        # warmup compiles.
+        S = batcher.n_slots
+        pool_struct = {
             k: jax.ShapeDtypeStruct(v.shape, v.dtype)
-            for k, v in batcher._cache.items()
+            for k, v in batcher._pools.items()
         }
+        spec_table = (
+            jax.ShapeDtypeStruct((S, cfg.vocab_size), jnp.int32)
+            if batcher.spec_k
+            else None
+        )
         rng = jax.random.PRNGKey(0)
 
-        def prefill_mem(B: int):
+        def prefill_mem(T: int):
+            vec = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
+            packed = (vec(T), vec(T), vec(T), vec(T), vec(S), vec(S), rng)
+            if batcher.spec_k:
+                return lowered_memory(
+                    prefill_fn, engine.params, pool_struct, spec_table,
+                    *packed,
+                )
             return lowered_memory(
-                prefill_fn,
-                engine.params,
-                cache_struct,
-                jax.ShapeDtypeStruct((B, bucket), jnp.int32),
-                jax.ShapeDtypeStruct((B,), jnp.int32),
-                jax.ShapeDtypeStruct((B,), jnp.int32),
-                rng,
+                prefill_fn, engine.params, pool_struct, *packed
             )
 
         per_shape = {
-            "trickle": prefill_mem(4),
-            "full": prefill_mem(batcher.n_slots),
+            f"tokens_{T}": prefill_mem(T) for T in batcher._token_buckets
         }
-        decode_mem = lowered_memory(
-            decode_fn,
-            engine.params,
-            cache_struct,
-            jax.ShapeDtypeStruct((batcher.n_slots,), jnp.int32),
-            jax.ShapeDtypeStruct((batcher.n_slots,), jnp.int32),
-            jax.ShapeDtypeStruct((batcher.n_slots,), jnp.bool_),
-            rng,
+        tables = jax.ShapeDtypeStruct(
+            (S, batcher.blocks_per_seq), jnp.int32
         )
-        n_widths = 2 if batcher.n_slots > 4 else 1
+        caps = jax.ShapeDtypeStruct((S,), jnp.int32)
+        tok = jax.ShapeDtypeStruct((S,), jnp.int32)
+        lens = jax.ShapeDtypeStruct((S,), jnp.int32)
+        active = jax.ShapeDtypeStruct((S,), jnp.bool_)
+        if batcher.spec_k:
+            decode_mem = lowered_memory(
+                decode_fn, engine.params, pool_struct, tables, caps,
+                spec_table, tok, lens, active,
+            )
+        else:
+            decode_mem = lowered_memory(
+                decode_fn, engine.params, pool_struct, tables, caps,
+                tok, lens, active, rng,
+            )
         return {
             "meta": {
-                "n_slots": batcher.n_slots,
-                "buckets": buckets,
-                "shape_families": n_widths,
+                "n_slots": S,
+                "paged": True,
+                "token_buckets": list(batcher._token_buckets),
+                "kv_block_size": batcher.block_size,
+                "kv_pool_blocks": batcher.n_blocks,
+                "kv_bytes_per_token": kv_bytes_per_token(cfg),
+                "kv_pool_bytes": (
+                    batcher.n_blocks * batcher.block_size
+                    * kv_bytes_per_token(cfg)
+                ),
             },
             "roots": {
                 "serve_prefill": {
                     "compiles": warm_prefill,
-                    "expected_shapes": n_widths * len(buckets),
+                    "expected_shapes": len(batcher._token_buckets),
                     "steady_state_retraces": retrace_prefill,
                     "per_shape": per_shape,
                     "peak_bytes": max(
@@ -470,6 +492,22 @@ def semantic_violations(report: Dict[str, Any]) -> List[str]:
             "admission shape exists to make trickle rounds cheaper; this "
             "layout broke that"
         )
+    if serve.get("meta", {}).get("paged"):
+        # the paged tentpole's headline contract: the whole batcher
+        # compile matrix is <= 3 programs (ragged prefill token budgets
+        # + the one decode chunk) — re-derived from the MEASUREMENT so a
+        # budget regeneration cannot launder a matrix regrowth
+        total = sum(
+            int(root.get("compiles") or 0)
+            for root in serve.get("roots", {}).values()
+        )
+        if total > 3:
+            out.append(
+                f"serve: {total} compiled programs across prefill+decode "
+                "— the paged batcher's whole matrix must stay <= 3 "
+                "(ragged token budgets + one decode chunk); a regrowth "
+                "toward the per-bucket shape families is a regression"
+            )
     return out
 
 

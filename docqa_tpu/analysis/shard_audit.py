@@ -63,6 +63,8 @@ MESH_SHAPES: Dict[str, Tuple[int, int]] = {
 AUDIT_PROGRAMS = (
     "decoder_decode",
     "decoder_prefill",
+    "decoder_paged_decode",
+    "decoder_ragged_prefill",
     "ring_attention",
     "ulysses_attention",
     "retrieve_fused",
@@ -262,6 +264,104 @@ def _audit_decoder(mesh_name: str, prefill: bool, pspec_fn=None):
     return counts, meta
 
 
+def _audit_paged(mesh_name: str, prefill: bool):
+    """Lower the PAGED serving programs (engines/paged.py) under the
+    same Megatron layout: the block-pool gather/scatter must not change
+    the collective story — still exactly one all-reduce per Megatron
+    block, zero all-gathers (the pool shards kv-heads over ``model``,
+    its flat block-row axis is replicated, and every table index rides
+    that unsharded axis).  This is the ISSUE's "unchanged collective
+    budget" evidence for the paged KV tentpole."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from docqa_tpu.engines.paged import (
+        paged_decode_forward,
+        ragged_prefill_forward,
+    )
+    from docqa_tpu.parallel.sharding import (
+        decoder_param_pspecs,
+        paged_pool_pspecs,
+    )
+
+    cfg = _audit_decoder_cfg()
+    mesh = _mesh(mesh_name)
+    slots, block_size, n_blocks = 4, 8, 16
+    rope_len = 32
+    params, _cache, _ids, _lengths = _decoder_abstract_args(cfg, slots, 1, 8)
+    pools = {
+        f"{kv}{i}": jax.ShapeDtypeStruct(
+            (n_blocks * block_size, cfg.num_kv_heads, cfg.head_dim),
+            jnp.bfloat16,
+        )
+        for i in range(cfg.num_layers)
+        for kv in ("k", "v")
+    }
+    pspecs = decoder_param_pspecs(cfg, mesh.model_axis)
+    pool_specs = paged_pool_pspecs(cfg, mesh)
+    replicated = NamedSharding(mesh.mesh, P())
+    param_shardings = {
+        k: NamedSharding(mesh.mesh, pspecs[k]) for k in params
+    }
+    pool_shardings = {
+        k: NamedSharding(mesh.mesh, pool_specs[k]) for k in pools
+    }
+
+    if prefill:
+        T = 128
+
+        def program(params, pools, ids, seg, pos, dest, last_rows):
+            return ragged_prefill_forward(
+                params, cfg, pools, ids, seg, pos, dest, last_rows,
+                rope_len=rope_len,
+            )
+
+        args = (
+            params,
+            pools,
+            jax.ShapeDtypeStruct((T,), jnp.int32),
+            jax.ShapeDtypeStruct((T,), jnp.int32),
+            jax.ShapeDtypeStruct((T,), jnp.int32),
+            jax.ShapeDtypeStruct((T,), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+        )
+        in_shardings = (
+            param_shardings, pool_shardings,
+            replicated, replicated, replicated, replicated, replicated,
+        )
+    else:
+
+        def program(params, pools, tables, tok, lengths):
+            return paged_decode_forward(
+                params, cfg, pools, tables, tok, lengths,
+                block_size=block_size, rope_len=rope_len,
+            )
+
+        args = (
+            params,
+            pools,
+            jax.ShapeDtypeStruct((slots, 4), jnp.int32),
+            jax.ShapeDtypeStruct((slots, 1), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+        )
+        in_shardings = (
+            param_shardings, pool_shardings,
+            replicated, replicated, replicated,
+        )
+    compiled = (
+        jax.jit(program, in_shardings=in_shardings).lower(*args).compile()
+    )
+    counts = count_hlo_collectives(compiled.as_text())
+    meta = {
+        "num_layers": cfg.num_layers,
+        "megatron_blocks": 2 * cfg.num_layers,
+        "block_size": block_size,
+        "model_parallel": mesh.n_model,
+    }
+    return counts, meta
+
+
 def _attention_abstract_args():
     import jax
     import jax.numpy as jnp
@@ -358,6 +458,8 @@ def _audit_retrieve(mesh_name: str):
 _AUDITS: Dict[str, Callable[[str], Tuple[Dict[str, int], Dict[str, Any]]]] = {
     "decoder_decode": functools.partial(_audit_decoder, prefill=False),
     "decoder_prefill": functools.partial(_audit_decoder, prefill=True),
+    "decoder_paged_decode": functools.partial(_audit_paged, prefill=False),
+    "decoder_ragged_prefill": functools.partial(_audit_paged, prefill=True),
     "ring_attention": _audit_ring,
     "ulysses_attention": _audit_ulysses,
     "retrieve_fused": _audit_retrieve,
@@ -441,7 +543,12 @@ def semantic_violations(report: Dict[str, Any]) -> List[str]:
     out: List[str] = []
     progs = report.get("programs", {})
 
-    for name in ("decoder_decode", "decoder_prefill"):
+    for name in (
+        "decoder_decode",
+        "decoder_prefill",
+        "decoder_paged_decode",
+        "decoder_ragged_prefill",
+    ):
         prog = progs.get(name)
         if not prog:
             continue

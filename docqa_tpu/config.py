@@ -504,29 +504,59 @@ class GenerateConfig:
     top_p: float = 1.0
     eos_id: int = 2
     pad_id: int = 0
-    # decode-step bucketing: prefill lengths are padded to these buckets so a
-    # handful of compiled programs cover all requests.
+    # SOLO-engine prefill bucketing (GenerateEngine): prompt lengths pad
+    # to these buckets so a handful of compiled programs cover all
+    # requests.  The continuous batcher no longer buckets prompts — its
+    # ragged prefill packs mixed lengths into prefill_token_buckets below.
     prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
-    # startup warm depth: how many of the SMALLEST prefill buckets the
-    # runtime pre-compiles (both admission shape families + the decode
-    # chunk) in the background at boot via ContinuousBatcher.warmup().
-    # -1 = the whole bucket ladder (a deployment that wants zero compile
-    # surprises pays the full compile bill up front); 0 = none.  The
-    # default keeps dev/CPU boots cheap; the compile audit proves the
-    # full-set mechanism retrace-free regardless (compile_budget.json).
+    # Ragged-prefill token budgets for the continuous batcher (engines/
+    # serve.py + engines/paged.py): an admission round packs its prompts
+    # (starts 128-aligned) into the smallest budget that fits, splitting
+    # across dispatches past the largest.  The batcher always ADDS the
+    # full packed cache capacity to this set (a maximal prompt must fit
+    # one dispatch), so the WHOLE batcher prefill compile surface is
+    # this-set-plus-full — one program per budget, regardless of how
+    # prompt lengths mix — versus the old (2 shape families x
+    # prefill_buckets) matrix.  The default single trickle budget keeps
+    # the total at prefill<=2 + decode = <=3 programs at ANY cache
+    # length (compile_budget.json gates the collapse and the <=3 total).
+    prefill_token_buckets: Tuple[int, ...] = (512,)
+    # startup warm depth: how many of the SMALLEST ragged token budgets
+    # the runtime pre-compiles (plus the decode chunk) in the background
+    # at boot via ContinuousBatcher.warmup().  -1 = every budget (a
+    # deployment that wants zero compile surprises pays the full compile
+    # bill up front); 0 = none.  The default keeps dev/CPU boots cheap;
+    # the compile audit proves the full-set mechanism retrace-free
+    # regardless (compile_budget.json).
     startup_warm_buckets: int = 1
     max_concurrent: int = 16  # continuous batching lanes (QPS 16 target)
     # tokens per batcher decode dispatch: larger chunks amortize dispatch
     # round-trips (dominant over a tunneled TPU) at the cost of coarser
     # slot-retirement granularity
     decode_chunk: int = 16
-    # prompt-lookup speculative decoding (GenerateEngine, greedy only):
-    # verify width per step; 0/1 disables.  Decode is HBM-bound, so a
-    # K-token verify costs one weight read like a single step but emits the
-    # matched draft prefix + 1 — RAG answers that quote retrieved context
-    # draft well from the prompt's own bigrams.  Output-exact vs plain
-    # greedy by construction.
-    speculative_k: int = 0
+    # paged KV cache (engines/paged.py; docs/OPERATIONS.md "Paged KV
+    # cache"): tokens per KV block.  Smaller blocks waste less on the
+    # last partial block per request but grow the block-table/alloc
+    # churn; 16 matches the RPA paper's sweet spot.
+    kv_block_size: int = 16
+    # total KV tokens the shared HBM block pool holds.  None = worst-case
+    # provisioning (n_slots x cache capacity — no request mix can ever
+    # exhaust the pool, matching the old per-slot reservation byte for
+    # byte).  Set BELOW that to overcommit: mixed real-world lengths
+    # rarely sum to worst case, so the same HBM sustains more slots
+    # (bench.py kv_paging sweep measures the frontier); exhaustion then
+    # sheds typed (serve.BlockPoolExhausted) instead of admitting work
+    # the pool cannot hold.
+    kv_pool_tokens: Optional[int] = None
+    # prompt-lookup speculative decoding (greedy only): verify width per
+    # step; 0/1 disables.  Decode is HBM-bound, so a K-token verify costs
+    # one weight read like a single step but emits the matched draft
+    # prefix + 1 — RAG answers that quote retrieved context draft well
+    # from the prompt's own bigrams.  Output-exact vs plain greedy by
+    # construction (tests/test_speculative.py gates the equality), so the
+    # BENCH_r04-measured default (17.3 -> 18.3 QPS at 1M chunks) ships on:
+    # 4 was a bench knob, promoted per ROADMAP item 3.
+    speculative_k: int = 4
 
 
 @dataclass(frozen=True)

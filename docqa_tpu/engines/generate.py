@@ -52,6 +52,41 @@ CHAT_TEMPLATES = {
 }
 
 
+def draft_tokens(table, cur, K):
+    """Chained bigram drafting: K-1 draft tokens per lane from the lookup
+    table (misses repeat the current token — a cheap guess).  The one
+    drafting implementation: the solo speculative loop AND the batcher's
+    paged speculative chunk both call this, so their draft streams can
+    never diverge."""
+    lane = jnp.arange(cur.shape[0])
+
+    def draft_step(tok, _):
+        nt = table[lane, tok]
+        nt = jnp.where(nt < 0, tok, nt)
+        return nt, nt
+
+    _, drafts_t = jax.lax.scan(draft_step, cur, None, length=K - 1)
+    return jnp.swapaxes(drafts_t, 0, 1)  # [b, K-1]
+
+
+def accept_drafts(logits, drafts, eos_id):
+    """Verify-step acceptance math shared by every speculative path:
+    greedy targets ``g`` [b, K] from the verify logits, accepted-draft
+    count ``m``, the emission-candidate mask (g0..gm), EOS hits among
+    candidates, and the first-EOS position (K = none).  Every emitted
+    token is an argmax of the model's own logits — acceptance only
+    decides how many argmaxes one weight read yields."""
+    K = logits.shape[1]
+    karange = jnp.arange(K)[None, :]
+    g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, K]
+    match = (drafts == g[:, :-1]).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
+    cand = karange <= m[:, None]  # emission candidates g0..gm
+    is_eos = (g == eos_id) & cand
+    eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
+    return g, m, cand, is_eos, eos_pos
+
+
 class GenerateEngine:
     def __init__(
         self,
@@ -273,7 +308,9 @@ class GenerateEngine:
     def spec_verify_step(self, params, cache, table, cur, lengths, *, K):
         """The draft → verify → accept core shared by the solo speculative
         loop and the batcher's speculative chunk program (the two MUST stay
-        output-exact; sharing the subtle part keeps them from diverging).
+        output-exact; sharing the subtle part keeps them from diverging —
+        the batcher's PAGED variant composes the same :func:`draft_tokens`
+        / :func:`accept_drafts` halves around its block-pool forward).
 
         Drafts K-1 tokens per lane by chained bigram lookup, verifies them
         in one forward of q_len=K, and returns
@@ -282,28 +319,15 @@ class GenerateEngine:
         hits among candidates, and the first-EOS position (K = none).
         Callers apply their own emission masking (budget / live slots) and
         state updates."""
-        b = cur.shape[0]
-        lane = jnp.arange(b)
-        karange = jnp.arange(K)[None, :]
-
-        def draft_step(tok, _):
-            nt = table[lane, tok]
-            nt = jnp.where(nt < 0, tok, nt)  # miss: repeat (cheap guess)
-            return nt, nt
-
-        _, drafts_t = jax.lax.scan(draft_step, cur, None, length=K - 1)
-        drafts = jnp.swapaxes(drafts_t, 0, 1)  # [b, K-1]
+        drafts = draft_tokens(table, cur, K)
         verify_in = jnp.concatenate([cur[:, None], drafts], axis=1)
         logits, cache = decoder_forward(
             params, self.cfg, verify_in, cache, lengths,
             attn_lengths=lengths + K, use_flash=self.use_flash,
         )
-        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, K]
-        match = (drafts == g[:, :-1]).astype(jnp.int32)
-        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
-        cand = karange <= m[:, None]  # emission candidates g0..gm
-        is_eos = (g == self.gen.eos_id) & cand
-        eos_pos = jnp.where(jnp.any(is_eos, 1), jnp.argmax(is_eos, 1), K)
+        g, m, cand, is_eos, eos_pos = accept_drafts(
+            logits, drafts, self.gen.eos_id
+        )
         return cache, g, m, cand, is_eos, eos_pos
 
     def confirm_bigrams(self, table, cur, g, emit_valid):

@@ -1,0 +1,311 @@
+"""Block-table paged KV cache for the continuous batcher (ROADMAP item 1;
+Ragged Paged Attention, arXiv 2604.15464).
+
+The bucket-padded slot model this replaces pinned worst-case-bucket HBM
+per slot for the slot's whole lifetime and compiled one prefill program
+per (shape family x prompt bucket).  Here KV lives in ONE flat HBM block
+pool shared by every slot:
+
+* **host side** — :class:`BlockAllocator`: a lock-disciplined free list
+  of fixed-size KV blocks with per-request :class:`BlockTable`\\ s.
+  Blocks are allocated at admission (prompt + a grow margin), grown at
+  decode as a lane's length approaches its allocated capacity, and freed
+  at retirement — a long-running request holds blocks proportional to
+  the tokens it has actually produced, not to the worst-case bucket.
+  Release is idempotent AND double-free-guarded (the drain / steal /
+  failover paths must free exactly once; tests/test_paged.py).
+* **device side** — :func:`ragged_prefill_forward` scatters a PACKED
+  batch of mixed-length prompts into their block tables in one dispatch
+  (no shape families, no per-bucket padding: any length mix that fits
+  the token budget shares one compiled program), and
+  :func:`paged_decode_forward` advances lanes by gathering K/V through
+  the block table.  Both are thin compositions of the shared decoder
+  trunk (:func:`~docqa_tpu.models.decoder.decoder_layer_stack`) with the
+  ragged/paged attention ops (``ops/attention.py``), so the layer math
+  can never drift from the dense solo engine — the serve-vs-solo
+  token-equality invariant holds by construction.
+
+The allocator is HOST-ONLY and thread-light by design: the batcher
+worker is the single caller of alloc/grow on the hot path, other threads
+only read stats or release tables — no new thread ever reaches a jax
+dispatch (``dispatch_streams.json`` is unchanged by this module).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from docqa_tpu.config import DecoderConfig
+from docqa_tpu.models.decoder import (
+    Params,
+    decoder_head,
+    decoder_layer_stack,
+)
+from docqa_tpu.ops.attention import (
+    paged_decode_attention,
+    ragged_prefill_attention,
+)
+
+PagedPools = Dict[str, "jnp.ndarray"]  # "k0".."k{L-1}", "v0".."v{L-1}"
+
+
+class OutOfBlocks(RuntimeError):
+    """The allocator could not satisfy a block request.  Internal to the
+    paging layer: the batcher maps it to its typed admission/decode shed
+    (``serve.BlockPoolExhausted``) with the request context attached."""
+
+
+class BlockTable:
+    """Per-request block list.  All mutation goes through the owning
+    :class:`BlockAllocator` (one lock for table + free list, so a
+    release racing a grow can never tear the accounting)."""
+
+    __slots__ = ("blocks", "released", "_alloc")
+
+    def __init__(self, alloc: "BlockAllocator") -> None:
+        self.blocks: List[int] = []
+        self.released = False
+        self._alloc = alloc
+
+    @property
+    def capacity(self) -> int:
+        """Tokens this table can currently hold."""
+        return self._alloc.capacity_of(self)
+
+    def ensure(self, n_tokens: int) -> None:
+        """Grow to cover ``n_tokens`` (no-op when already covered).
+        Raises :class:`OutOfBlocks` atomically: either every needed
+        block is taken or none are."""
+        self._alloc.grow(self, n_tokens)
+
+    def release(self) -> None:
+        """Return every block to the pool.  Idempotent and thread-safe:
+        retire (worker), stop-sweep (caller thread), and failover paths
+        may all reach a table — exactly one of them frees it."""
+        self._alloc.release(self)
+
+
+class BlockAllocator:
+    """Free-list allocator over a fixed pool of KV blocks.
+
+    LIFO reuse keeps recently-freed blocks hot; allocation is
+    all-or-nothing so a half-admitted request never strands blocks.
+    Double frees raise (rather than silently inflating the free list) —
+    the accounting IS the leak detector the chaos/drain tests assert on.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int) -> None:
+        if n_blocks <= 0 or block_size <= 0:
+            raise ValueError("n_blocks and block_size must be positive")
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self._lock = threading.Lock()
+        # LIFO stack: low block ids hand out first (stable tests/debug)
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._in_use = 0
+
+    # ---- table lifecycle -------------------------------------------------
+
+    def new_table(self) -> BlockTable:
+        return BlockTable(self)
+
+    def capacity_of(self, table: BlockTable) -> int:
+        with self._lock:
+            return len(table.blocks) * self.block_size
+
+    def grow(self, table: BlockTable, n_tokens: int) -> None:
+        with self._lock:
+            need = -(-int(n_tokens) // self.block_size) - len(table.blocks)
+            if need <= 0:
+                return
+            if table.released:
+                raise OutOfBlocks("table already released")
+            if need > len(self._free):
+                raise OutOfBlocks(
+                    f"need {need} block(s), {len(self._free)} free "
+                    f"(pool {self.n_blocks} x {self.block_size} tokens)"
+                )
+            table.blocks.extend(
+                self._free.pop() for _ in range(need)
+            )
+            self._in_use += need
+
+    def release(self, table: BlockTable) -> None:
+        with self._lock:
+            if table.released:
+                return
+            table.released = True
+            if not table.blocks:
+                return
+            freed = set(table.blocks)
+            if len(freed) != len(table.blocks) or not freed.isdisjoint(
+                self._free
+            ):
+                # a block can be owned by exactly one live table; seeing
+                # it free (or listed twice) means the exactly-once
+                # contract broke upstream — fail loudly, never double-add
+                raise RuntimeError(
+                    "double free detected: blocks already in the free "
+                    f"list ({sorted(freed & set(self._free))[:4]}...)"
+                )
+            self._free.extend(table.blocks)
+            self._in_use -= len(table.blocks)
+            table.blocks = []
+
+    # ---- sizing / stats --------------------------------------------------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def can_alloc(self, n_blocks: int) -> bool:
+        with self._lock:
+            return int(n_blocks) <= len(self._free)
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return self._in_use
+
+
+# ---------------------------------------------------------------------------
+# device side: block pool init + ragged/paged forwards
+# ---------------------------------------------------------------------------
+
+
+def init_paged_pools(
+    cfg: DecoderConfig, n_blocks: int, block_size: int,
+    dtype: Optional["jnp.dtype"] = None,
+) -> PagedPools:
+    """Flat per-layer K/V block pools: [n_blocks * block_size, kv_heads,
+    head_dim].  Row ``b * block_size + o`` is offset ``o`` of block ``b``
+    — the one flat axis both the prefill scatter and the decode gather
+    index, so a block id IS a row range."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (n_blocks * block_size, cfg.num_kv_heads, cfg.head_dim)
+    pools: PagedPools = {}
+    for i in range(cfg.num_layers):
+        pools[f"k{i}"] = jnp.zeros(shape, dtype)
+        pools[f"v{i}"] = jnp.zeros(shape, dtype)
+    return pools
+
+
+def kv_bytes_per_token(cfg: DecoderConfig) -> int:
+    """HBM bytes one token of KV occupies across every layer — the
+    block-granular accounting unit the bench and telemetry report
+    (ROADMAP item 1: per-token bytes instead of per-bucket)."""
+    return (
+        2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+
+
+def ragged_prefill_forward(
+    params: Params,
+    cfg: DecoderConfig,
+    pools: PagedPools,
+    ids,  # [T] packed prompt tokens (pad elsewhere)
+    seg_ids,  # [T] int32 lane index per token; -1 = padding
+    positions,  # [T] int32 position within its own sequence
+    dest_rows,  # [T] int32 flat pool row per token; >= P = dropped
+    last_rows,  # [B] int32 packed row of each lane's last prompt token
+    *,
+    rope_len: int,
+):
+    """Prefill a whole admission round of MIXED-length prompts in one
+    dispatch: every token computes through the shared trunk, scatters its
+    K/V straight into its block-table rows, and each lane's last-token
+    hidden state feeds the head.
+
+    Returns (last_logits [B, vocab] f32, pools).  Padding lanes produce
+    garbage logits the caller ignores (their scatter rows are
+    out-of-bounds and dropped).  No shape family, no prompt bucket: the
+    compile key is the token budget T alone.
+    """
+
+    def attend(i, q, k, v):
+        kp = pools[f"k{i}"]
+        pools[f"k{i}"] = kp.at[dest_rows].set(
+            k[0].astype(kp.dtype), mode="drop"
+        )
+        vp = pools[f"v{i}"]
+        pools[f"v{i}"] = vp.at[dest_rows].set(
+            v[0].astype(vp.dtype), mode="drop"
+        )
+        # attention over the packed batch itself: every KV row a prompt
+        # token needs is in-flight in this very dispatch (fresh prompts
+        # never read older pool state)
+        return ragged_prefill_attention(
+            q[0], k[0], v[0], seg_ids, positions,
+            sliding_window=cfg.sliding_window,
+        )[None]
+
+    x = decoder_layer_stack(
+        params, cfg, ids[None, :], positions[None, :], rope_len, attend
+    )
+    x_last = x[0][last_rows]  # [B, hidden]
+    logits = decoder_head(params, cfg, x_last[:, None, :])
+    return logits[:, 0], pools
+
+
+def paged_decode_forward(
+    params: Params,
+    cfg: DecoderConfig,
+    pools: PagedPools,
+    block_tables,  # [S, NB] int32; entries >= n_blocks are holes
+    tok,  # [S, s] next token(s) per lane (s=1 plain, K spec verify)
+    lengths,  # [S] tokens already in each lane's KV
+    *,
+    block_size: int,
+    rope_len: int,
+    use_flash: bool = False,
+):
+    """Advance every lane ``s`` tokens against the block pool: write each
+    new token's K/V at its table-mapped row, attend through the table.
+
+    Writes whose position falls past a lane's allocated blocks (hole
+    entries / retired lanes whose table row went sentinel) are DROPPED —
+    the in-program capacity guard in the batcher's chunk programs stops
+    live lanes before that can happen, so a dropped write only ever
+    belongs to an inactive lane re-writing its scratch row.
+
+    Returns (logits [S, s, vocab] f32, pools)."""
+    S, s = tok.shape
+    nb = block_tables.shape[1]
+    P = pools["k0"].shape[0]
+    n_blocks = P // block_size
+
+    pos = lengths[:, None] + jnp.arange(s)[None, :]  # [S, s]
+    blk_idx = pos // block_size
+    blk = jnp.take_along_axis(
+        block_tables, jnp.minimum(blk_idx, nb - 1), axis=1
+    )
+    dest = jnp.where(
+        (blk_idx < nb) & (blk < n_blocks),
+        blk * block_size + pos % block_size,
+        P,  # out of bounds -> dropped write
+    )
+    rope_pos = jnp.minimum(pos, rope_len - 1)
+    attn_lengths = lengths + s
+
+    def attend(i, q, k, v):
+        kp = pools[f"k{i}"]
+        pools[f"k{i}"] = kp.at[dest].set(k.astype(kp.dtype), mode="drop")
+        vp = pools[f"v{i}"]
+        pools[f"v{i}"] = vp.at[dest].set(v.astype(vp.dtype), mode="drop")
+        return paged_decode_attention(
+            q, pools[f"k{i}"], pools[f"v{i}"], block_tables, attn_lengths,
+            block_size=block_size, q_offset=lengths,
+            sliding_window=cfg.sliding_window, use_flash=use_flash,
+        )
+
+    x = decoder_layer_stack(params, cfg, tok, rope_pos, rope_len, attend)
+    logits = decoder_head(params, cfg, x)
+    return logits, pools

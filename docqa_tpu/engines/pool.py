@@ -1120,13 +1120,22 @@ class EnginePool:
     def n_admitting(self) -> int:
         return sum(r.batcher.n_admitting for r in self._replicas)
 
-    def kv_slot_occupancy(self) -> Dict[int, int]:
-        """Pool-wide active KV slots per prefill bucket (telemetry
-        scrape surface — same shape as the solo batcher's)."""
-        out: Dict[int, int] = {}
+    def kv_block_occupancy(self) -> Dict[str, float]:
+        """Pool-wide KV block-pool occupancy (telemetry scrape surface —
+        same shape as the solo batcher's; counts/bytes sum over
+        replicas, per-token byte cost and block size are config-wide)."""
+        out: Dict[str, float] = {}
         for r in self._replicas:
-            for bucket, n in r.batcher.kv_slot_occupancy().items():
-                out[bucket] = out.get(bucket, 0) + n
+            occ = r.batcher.kv_block_occupancy()
+            for key in (
+                "blocks_total", "blocks_used", "pool_bytes", "used_bytes",
+                "tokens_committed",
+            ):
+                out[key] = out.get(key, 0) + occ[key]
+            out["block_size"] = occ["block_size"]
+            out["bytes_per_token"] = occ["bytes_per_token"]
+        if out.get("blocks_total"):
+            out["utilization"] = out["blocks_used"] / out["blocks_total"]
         return out
 
     def status(self) -> Dict[str, Any]:

@@ -2,20 +2,36 @@
 
 The reference served generation through one external Ollama process per
 request (``llm-qa/main.py:66-69``) — no batching, no admission control.
-Here a fixed pool of decode *slots* shares one KV cache and one jit decode
-program:
+Here a fixed pool of decode *slots* shares one PAGED KV block pool and a
+two-program compile surface (docqa-paged; ROADMAP item 1, Ragged Paged
+Attention arXiv 2604.15464):
 
-* admission: every free slot is filled from the queue in ONE batched
-  prefill dispatch per round (requests ride the batch axis, each lane
-  scattering its prompt K/V into its slot of the shared cache) — measured
-  on the tunneled chip, per-request prefill dispatches were the QPS
-  ceiling: 16 sequential batch-1 forwards cost ~12x one batch-16 forward;
+* KV paging: prompt and decode K/V live in fixed-size blocks of one flat
+  HBM pool (``engines/paged.py``).  A host-side allocator hands each
+  request a block table at admission, grows it as decode advances, and
+  frees it at retirement — a slot holds HBM proportional to the tokens
+  it actually produced, never a worst-case bucket for its lifetime (the
+  pre-paged model pinned bucket-sized rows per slot; the `_slot_bucket`
+  gauges PR 7 added existed to show exactly that waste).  Pool
+  exhaustion is a typed, deadline-aware admission signal
+  (:class:`BlockPoolExhausted`), not an OOM.
+* admission: every free slot is filled from the queue in ONE ragged
+  prefill dispatch per round — mixed-length prompts PACK into a flat
+  token axis (starts 128-aligned, see ``ops/attention.RAGGED_ALIGN``)
+  and scatter straight into their block tables.  No shape families, no
+  per-bucket padding: the compile key is the packed token budget alone
+  (``gen.prefill_token_buckets``, <= 2 programs), versus the old
+  (2 families x buckets) matrix — ``compile_budget.json`` gates the
+  collapse.  Rounds whose prompts exceed the largest budget split
+  across dispatches of the same shape (zero retraces either way);
 * decode: ONE program advances all slots a chunk of tokens per dispatch
   (``lax.fori_loop`` inside jit — no host round-trip per token, SURVEY §7
-  hard part (b)); finished lanes go inactive inside the chunk;
-* retirement: a slot frees as soon as its lane hits EOS or its token budget,
-  and the next queued request takes it — throughput tracks the number of
-  *live* requests, not the slowest member of a static batch;
+  hard part (b)), gathering K/V through the block tables; finished lanes
+  go inactive inside the chunk;
+* retirement: a slot frees — and returns its KV blocks — as soon as its
+  lane hits EOS or its token budget, and the next queued request takes
+  it: throughput tracks the number of *live* requests, HBM tracks the
+  number of *live tokens*;
 * pipelining: the worker keeps ONE decode chunk in flight past the host —
   chunk N+1 is dispatched on chunk N's device-side output state (a pure
   data dependency, no host sync) *before* chunk N's packed results are
@@ -29,12 +45,15 @@ program:
   one; a snapshot guard drops tokens for any slot whose occupant changed
   anyway.  Slots that retire on budget mid-pipeline decode one extra chunk
   whose tokens are discarded — wasted compute, never wrong output — and an
-  in-program cache-bound guard deactivates any lane before its K/V write
-  could clamp, so the overshoot cannot corrupt cache rows.
+  in-program capacity guard deactivates any lane before a K/V write could
+  land past its allocated blocks (such writes are additionally dropped,
+  never clamped, by the paged scatter).  Freed blocks can be re-used by
+  the very next admission because the pool is DONATED through every
+  dispatch: an in-flight overshoot chunk's stale writes are sequenced
+  before the prefill that re-populates those rows.
 
-The KV cache is donated through both programs (prefill scatter and decode
-chunk), so slot state stays HBM-resident across the whole serving session.
-TP shardings come from ``parallel/sharding.py``; slots ride the batch axis.
+TP shardings come from ``parallel/sharding.py`` (block pool: kv-heads over
+the model axis, block rows replicated); slots ride the batch axis.
 """
 
 from __future__ import annotations
@@ -46,23 +65,31 @@ import threading
 from dataclasses import dataclass, field
 from time import monotonic as time_monotonic
 from time import perf_counter as _now
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from docqa_tpu import obs
-from docqa_tpu.models.decoder import (
-    decoder_forward,
-    init_decoder_params,  # noqa: F401  (re-export convenience for tests)
-    init_kv_cache,
+from docqa_tpu.engines.paged import (
+    BlockAllocator,
+    OutOfBlocks,
+    init_paged_pools,
+    kv_bytes_per_token,
+    paged_decode_forward,
+    ragged_prefill_forward,
 )
+from docqa_tpu.engines.generate import accept_drafts, draft_tokens
+from docqa_tpu.models.decoder import (
+    init_decoder_params,  # noqa: F401  (re-export convenience for tests)
+)
+from docqa_tpu.ops.attention import RAGGED_ALIGN
 from docqa_tpu.ops.sampling import sample
 from docqa_tpu.resilience import faults
 from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
-from docqa_tpu.utils import pick_bucket, round_up
+from docqa_tpu.utils import round_up
 
 log = get_logger("docqa.serve")
 
@@ -327,6 +354,21 @@ class Draining(QueueFull):
     pool routes around draining replicas before this is ever raised)."""
 
 
+class BlockPoolExhausted(QueueFull):
+    """The KV block pool ran dry (docs/OPERATIONS.md "Paged KV cache").
+
+    Raised two ways, both typed so the operator story is never a generic
+    timeout: (1) at submit, when the queue is full AND the pool has zero
+    free blocks — the 503 then names the real bottleneck (HBM, not queue
+    capacity; a :class:`QueueFull` subclass so every existing mapping
+    holds); (2) on a request's own handle when its lane could not GROW
+    mid-decode in an overcommitted pool (``gen.kv_pool_tokens`` below
+    worst case) — the QA layer degrades that extractively like any other
+    decode failure.  Requests merely WAITING for blocks stay queued and
+    keep their deadline semantics: the shed is deadline-aware, with a
+    ``block_pool_exhausted`` trace event marking why they waited."""
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching over a ``GenerateEngine``'s model."""
 
@@ -338,6 +380,8 @@ class ContinuousBatcher:
         cache_len: Optional[int] = None,
         seed: int = 0,
         max_queue: Optional[int] = 256,
+        kv_block_size: Optional[int] = None,
+        kv_pool_tokens: Optional[int] = None,
     ) -> None:
         self.engine = engine
         self.cfg = engine.cfg
@@ -360,12 +404,56 @@ class ContinuousBatcher:
             else 0
         )
 
-        # device state (host-held references; donated through each dispatch)
-        self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
-        if self.mesh is not None and self.mesh.n_devices > 1:
-            from docqa_tpu.parallel.sharding import shard_kv_cache
+        # ---- paged KV geometry (engines/paged.py) ----
+        self.block_size = int(
+            kv_block_size or getattr(self.gen, "kv_block_size", 16)
+        )
+        self.block_size = max(1, min(self.block_size, self.cache_len))
+        # blocks a single maximal request needs; its table never grows
+        # past this, so per-request capacity == the old cache_len budget
+        self.blocks_per_seq = -(-self.cache_len // self.block_size)
+        self.seq_capacity = self.blocks_per_seq * self.block_size
+        pool_tokens = (
+            kv_pool_tokens
+            or getattr(self.gen, "kv_pool_tokens", None)
+            or self.n_slots * self.seq_capacity  # worst-case provisioning
+        )
+        self.n_blocks = max(
+            self.blocks_per_seq, -(-int(pool_tokens) // self.block_size)
+        )
+        # ragged-prefill token budgets: the WHOLE prefill compile surface.
+        # Budgets clamp to the packed capacity one maximal prompt needs
+        # (RAGGED_ALIGN-aligned), dedupe, and always include it — so any
+        # admissible prompt fits a single dispatch and the set stays <= 2.
+        usable = self.cache_len - 2 - self.spec_k
+        full_t = round_up(max(usable, 1), RAGGED_ALIGN)
+        self._token_buckets = sorted(
+            {
+                min(round_up(int(t), RAGGED_ALIGN), full_t)
+                for t in getattr(
+                    self.gen, "prefill_token_buckets", (full_t,)
+                )
+                if int(t) > 0
+            }
+            | {full_t}
+        )
+        # grow-at-decode margin: a pipelined chunk can run one dispatch
+        # past the host's token count, and a spec dispatch emits up to
+        # chunk-1+K — two dispatches' worth of headroom guarantees the
+        # in-program capacity guard is never the thing that stops a live
+        # lane (it exists as defense in depth, like the old cache-bound
+        # guard)
+        self._grow_margin = 2 * (self.chunk + max(self.spec_k, 1)) + 2
 
-            self._cache = shard_kv_cache(self._cache, self.cfg, self.mesh)
+        # device state (host-held references; donated through each dispatch)
+        self._alloc = BlockAllocator(self.n_blocks, self.block_size)
+        self._pools = init_paged_pools(
+            self.cfg, self.n_blocks, self.block_size
+        )
+        if self.mesh is not None and self.mesh.n_devices > 1:
+            from docqa_tpu.parallel.sharding import shard_paged_pools
+
+            self._pools = shard_paged_pools(self._pools, self.cfg, self.mesh)
         self._tok = jnp.zeros((self.n_slots,), jnp.int32)
         self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
         self._active = jnp.zeros((self.n_slots,), bool)
@@ -379,14 +467,30 @@ class ContinuousBatcher:
         # host-side slot bookkeeping
         self._slot_req: List[Optional[_Request]] = [None] * self.n_slots
         self._slot_budget = np.zeros((self.n_slots,), np.int64)
-        # prefill bucket each occupied slot was admitted at — read only
-        # where _slot_req is non-None (freed slots keep stale values),
-        # so kv_slot_occupancy() needs no extra clearing on any of the
-        # retire/fail paths.  The telemetry sampler turns this into the
-        # per-bucket KV-occupancy gauges ROADMAP item 1 needs as its
-        # before/after evidence (today a slot pins worst-case bucket HBM
-        # for its whole lifetime; paged KV must show that shrinking).
-        self._slot_bucket = [0] * self.n_slots
+        # prompt tokens each occupied slot was admitted with: with the
+        # delivered-token count this is the worker's host-side length
+        # estimate, driving grow-at-decode and the block-occupancy
+        # gauges.  Read only where _slot_req is non-None (freed slots
+        # keep stale values), worker-written like _slot_budget.
+        self._slot_prompt = [0] * self.n_slots
+        # per-request block tables + their device mirror.  _block_rows
+        # holds the flat [n_slots, blocks_per_seq] int32 table the decode
+        # program indexes (sentinel n_blocks = hole); it re-uploads only
+        # when dirty (admission / growth / retirement), so steady decode
+        # chunks re-use one device array.  Worker-thread state, like the
+        # slot lists above.
+        self._slot_table: List[Optional[Any]] = [None] * self.n_slots
+        self._block_rows = np.full(
+            (self.n_slots, self.blocks_per_seq), self.n_blocks, np.int32
+        )
+        self._caps_np = np.zeros((self.n_slots,), np.int32)
+        self._tables_dev = None
+        self._caps_dev = None
+        self._tables_dirty = True
+        # id() of the queue head last marked block-starved: one trace
+        # event + one serve_block_pool_wait count per starvation
+        # episode, not per worker poll (guarded by _cv like the queue)
+        self._block_wait_marked: Optional[int] = None
 
         self._queue: collections.deque = collections.deque()
         self._cv = threading.Condition()
@@ -449,54 +553,51 @@ class ContinuousBatcher:
             self._seed * 100_003 + next(self._rng_counter)
         )
 
-    def _prefill_program(self, params, cache, ids, lengths, slots, rng,
-                         table=None):
-        """Prefill a whole admission round in ONE dispatch.
+    def _prefill_program(self, params, pools, ids, seg, pos, dest,
+                         last_rows, slots, rng, table=None):
+        """Ragged prefill: one PACKED dispatch admits a whole round of
+        mixed-length prompts (engines/paged.py).
 
-        ``ids`` [B, bucket] right-padded prompts, ``lengths`` [B] true
-        lengths, ``slots`` [B] destination slots — padding lanes carry
-        ``slots[i] == n_slots`` (out of bounds) so their scatter is dropped.
-        The per-lane prompt K/V lives in a local [B, bucket] cache and only
-        those ``bucket`` rows are scattered into each target slot (decode
-        steps write later rows directly), so the transient is O(B x bucket),
-        not O(B x cache_len).
+        ``ids``/``seg``/``pos``/``dest`` [T] are the packed token stream
+        (lane index, in-sequence position, flat block-pool row; padding
+        carries seg = -1 and an out-of-bounds dest so its scatter drops),
+        ``last_rows`` [n_slots] the packed row of each lane's last prompt
+        token, ``slots`` [n_slots] the destination slot per lane (padding
+        lanes carry ``n_slots`` — out of bounds, dropped).  T is the only
+        compile key: no batch family, no prompt bucket.
 
         With speculation on, ``table`` rows for the admitted slots are
-        REPLACED by each prompt's bigram table (plus the confirmed
-        last-prompt-token -> first-token pair) — the drafting source for
+        REPLACED by each prompt's bigram table (built from the same
+        packed stream: consecutive same-segment pairs) plus the confirmed
+        last-prompt-token -> first-token pair — the drafting source for
         the speculative decode chunks."""
-        B, bucket = ids.shape
-        local = init_kv_cache(self.cfg, B, max_len=bucket)
-        logits, local = decoder_forward(
-            params,
-            self.cfg,
-            ids,
-            local,
-            jnp.zeros((B,), jnp.int32),
-            attn_lengths=lengths,
-            use_flash=self.engine.use_flash,
-            last_token_only=True,
+        S = self.n_slots
+        logits, pools = ragged_prefill_forward(
+            params, self.cfg, pools, ids, seg, pos, dest, last_rows,
+            rope_len=self.seq_capacity,
         )
         toks = sample(
-            logits[:, -1], rng, self.gen.temperature, self.gen.top_k,
+            logits, rng, self.gen.temperature, self.gen.top_k,
             self.gen.top_p,
         )
-        for key in cache:
-            cache[key] = cache[key].at[slots, :bucket].set(
-                local[key].astype(cache[key].dtype), mode="drop"
-            )
         if table is None:
-            return cache, toks
-        rows = self.engine._build_bigram(ids, lengths)
-        last_prompt = jnp.take_along_axis(
-            ids, jnp.maximum(lengths - 1, 0)[:, None], 1
-        )[:, 0]
-        rows = rows.at[jnp.arange(B), last_prompt].set(toks)
-        table = table.at[slots, :].set(rows, mode="drop")
-        return cache, table, toks
+            return pools, toks
+        # per-lane bigram rows from the packed stream: a (prev, next)
+        # pair exists wherever two adjacent packed tokens share a segment
+        prev, nxt = ids[:-1], ids[1:]
+        pair_ok = (seg[:-1] == seg[1:]) & (seg[:-1] >= 0)
+        lane = jnp.where(pair_ok, seg[:-1], S)  # OOB -> dropped
+        prev = jnp.where(pair_ok, prev, self.cfg.vocab_size)
+        rows = jnp.full((S, self.cfg.vocab_size), -1, jnp.int32)
+        rows = rows.at[lane, prev].set(nxt, mode="drop")
+        rows = rows.at[jnp.arange(S), ids[last_rows]].set(toks)
+        table = table.at[slots].set(rows, mode="drop")
+        return pools, table, toks
 
-    def _decode_program(self, params, cache, tok, lengths, active, rng):
-        """Advance every active slot by ``self.chunk`` tokens in one dispatch.
+    def _decode_program(self, params, pools, tables, caps, tok, lengths,
+                        active, rng):
+        """Advance every active slot by ``self.chunk`` tokens in one
+        dispatch, reading and writing K/V through the block tables.
 
         Returns out [S, chunk] (pad on inactive steps), valid [S, chunk]
         (True where the token is a real emission, EOS excluded — so a
@@ -509,13 +610,10 @@ class ContinuousBatcher:
         valid0 = jnp.zeros((S, self.chunk), bool)
 
         def body(t, carry):
-            cache, tok, lengths, active, out, valid, rng = carry
-            logits, cache = decoder_forward(
-                params,
-                self.cfg,
-                tok[:, None],
-                cache,
-                lengths,
+            pools, tok, lengths, active, out, valid, rng = carry
+            logits, pools = paged_decode_forward(
+                params, self.cfg, pools, tables, tok[:, None], lengths,
+                block_size=self.block_size, rope_len=self.seq_capacity,
                 use_flash=self.engine.use_flash,
             )
             rng, sub = jax.random.split(rng)
@@ -529,38 +627,42 @@ class ContinuousBatcher:
             valid = valid.at[:, t].set(active & ~is_eos)
             lengths = lengths + active.astype(jnp.int32)
             active = active & ~is_eos
-            # cache-bound guard: the next step writes row ``lengths``; a
-            # lane at the last row stops here.  Admission budgets already
-            # keep lengths in bounds solo, but a pipelined chunk can run
-            # one chunk past the host-enforced budget (tokens discarded)
-            # — without this guard that overshoot would clamp its K/V
-            # write onto row cache_len-1.
-            active = active & (lengths < self.cache_len)
+            # capacity guard: the next step writes row ``lengths``; a
+            # lane at its last ALLOCATED row stops here.  The worker's
+            # grow-at-decode margin keeps live lanes comfortably under
+            # their caps, but a pipelined chunk can run one dispatch past
+            # the host-enforced budget (tokens discarded) — without this
+            # guard that overshoot's K/V write would be dropped at a
+            # position attention could later read as garbage.
+            active = active & (lengths < caps) & (lengths < self.cache_len)
             tok = jnp.where(active, nxt, tok)
-            return cache, tok, lengths, active, out, valid, rng
+            return pools, tok, lengths, active, out, valid, rng
 
-        cache, tok, lengths, active, out, valid, _ = jax.lax.fori_loop(
+        pools, tok, lengths, active, out, valid, _ = jax.lax.fori_loop(
             0,
             self.chunk,
             body,
-            (cache, tok, lengths, active, out0, valid0, rng),
+            (pools, tok, lengths, active, out0, valid0, rng),
         )
         packed = jnp.concatenate(
             [out, valid.astype(jnp.int32), active.astype(jnp.int32)[:, None]],
             axis=1,
         )  # [S, 2*chunk + 1] — one D2H fetch for the worker
-        return cache, tok, lengths, active, packed
+        return pools, tok, lengths, active, packed
 
-    def _decode_spec_program(self, params, cache, table, tok, lengths, active):
-        """Speculative decode chunk: loop verify-steps until every live slot
-        has emitted >= ``chunk`` tokens (or retired on EOS).  Each step
-        drafts ``spec_k - 1`` tokens per slot from its bigram table and
-        verifies them in ONE forward of q_len=spec_k — the same weight read
-        a single-token step costs — emitting the matched prefix + bonus.
-        Output-exact with the plain chunk program (every emitted token is an
-        argmax of the model's logits).
+    def _decode_spec_program(self, params, pools, tables, caps, table, tok,
+                             lengths, active):
+        """Speculative decode chunk over the block pool: loop verify-steps
+        until every live slot has emitted >= ``chunk`` tokens (or retired
+        on EOS).  Each step drafts ``spec_k - 1`` tokens per slot from its
+        bigram table and verifies them in ONE forward of q_len=spec_k (the
+        same ``draft_tokens``/``accept_drafts`` halves the solo engine's
+        ``spec_verify_step`` uses, composed around the paged forward) —
+        the same weight read a single-token step costs — emitting the
+        matched prefix + bonus.  Output-exact with the plain chunk program
+        (every emitted token is an argmax of the model's logits).
 
-        Returns (cache, table, tok, lengths, active, packed) with packed
+        Returns (pools, table, tok, lengths, active, packed) with packed
         [S, chunk + 2K + 2]: token slab (sized so the K-wide slice write
         can never clamp — see the ``width`` comment), per-slot emission
         count, active flag."""
@@ -582,9 +684,16 @@ class ContinuousBatcher:
             return jnp.any(active & (n_out < self.chunk))
 
         def body(st):
-            cache, table, tok, lengths, active, out, n_out = st
-            cache, g, m, cand, is_eos, eos_pos = self.engine.spec_verify_step(
-                params, cache, table, tok, lengths, K=K
+            pools, table, tok, lengths, active, out, n_out = st
+            drafts = draft_tokens(table, tok, K)
+            verify_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+            logits, pools = paged_decode_forward(
+                params, self.cfg, pools, tables, verify_in, lengths,
+                block_size=self.block_size, rope_len=self.seq_capacity,
+                use_flash=self.engine.use_flash,
+            )
+            g, m, cand, is_eos, eos_pos = accept_drafts(
+                logits, drafts, self.gen.eos_id
             )
             # freeze slots that already filled their chunk quota: the loop
             # keeps running for slower slots, and a frozen slot must not
@@ -609,36 +718,43 @@ class ContinuousBatcher:
             table = self.engine.confirm_bigrams(table, tok, g, emit_valid)
             lengths = lengths + jnp.where(active, n_valid, 0)
             active = active & ~saw_eos
-            # cache-bound guard (see _decode_program): a verify writes the
-            # K-row window [lengths, lengths+K) — stop the lane while that
-            # window still fits, so a pipelined overshoot chunk cannot
-            # clamp K/V writes onto confirmed rows.
-            active = active & (lengths < self.cache_len - K)
+            # capacity guard (see _decode_program): a verify writes the
+            # K-row window [lengths, lengths+K) — stop the lane while
+            # that window still fits its ALLOCATED blocks, so a pipelined
+            # overshoot chunk can only ever drop writes, never land them
+            # where attention could read them back.
+            active = (
+                active
+                & (lengths <= caps - K)
+                & (lengths < self.cache_len - K)
+            )
             tok = jnp.where(active & (n_valid > 0), last_tok, tok)
-            return cache, table, tok, lengths, active, out, n_out
+            return pools, table, tok, lengths, active, out, n_out
 
-        cache, table, tok, lengths, active, out, n_out = jax.lax.while_loop(
-            cond, body, (cache, table, tok, lengths, active, out0, n0)
+        pools, table, tok, lengths, active, out, n_out = jax.lax.while_loop(
+            cond, body, (pools, table, tok, lengths, active, out0, n0)
         )
         packed = jnp.concatenate(
             [out, n_out[:, None], active.astype(jnp.int32)[:, None]], axis=1
         )  # [S, width + 2] — one D2H fetch for the worker
-        return cache, table, tok, lengths, active, packed
+        return pools, table, tok, lengths, active, packed
 
     def _get_prefill_fn(self):
-        """One jit object; XLA re-specializes per (batch, prompt-bucket)
-        shape.  The batch axis pads to one of exactly TWO shapes per
-        bucket — the 4-lane trickle shape for rounds admitting <=4
-        requests and the full ``n_slots`` width otherwise (see
-        ``_admit_round``) — so the compile surface is 2 x len(buckets),
-        and :meth:`warmup` pre-compiles every member of that set before
-        traffic (the compile audit holds the steady state to zero
-        retraces against ``compile_budget.json``)."""
+        """One jit object; XLA re-specializes per packed-token-budget
+        shape T alone.  ``_admit_round`` packs a round's prompts into the
+        smallest budget in ``self._token_buckets`` that fits (splitting
+        past the largest), so the WHOLE prefill compile surface is
+        ``len(self._token_buckets)`` programs (<= 2) — the old policy of
+        two batch families x every prompt bucket is gone, and
+        :meth:`warmup` pre-compiles the full set before traffic (the
+        compile audit holds the steady state to zero retraces against
+        ``compile_budget.json``)."""
         if self._prefill_fn is None:
             if self.spec_k:
                 self._prefill_fn = jax.jit(
-                    lambda p, c, t, i, l, s, r: self._prefill_program(
-                        p, c, i, l, s, r, table=t
+                    lambda p, c, t, i, sg, po, d, lr, sl, r:
+                    self._prefill_program(
+                        p, c, i, sg, po, d, lr, sl, r, table=t
                     ),
                     donate_argnums=(1, 2),
                 )
@@ -651,8 +767,10 @@ class ContinuousBatcher:
     def _get_decode_fn(self):
         if self._decode_fn is None:
             if self.spec_k:
+                # donate the pool + spec table; block tables and caps are
+                # small host-refreshed arrays reused across chunks
                 self._decode_fn = jax.jit(
-                    self._decode_spec_program, donate_argnums=(1, 2)
+                    self._decode_spec_program, donate_argnums=(1, 4)
                 )
             else:
                 self._decode_fn = jax.jit(
@@ -661,16 +779,16 @@ class ContinuousBatcher:
         return self._decode_fn
 
     def _fresh_device_state(self):
-        """A throwaway (cache, table, tok, lengths, active) tuple with the
+        """A throwaway (pools, table, tok, lengths, active) tuple with the
         exact shapes/dtypes/shardings of the live slot state — warmup
         dispatches donate THESE instead of the live buffers, so a warmup
         can run concurrently with serving without ever racing the worker
-        for ``self._cache``."""
-        cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
+        for ``self._pools``."""
+        pools = init_paged_pools(self.cfg, self.n_blocks, self.block_size)
         if self.mesh is not None and self.mesh.n_devices > 1:
-            from docqa_tpu.parallel.sharding import shard_kv_cache
+            from docqa_tpu.parallel.sharding import shard_paged_pools
 
-            cache = shard_kv_cache(cache, self.cfg, self.mesh)
+            pools = shard_paged_pools(pools, self.cfg, self.mesh)
         table = (
             jnp.full((self.n_slots, self.cfg.vocab_size), -1, jnp.int32)
             if self.spec_k
@@ -679,72 +797,82 @@ class ContinuousBatcher:
         tok = jnp.zeros((self.n_slots,), jnp.int32)
         lengths = jnp.zeros((self.n_slots,), jnp.int32)
         active = jnp.zeros((self.n_slots,), bool)
-        return cache, table, tok, lengths, active
+        return pools, table, tok, lengths, active
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
         """Compile the whole admission-path shape set ahead of traffic.
 
-        ``_admit_round`` dispatches one of exactly TWO batch shapes per
-        prompt bucket: the 4-lane trickle shape (rounds admitting <=4)
-        and the full ``n_slots`` width.  Warming only one of them — the
-        old behavior everywhere (the app's single dummy submit warmed
-        trickle only; the bench's ``n_slots`` burst warmed full only) —
-        left the other family to trace+compile INSIDE the first latency
-        measurement or live request that hit it (the r05 open-loop runs
-        paid the trickle compile mid-measurement; BENCH_r05).
+        The set is small by construction now: one ragged prefill program
+        per packed token budget (``self._token_buckets``, <= 2) plus the
+        one decode chunk — versus the pre-paged (2 shape families x
+        prompt buckets) matrix this replaces.  Warming still matters: a
+        shape left cold compiles inside the first live request that hits
+        it (the r05 open-loop runs paid exactly that).
 
         Every warm dispatch donates a throwaway state tuple
-        (``_fresh_device_state``) and scatters all lanes out of bounds,
-        so live slots are untouched and the method is safe to run from a
-        background thread while traffic arrives.  ``buckets`` defaults to
-        every configured prefill bucket that fits the cache budget.
+        (``_fresh_device_state``) and scatters all tokens/lanes out of
+        bounds, so live slots are untouched and the method is safe to run
+        from a background thread while traffic arrives.  ``buckets``
+        narrows the warmed token budgets (legacy arg: values are mapped
+        onto the budgets they pack into); default warms every budget.
         """
-        usable = self.cache_len - 2 - self.spec_k
         if buckets is None:
-            buckets = self.gen.prefill_buckets
-        # CLAMP oversized buckets to ``usable`` (never drop them):
-        # _admit_round dispatches min(bucket, usable), so the clamped
-        # shape is a real admitted shape that must be warmed too — a
-        # dropped bucket would leave a live compile for any prompt
-        # whose bucket exceeds the cache budget
-        buckets = sorted({min(int(b), usable) for b in buckets})
-        widths = sorted({4, self.n_slots}) if self.n_slots > 4 else [
-            self.n_slots
-        ]
+            warm = list(self._token_buckets)
+        else:
+            # map requested prompt sizes onto the token budgets their
+            # admission rounds would actually dispatch
+            warm = sorted({self._pick_token_bucket(int(b)) for b in buckets})
         fn = self._get_prefill_fn()
-        for bucket in buckets:
-            for B in widths:
-                cache, table, _tok, _lengths, _active = (
-                    self._fresh_device_state()
+        S = self.n_slots
+        oob_row = self.n_blocks * self.block_size
+        for T in warm:
+            pools, table, _tok, _lengths, _active = (
+                self._fresh_device_state()
+            )
+            ids = jnp.full((T,), self.gen.pad_id, jnp.int32)
+            seg = jnp.full((T,), -1, jnp.int32)  # every token is padding
+            pos = jnp.zeros((T,), jnp.int32)
+            dest = jnp.full((T,), oob_row, jnp.int32)  # dropped writes
+            last_rows = jnp.zeros((S,), jnp.int32)
+            slots = jnp.full((S,), S, jnp.int32)  # OOB == dropped
+            if self.spec_k:
+                fn(
+                    self.engine.params, pools, table, ids, seg, pos,
+                    dest, last_rows, slots, self._next_rng(),
                 )
-                ids = jnp.full((B, bucket), self.gen.pad_id, jnp.int32)
-                lengths = jnp.ones((B,), jnp.int32)
-                # every lane scatters out of bounds -> dropped write
-                slots = jnp.full((B,), self.n_slots, jnp.int32)
-                if self.spec_k:
-                    fn(
-                        self.engine.params, cache, table, ids, lengths,
-                        slots, self._next_rng(),
-                    )
-                else:
-                    fn(
-                        self.engine.params, cache, ids, lengths, slots,
-                        self._next_rng(),
-                    )
-        # decode chunk: one shape regardless of bucket — all-inactive
-        # lanes still trace/compile the full program
+            else:
+                fn(
+                    self.engine.params, pools, ids, seg, pos, dest,
+                    last_rows, slots, self._next_rng(),
+                )
+        # decode chunk: one shape regardless of prompt mix — all-inactive
+        # lanes still trace/compile the full program (all-sentinel tables)
         dfn = self._get_decode_fn()
-        cache, table, tok, lengths, active = self._fresh_device_state()
+        pools, table, tok, lengths, active = self._fresh_device_state()
+        tables = jnp.full(
+            (S, self.blocks_per_seq), self.n_blocks, jnp.int32
+        )
+        caps = jnp.zeros((S,), jnp.int32)
         if self.spec_k:
-            dfn(self.engine.params, cache, table, tok, lengths, active)
+            dfn(self.engine.params, pools, tables, caps, table, tok,
+                lengths, active)
         else:
             dfn(
-                self.engine.params, cache, tok, lengths, active,
-                self._next_rng(),
+                self.engine.params, pools, tables, caps, tok, lengths,
+                active, self._next_rng(),
             )
         # warmed shapes cover the admission path: worker iterations are
         # now bounded by real chunk rounds, so liveness checks may engage
         self._cold = False
+
+    def _pick_token_bucket(self, n_tokens: int) -> int:
+        """Smallest packed token budget covering ``n_tokens`` (the
+        largest budget for anything bigger — callers split into multiple
+        dispatches of that same shape)."""
+        for t in self._token_buckets:
+            if n_tokens <= t:
+                return t
+        return self._token_buckets[-1]
 
     # ---- public API ----------------------------------------------------------
 
@@ -779,13 +907,29 @@ class ContinuousBatcher:
                 and len(self._queue) >= self.max_queue
             ):
                 DEFAULT_REGISTRY.counter("serve_shed").inc()
+                n_active = sum(1 for r in self._slot_req if r is not None)
+                if self._alloc.n_free == 0:
+                    # the queue backed up BECAUSE the block pool is dry:
+                    # name the real bottleneck (HBM overcommit, not queue
+                    # sizing) — same 503, different operator story
+                    DEFAULT_REGISTRY.counter("serve_block_shed").inc()
+                    _req_mark(
+                        req, "block_pool_exhausted",
+                        n_queued=len(self._queue),
+                    )
+                    raise BlockPoolExhausted(
+                        "KV block pool exhausted and generation queue at "
+                        f"capacity ({self.max_queue})",
+                        n_queued=len(self._queue),
+                        n_active=n_active,
+                    )
                 _req_mark(
                     req, "queue_full", n_queued=len(self._queue)
                 )
                 raise QueueFull(
                     f"generation queue at capacity ({self.max_queue})",
                     n_queued=len(self._queue),
-                    n_active=sum(1 for r in self._slot_req if r is not None),
+                    n_active=n_active,
                 )
             self._queue.append(req)
             n_queued = len(self._queue)
@@ -884,6 +1028,12 @@ class ContinuousBatcher:
             if not req.done.is_set():
                 req.error = RuntimeError("batcher stopped")
                 _finish(req)
+        # block accounting closes with the batcher: every slot's table
+        # returns to the pool exactly once (release is idempotent and
+        # allocator-locked, so a wedged worker racing its own retire
+        # cannot double-free)
+        for slot in range(self.n_slots):
+            self._release_slot_blocks(slot)
 
     # ---- liveness / graceful-drain contract (engines/pool.py) ---------------
 
@@ -987,8 +1137,14 @@ class ContinuousBatcher:
             # and deliver tokens into these very objects — re-admitting
             # them elsewhere could interleave two replicas' tokens.
             # (_finish is idempotent, so a zombie completing a
-            # failed-typed request is harmless.)
-            queued = self._admitting_reqs + list(self._queue)
+            # failed-typed request is harmless.)  Dedup by identity —
+            # see _worker_died.
+            queued = list(
+                {
+                    id(r): r
+                    for r in self._admitting_reqs + list(self._queue)
+                }.values()
+            )
             self._admitting_reqs = []
             self._admitting = 0
             self._queue.clear()
@@ -999,6 +1155,12 @@ class ContinuousBatcher:
                 _req_mark(req, "replica_killed", queued=True)
                 _finish(req)
         self.fail_active(error)
+        # close the block accounting (idempotent; a later zombie retire
+        # is a no-op).  The pool itself dies with this batcher — the
+        # rebuild allocates a fresh one — so freed ids are never handed
+        # to a new admission a zombie write could corrupt.
+        for slot in range(self.n_slots):
+            self._release_slot_blocks(slot)
 
     @property
     def n_active(self) -> int:
@@ -1024,48 +1186,66 @@ class ContinuousBatcher:
         worker wedged here shows 0 queued AND 0 active."""
         return self._admitting
 
-    def kv_slot_occupancy(self) -> Dict[int, int]:
-        """Active KV slots per admission prefill bucket (telemetry
-        gauge ``serve_kv_slots_bucket_<N>``).  Unlocked snapshot of the
-        same host-side lists ``n_active`` reads — per-slot writes are
-        atomic reference stores, and a slot mid-transition miscounting
-        by one for one sample is fine for a 2 Hz occupancy series."""
-        out: Dict[int, int] = {}
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one token of KV occupies (all layers) — the paged
+        accounting unit: HBM cost is tokens x this, block-granular,
+        never per-bucket."""
+        return kv_bytes_per_token(self.cfg)
+
+    def kv_block_occupancy(self) -> Dict[str, float]:
+        """Block-pool occupancy snapshot (telemetry gauges
+        ``serve_kv_blocks_*`` / ``serve_kv_bytes_per_token`` — the
+        replacement for the pre-paged per-bucket slot gauges).  Unlocked
+        reads of the allocator counters and the same host-side lists
+        ``n_active`` reads — a sample mid-transition miscounting one
+        block is fine for a 2 Hz occupancy series."""
+        bpt = self.kv_bytes_per_token
+        used = self._alloc.blocks_in_use
+        tokens = 0
         for slot in range(self.n_slots):
-            if self._slot_req[slot] is not None:
-                b = self._slot_bucket[slot]
-                out[b] = out.get(b, 0) + 1
-        return out
+            req = self._slot_req[slot]
+            if req is not None:
+                tokens += self._slot_prompt[slot] + len(req.tokens)
+        return {
+            "blocks_total": self.n_blocks,
+            "blocks_used": used,
+            "block_size": self.block_size,
+            "bytes_per_token": bpt,
+            "pool_bytes": self.n_blocks * self.block_size * bpt,
+            "used_bytes": used * self.block_size * bpt,
+            "tokens_committed": tokens,
+            "utilization": used / self.n_blocks,
+        }
 
     # ---- worker loop ---------------------------------------------------------
 
     def _admit_round(self, pairs: List[Tuple[int, "_Request"]]):
-        """Prefill every (slot, request) pair of this round in ONE batched
-        dispatch (async — no device sync; the round is finalized with one
-        host fetch in ``_finalize_admissions``).
+        """Prefill every (slot, request) pair of this round through the
+        ragged packed program (async — no device sync; the round is
+        finalized with one host fetch per dispatch group in
+        ``_finalize_admissions``).
 
-        The batch axis pads to ONE of two shapes: a narrow trickle shape
-        (4) when the round admits <=4 requests, else the full ``n_slots``.
-        Always-``n_slots`` (the round-4 policy) made every open-loop
-        admission round pay the full-width prefill compute — at a
-        512-token bucket that is ~B×bucket tokens of forward FLOPs per
-        round regardless of how few requests arrived, and the r05
-        open-loop run (arrivals every 62 ms, 1-2 admits per round)
-        measured it as the throughput wall (docs/PERF.md §5).  Two
-        shapes per prompt bucket keeps the compile surface bounded —
-        the original rationale for a single shape — and the trickle
-        shape cuts the per-arrival prefill cost by n_slots/4.  Padding
-        lanes scatter out of bounds (dropped) and their sampled tokens
-        are ignored.  A request whose prompt cannot be marshalled fails
-        alone, before the dispatch — not with the whole round."""
+        Prompts pack into a flat token stream (starts RAGGED_ALIGN-
+        aligned) and the stream pads to the smallest configured token
+        budget that fits — mixed lengths share one dispatch with no
+        shape family and no per-bucket padding; a round whose prompts
+        exceed the largest budget splits into several dispatches of that
+        same shape (no retrace).  Each request's KV blocks are allocated
+        here (prompt + grow margin); a request the pool cannot currently
+        hold goes BACK to the queue head (traced, deadline still
+        enforced there) instead of failing — ``_pop_free_slots``
+        pre-checks capacity, so that path is a rare race, not the norm.
+        A request whose prompt cannot be marshalled fails alone, before
+        the dispatch — not with the whole round."""
         # Truncation limit mirrors the budget formula in
         # _finalize_admissions (cache_len - n_ids - 1 - spec_k) with one
         # extra row reserved, so a maximally-long prompt still gets
         # budget >= 1 — otherwise prompts in the band truncate "in bounds"
         # but retire with zero output (a 200 with an empty answer).
         usable = self.cache_len - 2 - self.spec_k
-        good: List[Tuple[int, "_Request", List[int]]] = []
-        longest = 1
+        good: List[Tuple[int, "_Request", List[int], Any]] = []
+        send_back: List["_Request"] = []
         for slot, req in pairs:
             if req.deadline is not None and req.deadline.expired:
                 # the budget lapsed between queue pop and this round
@@ -1086,55 +1266,132 @@ class ContinuousBatcher:
                 req.error = e
                 _finish(req)
                 continue
-            good.append((slot, req, ids))
-            longest = max(longest, len(ids))
+            table = self._alloc.new_table()
+            try:
+                table.ensure(
+                    min(len(ids) + self._grow_margin, self.seq_capacity)
+                )
+            except OutOfBlocks:
+                # the pool drained between the _pop_free_slots capacity
+                # check and here (same thread, so only by THIS round's
+                # earlier allocations) — requeue at the head, keep order
+                DEFAULT_REGISTRY.counter("serve_block_pool_wait").inc()
+                _req_mark(
+                    req, "block_pool_exhausted", queued=True,
+                    free_blocks=self._alloc.n_free,
+                )
+                send_back.append(req)
+                continue
+            good.append((slot, req, ids, table))
+        if send_back:
+            sent = {id(r) for r in send_back}
+            with self._cv:
+                for req in reversed(send_back):
+                    self._queue.appendleft(req)
+                # queue-resident again: drop them from the admission
+                # window NOW, not at the round's end — a worker death in
+                # between must see each request in exactly ONE of
+                # (_admitting_reqs, _queue), or the rescue hook would
+                # offer it twice and two replicas could decode it
+                self._admitting_reqs = [
+                    r for r in self._admitting_reqs if id(r) not in sent
+                ]
+                self._admitting = len(self._admitting_reqs)
+                self._cv.notify_all()
         if not good:
             return [], None
-        bucket = min(
-            pick_bucket(longest, self.gen.prefill_buckets)
-            if longest <= self.gen.prefill_buckets[-1]
-            else round_up(longest, 128),
-            usable,
-        )
-        B = 4 if len(good) <= 4 and self.n_slots > 4 else self.n_slots
-        padded = np.full((B, bucket), self.gen.pad_id, np.int32)
-        lengths = np.ones((B,), np.int32)
-        slots_arr = np.full((B,), self.n_slots, np.int32)  # OOB == dropped
-        for i, (slot, _req, ids) in enumerate(good):
-            ids = ids[-bucket:]
-            padded[i, : len(ids)] = ids
-            lengths[i] = len(ids)
-            slots_arr[i] = slot
-            good[i] = (slot, _req, ids)
+
+        # Register slot state BEFORE the dispatch: if the dispatch dies,
+        # _fail_active sweeps these slots and releases their fresh block
+        # tables along with everything else (exactly-once accounting).
+        for slot, req, ids, table in good:
+            n_ids = len(ids)
+            budget = min(req.max_new, self.cache_len - n_ids - 1 - self.spec_k)
+            self._slot_req[slot] = req
+            self._slot_budget[slot] = budget
+            self._slot_prompt[slot] = n_ids
+            self._slot_table[slot] = table
+            row = self._block_rows[slot]
+            row[:] = self.n_blocks
+            row[: len(table.blocks)] = table.blocks
+            self._caps_np[slot] = table.capacity
+        self._tables_dirty = True
+
+        # pack into dispatch groups: each prompt starts on a
+        # RAGGED_ALIGN boundary (the exactness contract in
+        # ops/attention.py) and a group never exceeds the largest budget
+        groups: List[List[Tuple[int, "_Request", List[int], Any]]] = []
+        cur: List[Tuple[int, "_Request", List[int], Any]] = []
+        cur_tokens = 0
+        max_t = self._token_buckets[-1]
+        for entry in good:
+            n_aligned = round_up(len(entry[2]), RAGGED_ALIGN)
+            if cur and cur_tokens + n_aligned > max_t:
+                groups.append(cur)
+                cur, cur_tokens = [], 0
+            cur.append(entry)
+            cur_tokens += n_aligned
+        if cur:
+            groups.append(cur)
+
         fn = self._get_prefill_fn()
+        S = self.n_slots
+        oob_row = self.n_blocks * self.block_size
+        toks_parts = []
         t_prefill0 = _now()
         with span("serve_prefill", DEFAULT_REGISTRY):
-            if self.spec_k:
-                self._cache, self._table, toks = fn(
-                    self.engine.params,
-                    self._cache,
-                    self._table,
-                    jnp.asarray(padded),
-                    jnp.asarray(lengths),
+            for group in groups:
+                total = sum(
+                    round_up(len(ids), RAGGED_ALIGN) for _, _, ids, _ in group
+                )
+                T = self._pick_token_bucket(total)
+                ids_flat = np.full((T,), self.gen.pad_id, np.int32)
+                seg = np.full((T,), -1, np.int32)
+                pos = np.zeros((T,), np.int32)
+                dest = np.full((T,), oob_row, np.int32)
+                last_rows = np.zeros((S,), np.int32)
+                slots_arr = np.full((S,), S, np.int32)  # OOB == dropped
+                off = 0
+                for lane, (slot, _req, ids, table) in enumerate(group):
+                    n = len(ids)
+                    ids_flat[off: off + n] = ids
+                    seg[off: off + n] = lane
+                    p = np.arange(n, dtype=np.int32)
+                    pos[off: off + n] = p
+                    blocks = np.asarray(table.blocks, np.int64)
+                    dest[off: off + n] = (
+                        blocks[p // self.block_size] * self.block_size
+                        + p % self.block_size
+                    )
+                    last_rows[lane] = off + n - 1
+                    slots_arr[lane] = slot
+                    off += round_up(n, RAGGED_ALIGN)
+                args = (
+                    jnp.asarray(ids_flat),
+                    jnp.asarray(seg),
+                    jnp.asarray(pos),
+                    jnp.asarray(dest),
+                    jnp.asarray(last_rows),
                     jnp.asarray(slots_arr),
                     self._next_rng(),
                 )
-            else:
-                self._cache, toks = fn(
-                    self.engine.params,
-                    self._cache,
-                    jnp.asarray(padded),
-                    jnp.asarray(lengths),
-                    jnp.asarray(slots_arr),
-                    self._next_rng(),
-                )
+                if self.spec_k:
+                    self._pools, self._table, toks = fn(
+                        self.engine.params, self._pools, self._table, *args
+                    )
+                else:
+                    self._pools, toks = fn(
+                        self.engine.params, self._pools, *args
+                    )
+                toks_parts.append(toks[: len(group)])
         t_prefill1 = _now()
-        for slot, req, ids in good:
-            _req_span(
-                req, "serve_prefill", t_prefill0, t_prefill1,
-                batch=len(good), bucket=bucket, slot=slot,
-                prompt_tokens=len(ids),
-            )
+        for gi, group in enumerate(groups):
+            for slot, req, ids, table in group:
+                _req_span(
+                    req, "serve_prefill", t_prefill0, t_prefill1,
+                    batch=len(good), dispatch=gi, slot=slot,
+                    prompt_tokens=len(ids), blocks=len(table.blocks),
+                )
         # Slot state updates ride the device (the sampled first tokens are
         # already there) — alive = (first != eos) & (budget >= 2) needs no
         # host fetch, so the decode chunk that follows this admission can
@@ -1144,23 +1401,22 @@ class ContinuousBatcher:
         slots_np = np.empty((G,), np.int32)
         lens_np = np.empty((G,), np.int32)
         budget_ok = np.empty((G,), bool)
-        for i, (slot, req, ids) in enumerate(good):
-            n_ids = len(ids)
-            budget = min(req.max_new, self.cache_len - n_ids - 1 - self.spec_k)
-            self._slot_req[slot] = req
-            self._slot_budget[slot] = budget
-            self._slot_bucket[slot] = bucket
+        for i, (slot, req, ids, _table) in enumerate(good):
             slots_np[i] = slot
-            lens_np[i] = n_ids
-            budget_ok[i] = budget >= 2
+            lens_np[i] = len(ids)
+            budget_ok[i] = self._slot_budget[slot] >= 2
         idx = jnp.asarray(slots_np)
-        first_toks = toks[:G]
+        first_toks = (
+            toks_parts[0]
+            if len(toks_parts) == 1
+            else jnp.concatenate(toks_parts)
+        )
         alive_dev = (first_toks != self.gen.eos_id) & jnp.asarray(budget_ok)
         self._tok = self._tok.at[idx].set(first_toks)
         self._lengths = self._lengths.at[idx].set(jnp.asarray(lens_np))
         self._active = self._active.at[idx].set(alive_dev)
-        meta = [(slot, req, len(ids)) for slot, req, ids in good]
-        return meta, toks
+        meta = [(slot, req, len(ids)) for slot, req, ids, _t in good]
+        return meta, first_toks
 
     def _finalize_admissions(self, admitted) -> bool:
         """Host-side bookkeeping for an admission round: ONE device fetch
@@ -1198,8 +1454,21 @@ class ContinuousBatcher:
                     self._retire(slot)
         return True
 
+    def _release_slot_blocks(self, slot: int) -> None:
+        """Return a slot's KV blocks to the pool (idempotent via the
+        allocator) and sentinel its device-table row so in-flight
+        programs drop any further write through it."""
+        table = self._slot_table[slot]
+        self._slot_table[slot] = None
+        self._block_rows[slot, :] = self.n_blocks
+        self._caps_np[slot] = 0
+        self._tables_dirty = True
+        if table is not None:
+            table.release()
+
     def _fail_active(self, err: BaseException) -> None:
-        """Fail all in-flight requests and rebuild clean device state."""
+        """Fail all in-flight requests, free their blocks, and rebuild
+        clean device state."""
         for slot in range(self.n_slots):
             req = self._slot_req[slot]
             if req is not None:
@@ -1207,17 +1476,20 @@ class ContinuousBatcher:
                 _req_mark(req, "decode_failed", slot=slot)
                 _finish(req)
                 self._slot_req[slot] = None
+            self._release_slot_blocks(slot)
         if self._stopped:
             # a killed batcher never serves again — re-allocating a fresh
-            # KV cache here would waste HBM right as the pool's rebuild
+            # block pool here would waste HBM right as the pool's rebuild
             # allocates the replacement replica's (and would undo the
             # pool's device-state scrub of this shell)
             return
-        self._cache = init_kv_cache(self.cfg, self.n_slots, max_len=self.cache_len)
+        self._pools = init_paged_pools(
+            self.cfg, self.n_blocks, self.block_size
+        )
         if self.mesh is not None and self.mesh.n_devices > 1:
-            from docqa_tpu.parallel.sharding import shard_kv_cache
+            from docqa_tpu.parallel.sharding import shard_paged_pools
 
-            self._cache = shard_kv_cache(self._cache, self.cfg, self.mesh)
+            self._pools = shard_paged_pools(self._pools, self.cfg, self.mesh)
         self._tok = jnp.zeros((self.n_slots,), jnp.int32)
         self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
         self._active = jnp.zeros((self.n_slots,), bool)
@@ -1230,9 +1502,19 @@ class ContinuousBatcher:
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
+        # eviction returns blocks IMMEDIATELY: the freed HBM admits the
+        # next queued request this same worker iteration — the whole
+        # point of paging over per-slot worst-case reservation
+        self._release_slot_blocks(slot)
         if req is not None:
             _finish(req)
-            DEFAULT_REGISTRY.counter("serve_completed").inc()
+            # serve_completed counts SUCCESSES: a lane retired carrying
+            # a typed error (deadline shed, cancellation, block-pool
+            # exhaustion) already incremented its own shed counter, and
+            # counting it here too would inflate the success rate
+            # exactly when the shed metrics say the pool is thrashing
+            if req.error is None:
+                DEFAULT_REGISTRY.counter("serve_completed").inc()
 
     def _process_chunk(
         self, packed_dev, snap: List[Optional[_Request]]
@@ -1357,6 +1639,15 @@ class ContinuousBatcher:
             self._active = self._active.at[idx].set(False)
         return True
 
+    def _blocks_for_admission(self, req: "_Request") -> int:
+        """Blocks an admission would allocate for ``req`` (prompt after
+        truncation, plus the grow margin, capped at one sequence)."""
+        usable = self.cache_len - 2 - self.spec_k
+        n_ids = max(1, min(len(req.prompt_ids), usable))
+        return self._alloc.blocks_for(
+            min(n_ids + self._grow_margin, self.seq_capacity)
+        )
+
     def _pop_free_slots(
         self, pairs: List[Tuple[int, "_Request"]]
     ) -> None:
@@ -1365,15 +1656,56 @@ class ContinuousBatcher:
 
         Requests whose deadline lapsed *while queued* are failed here —
         never admitted: prefilling them would spend a batched forward on
-        answers nobody is waiting for (the BENCH_r05 pile-up)."""
+        answers nobody is waiting for (the BENCH_r05 pile-up).  A request
+        the block pool cannot hold right now STOPS the fill (FIFO is
+        preserved — no head-of-line skipping to smaller prompts): it
+        stays queued, traced, and deadline-governed until retirements
+        free blocks, which the very next worker iteration re-checks."""
         taken = {s for s, _ in pairs}
         drained = False
+        # blocks this call has already earmarked (the allocator only
+        # commits in _admit_round, so the capacity check must account
+        # for earlier picks in the same round)
+        planned = sum(self._blocks_for_admission(r) for _, r in pairs)
+        blocked = False
         for slot in range(self.n_slots):
-            if self._slot_req[slot] is not None or slot in taken:
+            if blocked or self._slot_req[slot] is not None or slot in taken:
                 continue
             filled = False
             while self._queue and not filled:
+                head = self._queue[0]
+                need = self._blocks_for_admission(head)
+                if (
+                    head.deadline is None or not head.deadline.expired
+                ) and not head.cancelled and not self._alloc.can_alloc(
+                    planned + need
+                ):
+                    # pool exhausted for now: leave it queued (typed
+                    # trace event; the deadline check below still sheds
+                    # it if the budget lapses while it waits).  Mark and
+                    # count ONCE per starvation episode — the worker
+                    # re-polls this head every iteration (and every
+                    # 50 ms while idle), and per-poll marking would
+                    # bloat the request's trace and turn the counter
+                    # into a poll-rate meter instead of a wait meter.
+                    if self._block_wait_marked != id(head):
+                        self._block_wait_marked = id(head)
+                        _req_mark(
+                            head, "block_pool_exhausted", queued=True,
+                            anomalous=False,
+                            free_blocks=self._alloc.n_free,
+                        )
+                        DEFAULT_REGISTRY.counter(
+                            "serve_block_pool_wait"
+                        ).inc()
+                    blocked = True
+                    break
                 req = self._queue.popleft()
+                if self._block_wait_marked == id(req):
+                    # the starved head is leaving the queue: clear the
+                    # episode marker so a FUTURE request reusing this
+                    # object's address still gets its own mark/count
+                    self._block_wait_marked = None
                 drained = True
                 # queue-wait is over either way (admitted or shed) —
                 # the stage BENCH_r05 could not see
@@ -1402,6 +1734,7 @@ class ContinuousBatcher:
                     _finish(req)
                     continue
                 pairs.append((slot, req))
+                planned += need
                 filled = True
             if not self._queue and not filled:
                 break
@@ -1439,8 +1772,16 @@ class ContinuousBatcher:
             # admission-window requests (popped but never slot-resident)
             # count as queued for rescue purposes: the dead worker can
             # never touch them again, and like the queue they carry no
-            # tokens or device state — safe to re-admit elsewhere
-            queued = self._admitting_reqs + list(self._queue)
+            # tokens or device state — safe to re-admit elsewhere.
+            # Dedup by identity: a block-starved requeue transiently has
+            # a request in both lists, and offering it twice would let
+            # two replicas decode into one token stream.
+            queued = list(
+                {
+                    id(r): r
+                    for r in self._admitting_reqs + list(self._queue)
+                }.values()
+            )
             self._admitting_reqs = []
             self._admitting = 0
             self._queue.clear()
@@ -1460,6 +1801,7 @@ class ContinuousBatcher:
         for slot in range(self.n_slots):
             req = self._slot_req[slot]
             self._slot_req[slot] = None
+            self._release_slot_blocks(slot)
             if req is not None and not req.done.is_set():
                 req.error = err
                 _req_mark(req, "worker_died", slot=slot)
@@ -1494,6 +1836,18 @@ class ContinuousBatcher:
                 # admission: fill every free slot from the queue; the whole
                 # round prefills in one batched dispatch below
                 self._pop_free_slots(pairs)
+                if (
+                    not pairs
+                    and self._queue
+                    and not any(self._slot_req)
+                ):
+                    # queue head is block-starved with every slot idle
+                    # (pool held outside the slot set — a test harness
+                    # or a teardown window): bounded wait instead of a
+                    # hot spin; retirements notify this cv
+                    self._beat = time_monotonic()
+                    self._cv.wait(0.05)
+                    self._pop_free_slots(pairs)
             if pairs and pending is not None:
                 # drain the pipeline before admitting: the invariant above,
                 # plus processing may retire slots this round can refill
@@ -1512,10 +1866,17 @@ class ContinuousBatcher:
                     if not admitted[0]:
                         admitted = None
                 except Exception as e:
-                    # the round's dispatch died; the cache was donated
-                    # through it — fail in-flight and reset
+                    # the round's dispatch died; the pool was donated
+                    # through it — fail in-flight and reset.  Requests
+                    # _admit_round already sent BACK to the queue
+                    # (block-starved) were never in the dispatch: they
+                    # stay queued for the next round, not failed here.
                     log.exception("admission round failed; resetting")
+                    with self._cv:
+                        requeued = {id(r) for r in self._queue}
                     for _slot, req in pairs:
+                        if id(req) in requeued:
+                            continue
                         if not req.done.is_set():
                             req.error = RuntimeError(f"prefill failed: {e!r}")
                             _finish(req)
@@ -1531,15 +1892,60 @@ class ContinuousBatcher:
                         self._cv.notify_all()
             if not any(self._slot_req):
                 continue
+            # grow-at-decode: top up every live lane's block table to the
+            # margin BEFORE dispatching (the in-program capacity guard
+            # must never be what stops a live lane).  A lane the pool
+            # cannot grow sheds TYPED here — in an overcommitted pool
+            # (gen.kv_pool_tokens < worst case) that is the designed
+            # failure mode, and it frees the lane's blocks for the rest.
+            shed_slots = []
+            for slot in range(self.n_slots):
+                req = self._slot_req[slot]
+                table = self._slot_table[slot]
+                if req is None or table is None:
+                    continue
+                est = self._slot_prompt[slot] + len(req.tokens)
+                target = min(est + self._grow_margin, self.seq_capacity)
+                if table.capacity >= target:
+                    continue
+                try:
+                    table.ensure(target)
+                    row = self._block_rows[slot]
+                    row[: len(table.blocks)] = table.blocks
+                    self._caps_np[slot] = table.capacity
+                    self._tables_dirty = True
+                except OutOfBlocks:
+                    with self._cv:
+                        n_queued = len(self._queue)
+                    req.error = BlockPoolExhausted(
+                        "KV block pool exhausted mid-decode "
+                        f"(lane at {est} tokens, pool "
+                        f"{self.n_blocks}x{self.block_size})",
+                        n_queued=n_queued,
+                        n_active=self.n_active,
+                    )
+                    DEFAULT_REGISTRY.counter("serve_block_shed").inc()
+                    _req_mark(req, "block_pool_exhausted", slot=slot)
+                    self._retire(slot)
+                    shed_slots.append(slot)
+            if shed_slots:
+                idx = jnp.asarray(shed_slots, jnp.int32)
+                self._active = self._active.at[idx].set(False)
+                if not any(self._slot_req):
+                    continue
             # one decode chunk for every live slot, dispatched BEFORE the
             # previous chunk's results are fetched — fetch + host work
             # below overlap this chunk's device execution
             fn = self._get_decode_fn()
+            if self._tables_dirty:
+                self._tables_dev = jnp.asarray(self._block_rows)
+                self._caps_dev = jnp.asarray(self._caps_np)
+                self._tables_dirty = False
             try:
                 with span("serve_decode_dispatch", DEFAULT_REGISTRY):
                     if self.spec_k:
                         (
-                            self._cache,
+                            self._pools,
                             self._table,
                             self._tok,
                             self._lengths,
@@ -1547,7 +1953,9 @@ class ContinuousBatcher:
                             packed,
                         ) = fn(
                             self.engine.params,
-                            self._cache,
+                            self._pools,
+                            self._tables_dev,
+                            self._caps_dev,
                             self._table,
                             self._tok,
                             self._lengths,
@@ -1555,14 +1963,16 @@ class ContinuousBatcher:
                         )
                     else:
                         (
-                            self._cache,
+                            self._pools,
                             self._tok,
                             self._lengths,
                             self._active,
                             packed,
                         ) = fn(
                             self.engine.params,
-                            self._cache,
+                            self._pools,
+                            self._tables_dev,
+                            self._caps_dev,
                             self._tok,
                             self._lengths,
                             self._active,

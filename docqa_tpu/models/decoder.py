@@ -157,6 +157,75 @@ def _qmatmul(x: jax.Array, params: Params, name: str, dtype) -> jax.Array:
     )
 
 
+def decoder_layer_stack(
+    params: Params,
+    cfg: DecoderConfig,
+    ids: jax.Array,  # [b, s]
+    positions: jax.Array,  # [b, s] absolute position per token (RoPE)
+    rope_len: int,  # RoPE table length (>= max position + 1)
+    attend,  # attend(layer, q, k, v) -> [b, s, num_heads, head_dim]
+) -> jax.Array:
+    """The shared transformer trunk: embed, then per layer project
+    q/k/v, apply RoPE at ``positions``, delegate KV-cache writes AND
+    attention to ``attend``, then the wo projection and SwiGLU MLP.
+
+    ``attend(i, q, k, v)`` owns the cache layout: the dense path
+    (:func:`decoder_forward`) writes a contiguous per-lane cache and
+    attends over it; the paged path (``engines/paged.py``) scatters
+    into a block pool and attends through a block table.  Factoring the
+    trunk means the two layouts can never drift in the layer math —
+    every op outside ``attend`` is shared code, so batcher output stays
+    token-exact with the solo engine by construction.
+
+    Returns the final hidden states [b, s, hidden] (pre final-norm;
+    :func:`decoder_head` finishes the stack)."""
+    b, s = ids.shape
+    dtype = jnp.dtype(cfg.dtype)
+    cos, sin = rope_angles(cfg.head_dim, rope_len, cfg.rope_theta)
+    x = params["tok_emb"][ids].astype(dtype)
+    for i in range(cfg.num_layers):
+        y = rms_norm(x, params[f"l{i}_attn_norm_g"], cfg.norm_eps)
+        q = _qmatmul(y, params, f"l{i}_wq", dtype).reshape(
+            b, s, cfg.num_heads, cfg.head_dim
+        )
+        k = _qmatmul(y, params, f"l{i}_wk", dtype).reshape(
+            b, s, cfg.num_kv_heads, cfg.head_dim
+        )
+        v = _qmatmul(y, params, f"l{i}_wv", dtype).reshape(
+            b, s, cfg.num_kv_heads, cfg.head_dim
+        )
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        attn = attend(i, q, k, v)
+        attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim)
+        x = x + _qmatmul(attn, params, f"l{i}_wo", dtype)
+
+        y = rms_norm(x, params[f"l{i}_mlp_norm_g"], cfg.norm_eps)
+        gate = _qmatmul(y, params, f"l{i}_w_gate", dtype)
+        up = _qmatmul(y, params, f"l{i}_w_up", dtype)
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(dtype) * up
+        x = x + _qmatmul(act, params, f"l{i}_w_down", dtype)
+    return x
+
+
+def decoder_head(
+    params: Params,
+    cfg: DecoderConfig,
+    x: jax.Array,  # [b, s, hidden]
+    new_lengths: Optional[jax.Array] = None,
+    last_token_only: bool = False,
+) -> jax.Array:
+    """Final norm + lm_head over the trunk's hidden states (f32 logits)."""
+    dtype = jnp.dtype(cfg.dtype)
+    if last_token_only and x.shape[1] > 1:
+        # prefill path: only the last valid row per lane feeds sampling —
+        # skip the [s, vocab] lm_head matmul for the rest (~s x fewer FLOPs)
+        x = jnp.take_along_axis(x, (new_lengths - 1)[:, None, None], axis=1)
+    x = rms_norm(x, params["final_norm_g"], cfg.norm_eps)
+    return _qmatmul(x, params, "lm_head", dtype).astype(jnp.float32)
+
+
 def decoder_forward(
     params: Params,
     cfg: DecoderConfig,
@@ -178,36 +247,18 @@ def decoder_forward(
     Returns (logits [b, s, vocab] f32, updated cache).
     """
     b, s = ids.shape
-    dtype = jnp.dtype(cfg.dtype)
     max_len = cache["k0"].shape[1]
 
-    cos, sin = rope_angles(cfg.head_dim, max_len, cfg.rope_theta)
     positions = cache_lengths[:, None] + jnp.arange(s)[None, :]  # [b, s]
     positions = jnp.minimum(positions, max_len - 1)
-
-    x = params["tok_emb"][ids].astype(dtype)
     new_lengths = cache_lengths + s if attn_lengths is None else attn_lengths
 
     attn_fn = flash_attention if use_flash else attention_reference
 
-    for i in range(cfg.num_layers):
-        y = rms_norm(x, params[f"l{i}_attn_norm_g"], cfg.norm_eps)
-        q = _qmatmul(y, params, f"l{i}_wq", dtype).reshape(
-            b, s, cfg.num_heads, cfg.head_dim
-        )
-        k = _qmatmul(y, params, f"l{i}_wk", dtype).reshape(
-            b, s, cfg.num_kv_heads, cfg.head_dim
-        )
-        v = _qmatmul(y, params, f"l{i}_wv", dtype).reshape(
-            b, s, cfg.num_kv_heads, cfg.head_dim
-        )
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
-
+    def attend(i, q, k, v):
         cache[f"k{i}"] = _write_cache(cache[f"k{i}"], k, cache_lengths)
         cache[f"v{i}"] = _write_cache(cache[f"v{i}"], v, cache_lengths)
-
-        attn = attn_fn(
+        return attn_fn(
             q,
             cache[f"k{i}"],
             cache[f"v{i}"],
@@ -216,21 +267,9 @@ def decoder_forward(
             q_offset=cache_lengths,
             sliding_window=cfg.sliding_window,
         )
-        attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim)
-        x = x + _qmatmul(attn, params, f"l{i}_wo", dtype)
 
-        y = rms_norm(x, params[f"l{i}_mlp_norm_g"], cfg.norm_eps)
-        gate = _qmatmul(y, params, f"l{i}_w_gate", dtype)
-        up = _qmatmul(y, params, f"l{i}_w_up", dtype)
-        act = jax.nn.silu(gate.astype(jnp.float32)).astype(dtype) * up
-        x = x + _qmatmul(act, params, f"l{i}_w_down", dtype)
-
-    if last_token_only and s > 1:
-        # prefill path: only the last valid row per lane feeds sampling —
-        # skip the [s, vocab] lm_head matmul for the rest (~s x fewer FLOPs)
-        x = jnp.take_along_axis(x, (new_lengths - 1)[:, None, None], axis=1)
-    x = rms_norm(x, params["final_norm_g"], cfg.norm_eps)
-    logits = _qmatmul(x, params, "lm_head", dtype).astype(jnp.float32)
+    x = decoder_layer_stack(params, cfg, ids, positions, max_len, attend)
+    logits = decoder_head(params, cfg, x, new_lengths, last_token_only)
     return logits, cache
 
 

@@ -636,10 +636,20 @@ class TelemetrySampler:
         n_admitting = getattr(b, "n_admitting", None)
         if n_admitting is not None:
             rec("serve_admitting", float(n_admitting), now=now)
-        occupancy = getattr(b, "kv_slot_occupancy", None)
+        occupancy = getattr(b, "kv_block_occupancy", None)
         if occupancy is not None:
-            for bucket, n in occupancy().items():
-                rec(f"serve_kv_slots_bucket_{bucket}", float(n), now=now)
+            # block-pool occupancy (engines/paged.py): per-token KV HBM
+            # accounting at block granularity — the ROADMAP item 1
+            # evidence that replaced the per-bucket slot gauges (a slot
+            # no longer pins a bucket's worth of HBM for its lifetime)
+            occ = occupancy()
+            for key in (
+                "blocks_total", "blocks_used", "block_size",
+                "bytes_per_token", "pool_bytes", "used_bytes",
+                "tokens_committed", "utilization",
+            ):
+                if key in occ:
+                    rec(f"serve_kv_{key}", float(occ[key]), now=now)
         status = getattr(b, "status", None)
         if status is None:
             return

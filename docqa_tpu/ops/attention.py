@@ -98,6 +98,137 @@ def attention_reference(
 
 
 # --------------------------------------------------------------------------
+# Ragged / paged attention (block-table KV; arXiv 2604.15464 contract)
+# --------------------------------------------------------------------------
+
+# Sequence starts inside a packed ragged-prefill batch are aligned to this
+# many rows.  The alignment exists for EXACTNESS, not speed: XLA's softmax
+# reductions (strided SIMD accumulators, power-of-two trees, or sequential
+# sums) all produce bitwise-identical partial sums when the non-zero
+# segment of a masked row starts at a multiple of the reduction's lane
+# width — so a prompt prefilled at offset 128k yields the SAME tokens as
+# the solo engine's offset-0 prefill, which is the serve-vs-solo
+# token-equality invariant every batcher test pins.  A production Pallas
+# RPA kernel packs densely and masks in-kernel instead; this is the XLA
+# reference path's price for bitwise parity.
+RAGGED_ALIGN = 128
+
+
+def ragged_prefill_attention(q, k, v, seg_ids, positions, *,
+                             sliding_window=None, scale=None):
+    """Self-attention over a PACKED batch of variable-length prompts —
+    the prefill half of Ragged Paged Attention, XLA reference path.
+
+    q, k, v   [T, heads, d] — ONE flat token axis; each prompt occupies a
+              contiguous run of rows (starts aligned to RAGGED_ALIGN)
+    seg_ids   [T] int32 — sequence id per token; negative = padding row
+    positions [T] int32 — position of each token within its own sequence
+
+    A token attends only within its own segment, causally by position
+    (plus the optional sliding window).  f32 softmax, same dtype contract
+    as :func:`attention_reference`; padding rows output zeros.  There is
+    no shape family here: any mix of prompt lengths that fits T shares
+    one compiled program.
+
+    Computed in RAGGED_ALIGN-row query blocks (a ``lax.map`` over the
+    packed axis) so the score transient is O(heads x ALIGN x T), never
+    the full O(heads x T x T) — at a 4096-token budget and 7B head
+    count the quadratic form would be ~2 GB of f32 per layer, which the
+    bucketed prefill this replaced never materialized.  Per-row numerics
+    are IDENTICAL to the single-shot form (each row still reduces over
+    the same [T] axis), so the block split cannot perturb greedy
+    outputs.  The Pallas RPA kernel that also skips cross-segment
+    blocks entirely is the TPU follow-up.
+    """
+    t, hq, d = q.shape
+    _, hkv, _ = k.shape
+    groups = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if groups > 1:
+        kf = jnp.repeat(kf, groups, axis=1)
+        vf = jnp.repeat(vf, groups, axis=1)
+
+    valid = seg_ids >= 0
+
+    def attend_rows(row_idx):
+        """One query block: rows ``row_idx`` [bq] against all T keys."""
+        qb = qf[row_idx]  # [bq, hq, d]
+        seg_q = seg_ids[row_idx]
+        pos_q = positions[row_idx]
+        scores = jnp.einsum("qhd,khd->hqk", qb, kf)  # [hq, bq, T]
+        mask = (
+            (seg_q[:, None] == seg_ids[None, :])
+            & (valid[row_idx][:, None] & valid[None, :])
+            & (positions[None, :] <= pos_q[:, None])
+        )
+        if sliding_window is not None:
+            mask &= positions[None, :] > pos_q[:, None] - sliding_window
+        mask = mask[None, :, :]  # [1, bq, T]
+        scores = jnp.where(mask, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        # fully-masked rows (padding) output zeros, like the dense path
+        probs = jnp.where(jnp.any(mask, axis=-1, keepdims=True), probs, 0.0)
+        return jnp.einsum("hqk,khd->qhd", probs, vf)  # [bq, hq, d]
+
+    if t % RAGGED_ALIGN or t <= RAGGED_ALIGN:
+        out = attend_rows(jnp.arange(t))
+    else:
+        blocks = jnp.arange(t).reshape(t // RAGGED_ALIGN, RAGGED_ALIGN)
+        out = jax.lax.map(attend_rows, blocks).reshape(t, hq, d)
+    return out.astype(q.dtype)
+
+
+def gather_paged_kv(pool, block_tables, block_size):
+    """Gather a per-sequence contiguous KV view out of a flat block pool.
+
+    pool         [P, kv_heads, d] — P = n_blocks * block_size flat rows
+    block_tables [S, NB] int32 — block ids per sequence; ids >= n_blocks
+                 are holes (unallocated tail), clamped and later masked
+                 by the caller's ``lengths``
+
+    Returns [S, NB * block_size, kv_heads, d]: row p of sequence s is
+    that sequence's token-position p, exactly the layout a dense
+    per-lane cache would have — so downstream attention reductions are
+    bitwise identical to the contiguous-cache path.
+    """
+    S, nb = block_tables.shape
+    L = nb * block_size
+    P = pool.shape[0]
+    cols = jnp.arange(L)
+    blk = jnp.take(block_tables, cols // block_size, axis=1)  # [S, L]
+    rows = jnp.minimum(blk * block_size + cols[None, :] % block_size, P - 1)
+    return pool[rows]
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths, *,
+                           block_size, q_offset=None, sliding_window=None,
+                           scale=None, use_flash=False):
+    """Decode-side attention through a block table (the decode half of
+    Ragged Paged Attention).  XLA reference path: gather the pages into a
+    per-sequence contiguous view, then run the standard masked kernel —
+    a TPU Pallas kernel would stream pages without materializing the
+    gather; this backs it the same way :func:`attention_reference` backs
+    :func:`flash_attention`.
+
+    q            [S, s, q_heads, d] (s = 1 plain step, K spec verify)
+    k/v_pool     [P, kv_heads, d] flat block pool
+    block_tables [S, NB] int32
+    lengths      [S] valid kv length per sequence AFTER this step
+    """
+    k = gather_paged_kv(k_pool, block_tables, block_size)
+    v = gather_paged_kv(v_pool, block_tables, block_size)
+    attn_fn = flash_attention if use_flash else attention_reference
+    return attn_fn(
+        q, k, v, causal=True, lengths=lengths, q_offset=q_offset,
+        sliding_window=sliding_window, scale=scale,
+    )
+
+
+# --------------------------------------------------------------------------
 # Pallas flash kernel
 # --------------------------------------------------------------------------
 

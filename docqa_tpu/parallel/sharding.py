@@ -109,3 +109,29 @@ def shard_kv_cache(cache, cfg: DecoderConfig, mesh: MeshContext):
         k: jax.device_put(v, NamedSharding(mesh.mesh, specs[k]))
         for k, v in cache.items()
     }
+
+
+def paged_pool_pspecs(cfg: DecoderConfig, mesh: MeshContext) -> Dict[str, P]:
+    """Paged KV block pool [n_blocks * block_size, kv_heads, head_dim]
+    (engines/paged.py): kv heads over the model axis — decode attention
+    stays local per TP shard, exactly like the dense cache — and the
+    flat block-row axis REPLICATED over data.  Blocks are a shared
+    resource every slot allocates from, so unlike the dense per-lane
+    cache there is no batch axis to split over ``data``; the scatter /
+    gather ride the unsharded row axis and insert no collective (the
+    shard audit's decoder_paged_decode program holds that to the same
+    one-all-reduce-per-Megatron-block budget as the dense programs)."""
+    spec = P(None, mesh.model_axis, None)
+    out: Dict[str, P] = {}
+    for i in range(cfg.num_layers):
+        out[f"k{i}"] = spec
+        out[f"v{i}"] = spec
+    return out
+
+
+def shard_paged_pools(pools, cfg: DecoderConfig, mesh: MeshContext):
+    specs = paged_pool_pspecs(cfg, mesh)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh.mesh, specs[k]))
+        for k, v in pools.items()
+    }

@@ -555,18 +555,17 @@ class DocQARuntime:
 
     def _warmup_decode(self) -> None:
         try:
-            # compile BOTH admission shape families (4-lane trickle + full
-            # n_slots) plus the decode chunk for the configured warm depth
-            # (gen.startup_warm_buckets smallest buckets; -1 = the whole
-            # ladder) — the single dummy submit below only ever warmed
-            # the trickle shape, so the first busy round paid a
-            # full-width prefill compile inside a live request's deadline
+            # compile the ragged-prefill token budgets plus the decode
+            # chunk for the configured warm depth
+            # (gen.startup_warm_buckets smallest budgets; -1 = all of
+            # them — the whole paged matrix is <= 3 programs, so even
+            # "all" is cheap now) ahead of the first busy round
             gen = self.batcher.gen
             depth = gen.startup_warm_buckets
             if depth != 0:
                 buckets = (
                     None if depth < 0
-                    else list(gen.prefill_buckets[:depth])
+                    else list(gen.prefill_token_buckets[:depth])
                 )
                 self.batcher.warmup(buckets=buckets)
             # then one real request end to end: exercises admission,
@@ -576,7 +575,7 @@ class DocQARuntime:
                 [1, 2, 3], max_new_tokens=2
             ).result(timeout=600)
             log.info(
-                "decode programs warm (both prefill shape families, "
+                "decode programs warm (ragged token budgets, "
                 "warm depth %s)", depth,
             )
         except Exception:

@@ -137,6 +137,18 @@ def measure(telemetry_out: str | None = None) -> dict:
         metrics["load_qps"] = round(n_req / wall, 2)
         metrics["load_p50_ms"] = round(float(np.percentile(lat, 50)), 1)
         metrics["load_p95_ms"] = round(float(np.percentile(lat, 95)), 1)
+        # paged-KV ratchet (docqa-paged): per-token KV bytes (block
+        # granularity — a regression back to per-bucket reservation
+        # shows up as this growing) and the batcher's whole compiled
+        # program count (ragged prefill budgets + decode chunk; the
+        # pre-paged matrix was 2 families x buckets)
+        from docqa_tpu.analysis.compile_audit import jit_cache_size
+
+        occ = b.kv_block_occupancy()
+        metrics["kv_bytes_per_token"] = occ["bytes_per_token"]
+        metrics["serve_compiled_programs"] = int(
+            jit_cache_size(b._prefill_fn) + jit_cache_size(b._decode_fn)
+        )
     finally:
         if sampler is not None:
             sampler.stop()
@@ -311,6 +323,10 @@ def write_baseline(
         "load_p50_ms": ("lower", 75),
         "load_p95_ms": ("lower", 100),
         "retrieve_p50_ms": ("lower", 75),
+        # structural paged-KV budgets, not timings: tight bands — these
+        # only move when the KV layout or the compile matrix changes
+        "kv_bytes_per_token": ("lower", 10),
+        "serve_compiled_programs": ("lower", 10),
     }
     # context-only outputs (exact token counts, sample sizes) are for
     # humans reading the report, not latency budgets

@@ -103,6 +103,44 @@ class TestBenchLoadSweepShapes:
         finally:
             b.stop()
 
+    def test_kv_paging_sweep_call_shape(self):
+        """bench kv_paging sweep: a ContinuousBatcher with a FIXED
+        kv_pool_tokens overcommit, a live sampler, and the
+        serve_kv_blocks_used series the sweep summarizes into peak
+        occupancy — the exact API sequence at toy size."""
+        from docqa_tpu import obs
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        eng = GenerateEngine(
+            TINY, GenerateConfig(max_new_tokens=8, prefill_buckets=(16,))
+        )
+        b = ContinuousBatcher(
+            eng, n_slots=4, chunk=8, cache_len=128,
+            kv_pool_tokens=2 * 128,  # half of the 4-slot worst case
+        )
+        tstore = obs.TelemetryStore(interval_s=0.2, points=100)
+        sampler = obs.TelemetrySampler(
+            tstore, batcher=b, sample_every_s=0.02, hbm_refresh_s=0
+        ).start()
+        try:
+            prompts = [[7 + i % 13, 5, 9, 11, 3 + i % 7] for i in range(12)]
+            handles = [b.submit_ids(p, max_new_tokens=8) for p in prompts]
+            results = [h.result(timeout=120) for h in handles]
+            assert all(len(r) <= 8 for r in results)
+            occ = b.kv_block_occupancy()
+            assert occ["blocks_total"] == (2 * 128) // occ["block_size"]
+        finally:
+            sampler.stop()
+            b.stop()
+        series = tstore.series("serve_kv_blocks_used")
+        vals = [
+            p.get("value") for p in (series or {}).get("points", [])
+            if isinstance(p.get("value"), (int, float))
+        ]
+        assert vals and max(vals) > 0  # peak occupancy was observable
+        assert max(vals) <= occ["blocks_total"]
+
     def test_delta_windowed_histogram_math(self):
         """bench 5b's serve_tokens_per_chunk delta-mean formula."""
         from docqa_tpu.runtime.metrics import Histogram

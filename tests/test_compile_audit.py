@@ -247,22 +247,32 @@ class TestLedgerSync:
 
 class TestLiveWorkloads:
     """Cheap workloads on CPU, held to the checked-in budget numbers.
-    The serve workload (the tentpole's two-shape contract) runs in full;
-    the rest ride scripts/compile_audit.py in CI."""
+    The serve workload (the paged tentpole's collapsed-matrix contract)
+    runs in full; the rest ride scripts/compile_audit.py in CI."""
 
-    def test_serve_workload_two_shape_contract(self):
+    def test_serve_workload_paged_contract(self):
+        """The docqa-paged headline: the batcher's WHOLE compile matrix
+        is <= 3 programs (ragged token budgets + one decode chunk), with
+        mixed prompt lengths sharing the warm programs retrace-free —
+        the pre-paged matrix was (2 shape families x buckets) = 4 at
+        this audit config."""
         result = ca._AUDITS["serve"]()
         prefill = result["roots"]["serve_prefill"]
         decode = result["roots"]["serve_decode"]
-        # both shape families x both buckets, warmed ahead of serving
-        assert prefill["compiles"] == prefill["expected_shapes"] == 4
+        assert result["meta"]["paged"] is True
+        n_buckets = len(result["meta"]["token_buckets"])
+        assert prefill["compiles"] == prefill["expected_shapes"] == n_buckets
+        assert prefill["compiles"] + decode["compiles"] <= 3
         assert prefill["steady_state_retraces"] == 0
         assert decode["compiles"] == 1
         assert decode["steady_state_retraces"] == 0
-        # the trickle family exists to be cheaper
-        trickle = prefill["per_shape"]["trickle"]["peak_bytes"]
-        full = prefill["per_shape"]["full"]["peak_bytes"]
-        assert 0 < trickle < full
+        # per-token KV accounting rides the meta (block granularity)
+        assert result["meta"]["kv_bytes_per_token"] > 0
+        assert result["meta"]["kv_pool_bytes"] == (
+            result["meta"]["kv_pool_blocks"]
+            * result["meta"]["kv_block_size"]
+            * result["meta"]["kv_bytes_per_token"]
+        )
         # and the checked-in budget grants exactly these counts
         budget = ca.load_budget()
         want = budget["workloads"]["serve"]["roots"]
@@ -270,6 +280,17 @@ class TestLiveWorkloads:
         assert prefill["peak_bytes"] <= want["serve_prefill"][
             "peak_bytes_ceiling"
         ]
+
+    def test_paged_matrix_regrowth_flips_red(self):
+        """A paged serve measurement whose program count regrows past 3
+        fails the SEMANTIC gate (re-derived from the measurement, so a
+        budget regeneration cannot launder it)."""
+        result = ca._AUDITS["serve"]()
+        result["roots"]["serve_prefill"]["compiles"] = 5
+        violations = ca.semantic_violations(
+            {"workloads": {"serve": result}}
+        )
+        assert any("<= 3" in v for v in violations)
 
     def test_encoder_and_retrieve_workloads_steady(self):
         for name in ("encoder", "retrieve_fused"):
@@ -279,11 +300,11 @@ class TestLiveWorkloads:
                 assert root["compiles"] == root["expected_shapes"]
                 assert root["peak_bytes"] > 0
 
-    def test_warmup_clamps_oversized_buckets(self):
-        """A prefill bucket larger than the cache budget is CLAMPED to
-        ``usable`` (the shape _admit_round actually dispatches), never
-        dropped — dropping it left the clamped shape to compile inside
-        the first live request that exceeded the budget."""
+    def test_warmup_covers_over_budget_prompts(self):
+        """Token budgets larger than the packed cache capacity CLAMP to
+        it (never drop): an over-budget prompt truncates to ``usable``
+        and must admit against a warm program with zero retraces — and
+        a round of mixed lengths must share those same programs."""
         from docqa_tpu.engines.serve import ContinuousBatcher
         from docqa_tpu.engines.generate import GenerateEngine
         from docqa_tpu.config import GenerateConfig
@@ -291,7 +312,7 @@ class TestLiveWorkloads:
         cfg = ca._audit_decoder_cfg()
         gen = GenerateConfig(
             max_new_tokens=4,
-            prefill_buckets=(16, 4096),  # 4096 >> cache budget
+            prefill_token_buckets=(16, 4096),  # 4096 >> cache budget
             decode_chunk=4,
             max_concurrent=8,
         )
@@ -301,14 +322,18 @@ class TestLiveWorkloads:
         try:
             batcher.warmup()
             usable = batcher.cache_len - 2 - batcher.spec_k
-            # shapes warmed: {16, usable} x {trickle, full}
-            assert batcher._prefill_fn._cache_size() == 4
-            # the clamped shape is warm: an over-budget prompt admits
-            # with zero retraces
+            # 16 and 4096 both collapse onto the one aligned packed
+            # capacity (128-aligned), so ONE program covers everything
+            assert len(batcher._token_buckets) == 1
             before = batcher._prefill_fn._cache_size()
-            batcher.submit_ids(
-                [1] * (usable + 40), max_new_tokens=2
-            ).result(timeout=120)
+            assert before == len(batcher._token_buckets)
+            handles = [
+                batcher.submit_ids([1] * (usable + 40), max_new_tokens=2),
+                batcher.submit_ids([1] * 3, max_new_tokens=2),
+                batcher.submit_ids([1] * 17, max_new_tokens=2),
+            ]
+            for h in handles:
+                h.result(timeout=120)
             assert batcher._prefill_fn._cache_size() == before
         finally:
             batcher.stop()

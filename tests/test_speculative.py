@@ -91,6 +91,35 @@ class TestExactness:
         assert out_s == out_p
         assert all(len(r) <= max_new for r in out_s)
 
+    def test_default_promotion_gated_by_token_equality(self):
+        """speculative_k=4 is the SHIPPED default (promoted from a bench
+        knob per ROADMAP item 3 after BENCH_r04 measured 17.3->18.3 QPS)
+        — this is its quality gate: the default config's output must
+        equal speculative_k=0 token for token, both solo and through
+        the continuous batcher."""
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        assert GenerateConfig().speculative_k == 4
+        default_cfg = GenerateConfig(
+            max_new_tokens=12, prefill_buckets=(16,)
+        )
+        plain_cfg = dataclasses.replace(default_cfg, speculative_k=0)
+        default_eng = GenerateEngine(CFG, default_cfg, seed=3)
+        plain_eng = GenerateEngine(
+            CFG, plain_cfg, params=default_eng.params
+        )
+        assert default_eng.generate_ids(PROMPTS) == plain_eng.generate_ids(
+            PROMPTS
+        )
+        b = ContinuousBatcher(default_eng, n_slots=2, chunk=4, cache_len=64)
+        try:
+            assert b.spec_k == 4  # the default reaches the served path
+            handles = [b.submit_ids(p, max_new_tokens=12) for p in PROMPTS]
+            got = [h.result(timeout=300) for h in handles]
+        finally:
+            b.stop()
+        assert got == plain_eng.generate_ids(PROMPTS)
+
     def test_sampling_falls_back_to_plain(self):
         # speculation is greedy-only; temperature>0 must route to the
         # stochastic program, not silently ignore the temperature

@@ -639,7 +639,7 @@ def tiny_engine():
 
 
 class TestSamplerAgainstPool:
-    def test_kv_slot_occupancy_shape(self, tiny_engine):
+    def test_kv_block_occupancy_shape(self, tiny_engine):
         from docqa_tpu.engines.serve import ContinuousBatcher
 
         b = ContinuousBatcher(
@@ -647,7 +647,10 @@ class TestSamplerAgainstPool:
         )
         try:
             b.warmup(buckets=[16])
-            assert b.kv_slot_occupancy() == {}
+            occ0 = b.kv_block_occupancy()
+            assert occ0["blocks_used"] == 0
+            assert occ0["blocks_total"] == b.n_blocks
+            assert occ0["bytes_per_token"] > 0
             handles = [
                 b.submit_ids([3 + i, 5, 9], max_new_tokens=48)
                 for i in range(2)
@@ -655,23 +658,30 @@ class TestSamplerAgainstPool:
             seen = {}
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
-                occ = b.kv_slot_occupancy()
-                if occ:
+                occ = b.kv_block_occupancy()
+                if occ["blocks_used"]:
                     seen = occ
                     break
                 time.sleep(0.002)
             for h in handles:
                 h.result(timeout=60)
             assert seen, "occupancy never became visible during decode"
-            assert all(
-                isinstance(k, int) and v >= 1 for k, v in seen.items()
+            # blocks are bounded by the pool and the byte accounting is
+            # block-granular per-token math, not per-bucket reservation
+            assert 0 < seen["blocks_used"] <= seen["blocks_total"]
+            assert seen["used_bytes"] == (
+                seen["blocks_used"] * seen["block_size"]
+                * seen["bytes_per_token"]
             )
-            assert sum(seen.values()) <= 2
-            # drained: freed slots leave the occupancy map
+            assert 0 < seen["utilization"] <= 1
+            # drained: retirement frees every block back to the pool
             deadline = time.monotonic() + 10
-            while b.kv_slot_occupancy() and time.monotonic() < deadline:
+            while (
+                b.kv_block_occupancy()["blocks_used"]
+                and time.monotonic() < deadline
+            ):
                 time.sleep(0.002)
-            assert b.kv_slot_occupancy() == {}
+            assert b.kv_block_occupancy()["blocks_used"] == 0
         finally:
             b.stop()
 
