@@ -94,10 +94,25 @@ def jit_cache_size(fn) -> int:
 
 
 def lowered_memory(fn, *args, **kwargs) -> Optional[Dict[str, int]]:
+    """AOT memory accounting for one root, augmented with the compiled
+    program's ``cost_analysis()`` FLOPs / bytes-accessed — the cost
+    model every ledgered jit root now carries (docqa-observatory).  The
+    GATE stays compile-count/bytes-based; the cost columns are
+    informational (they feed the same per-program accounting the
+    dispatch spine's MFU attribution uses at runtime)."""
+    from docqa_tpu.obs.observatory import parse_cost_analysis
+
     try:
-        return memory_of(fn.lower(*args, **kwargs).compile())
+        compiled = fn.lower(*args, **kwargs).compile()
     except Exception:
         return None
+    out = memory_of(compiled)
+    cost = parse_cost_analysis(compiled)
+    if cost is not None:
+        # backends without the estimate keep bytes-only rows
+        out = dict(out or {})
+        out.update(cost)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -260,6 +275,15 @@ def _audit_serve() -> Dict[str, Any]:
                         (m or {}).get("peak_bytes", 0)
                         for m in per_shape.values()
                     ),
+                    # cost model (informational; gate stays bytes-based)
+                    "flops": max(
+                        (m or {}).get("flops", 0)
+                        for m in per_shape.values()
+                    ),
+                    "bytes_accessed": max(
+                        (m or {}).get("bytes_accessed", 0)
+                        for m in per_shape.values()
+                    ),
                 },
                 "serve_decode": {
                     "compiles": warm_decode,
@@ -267,6 +291,10 @@ def _audit_serve() -> Dict[str, Any]:
                     "steady_state_retraces": retrace_decode,
                     "memory": decode_mem,
                     "peak_bytes": (decode_mem or {}).get("peak_bytes", 0),
+                    "flops": (decode_mem or {}).get("flops", 0),
+                    "bytes_accessed": (
+                        (decode_mem or {}).get("bytes_accessed", 0)
+                    ),
                 },
             },
         }
@@ -293,6 +321,8 @@ def _audit_generate() -> Dict[str, Any]:
                 "steady_state_retraces": after - warm,
                 "memory": mem,
                 "peak_bytes": (mem or {}).get("peak_bytes", 0),
+                "flops": (mem or {}).get("flops", 0),
+                "bytes_accessed": (mem or {}).get("bytes_accessed", 0),
             }
         },
     }
@@ -352,6 +382,8 @@ def _audit_retrieve() -> Dict[str, Any]:
                 "steady_state_retraces": after - warm,
                 "memory": mem,
                 "peak_bytes": (mem or {}).get("peak_bytes", 0),
+                "flops": (mem or {}).get("flops", 0),
+                "bytes_accessed": (mem or {}).get("bytes_accessed", 0),
             }
         },
     }
@@ -385,6 +417,8 @@ def _audit_seq2seq() -> Dict[str, Any]:
                 "steady_state_retraces": after - warm,
                 "memory": mem,
                 "peak_bytes": (mem or {}).get("peak_bytes", 0),
+                "flops": (mem or {}).get("flops", 0),
+                "bytes_accessed": (mem or {}).get("bytes_accessed", 0),
             }
         },
     }
@@ -417,6 +451,8 @@ def _audit_encoder() -> Dict[str, Any]:
                 "steady_state_retraces": after - warm,
                 "memory": mem,
                 "peak_bytes": (mem or {}).get("peak_bytes", 0),
+                "flops": (mem or {}).get("flops", 0),
+                "bytes_accessed": (mem or {}).get("bytes_accessed", 0),
             }
         },
     }

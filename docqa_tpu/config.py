@@ -457,6 +457,44 @@ class PoolConfig:
 
 
 @dataclass(frozen=True)
+class DispatchConfig:
+    """Bounded async dispatch spine (``engines/spine.py``;
+    docs/OBSERVABILITY.md "Device observatory").
+
+    Every device dispatch in the process flows through one spine of
+    ``n_lanes`` executor lanes — the number of threads concurrently
+    inside jax dispatch/compile is bounded by construction, retiring
+    the >= 3-concurrent-stream CPU-client deadlock class the
+    ``dispatch_streams.json`` budget used to gate statically."""
+
+    # concurrent device-dispatch lanes.  2 is the count
+    # scripts/serve_cluster_loop.py measured clean on the CPU client;
+    # a real multi-controller TPU runtime can raise it once
+    # serve_cluster_loop records fresh capacity evidence.
+    n_lanes: int = 2
+    # bounded work-item queue: submitters are synchronous, so depth
+    # tracks live submitting threads — saturation means a runaway
+    # producer and fails typed (SpineSaturated)
+    max_depth: int = 256
+    # inline mode runs work items on the submitting thread (no lanes) —
+    # the bench dispatch-overhead A/B's OFF arm; never serve with it
+    inline: bool = False
+    # strict mode FULLY SERIALIZES device work: one lane runs at a time
+    # and every item block_until_ready()s on it, so exactly one device
+    # program is ever in flight.  None = auto: ON for the multi-device
+    # CPU client — whose collective scheduler parks even at 2 concurrent
+    # sharded dispatches (PR-6 notes: 1-in-4 pre-spine; reproduced
+    # deterministically by serve_cluster_loop under load) — OFF for
+    # single-device and real TPU runtimes, which keep n_lanes-bounded
+    # concurrency and the async decode pipeline.
+    strict_sync: Optional[bool] = None
+    # register compiled-program cost_analysis() FLOPs/bytes at boot so
+    # /api/status and bench report per-stage MFU (a few background
+    # lowerings; disable on hosts where tracing at boot is too dear)
+    annotate_costs: bool = True
+
+
+@dataclass(frozen=True)
 class TelemetryConfig:
     """Time-series telemetry + SLO burn-rate policy (``obs/telemetry.py``
     / ``obs/slo.py``; docqa-telemetry, docs/OBSERVABILITY.md "Time
@@ -578,6 +616,7 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     pool: PoolConfig = field(default_factory=PoolConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    dispatch: DispatchConfig = field(default_factory=DispatchConfig)
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Tuple
 
 from docqa_tpu import obs
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.resilience.deadline import Deadline
 from docqa_tpu.runtime.metrics import get_logger
 
@@ -77,7 +78,14 @@ def dispatch_with_donation_retry(
         if fn is None:
             return None
         try:
-            return fn(*args)
+            # spine work item, ASYNC like the pre-spine call: the lane
+            # covers the hazard window (trace/compile + enqueue) and
+            # returns device arrays immediately, so FusedRAG's
+            # pack→generate device chaining keeps its no-sync contract
+            # and a lane is never held for the program's device time.
+            # A donation race surfaces at dispatch (tracing re-reads the
+            # donated buffers) exactly as it did pre-spine.
+            return spine_run("retrieve", fn, *args, deadline=deadline)
         except RuntimeError as e:
             if not _is_deleted_buffer_error(e):
                 raise
@@ -99,4 +107,7 @@ def dispatch_with_donation_retry(
         fn, args = snapshot_and_build()
         if fn is None:
             return None
-        return fn(*args)
+        # still a spine item even under the lock: the submitter holds
+        # the store lock while BLOCKED on the ticket; the lane runs the
+        # closure without acquiring anything, so no lock-order edge
+        return spine_run("retrieve", fn, *args, deadline=deadline)

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from docqa_tpu.config import EncoderConfig
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.models.encoder import Params, encode_batch, init_encoder_params
 from docqa_tpu.runtime.mesh import MeshContext
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
@@ -104,13 +105,17 @@ class EncoderEngine:
             # batch axis must divide evenly over the data axis
             n_data=self.mesh.n_data if self.mesh is not None else None,
         )
-        ids_j, len_j = jnp.asarray(ids_p), jnp.asarray(len_p)
-        if self.mesh is not None and self.mesh.n_data > 1:
-            ids_j = jax.device_put(ids_j, self.mesh.batch_sharded)
-            len_j = jax.device_put(len_j, self.mesh.batch_sharded)
-        with span("encode_batch", DEFAULT_REGISTRY):
+        def _encode_on_lane():
+            """Device phase (spine work item): upload, forward, fetch."""
+            ids_j, len_j = jnp.asarray(ids_p), jnp.asarray(len_p)
+            if self.mesh is not None and self.mesh.n_data > 1:
+                ids_j = jax.device_put(ids_j, self.mesh.batch_sharded)
+                len_j = jax.device_put(len_j, self.mesh.batch_sharded)
             emb = self._encode(params=self.params, ids=ids_j, lengths=len_j)
-            emb = np.asarray(emb, np.float32)
+            return np.asarray(emb, np.float32)
+
+        with span("encode_batch", DEFAULT_REGISTRY):
+            emb = spine_run("encode", _encode_on_lane)
         return emb[:n]
 
 

@@ -33,6 +33,7 @@ from docqa_tpu.models.decoder import (
     init_decoder_params,
     init_kv_cache,
 )
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.ops.sampling import sample
 from docqa_tpu.parallel.sharding import cache_pspecs, shard_decoder_params
 from docqa_tpu.runtime.mesh import MeshContext
@@ -502,16 +503,37 @@ class GenerateEngine:
         if self.mesh is not None:
             b_pad = round_up(b_pad, self.mesh.n_data)
         fn = self._get_fn(b_pad, bucket, max_new, greedy=temperature == 0.0)
+
+        def _probe_on_lane():
+            """AOT lower+compile as a BACKGROUND spine item: the probe's
+            compile must queue behind serving work, never become another
+            concurrent client stream (the telemetry sampler fires this
+            every hbm_refresh_s)."""
+            from docqa_tpu.obs.observatory import DEFAULT_OBSERVATORY
+
+            compiled = fn.lower(
+                self.params,
+                jax.ShapeDtypeStruct((b_pad, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((b_pad,), jnp.int32),
+                jax.random.PRNGKey(0),
+                jnp.float32(temperature),
+            ).compile()
+            # the compiled program is in hand: register its cost model
+            # so the solo `generate` stage reports MFU too
+            key = (b_pad, bucket, max_new, temperature == 0.0)
+            DEFAULT_OBSERVATORY.annotate_lowered("generate", compiled, key=key)
+            stats = compiled_memory_stats(compiled)
+            cost = DEFAULT_OBSERVATORY.cost_of("generate", key)
+            if stats is not None and cost is not None:
+                # cost columns ride the same probe (compile_audit /
+                # bench rows then carry flops next to bytes)
+                stats = dict(stats)
+                stats["flops"] = cost["flops"]
+                stats["bytes_accessed"] = cost["bytes"]
+            return stats
+
         try:
-            return compiled_memory_stats(
-                fn.lower(
-                    self.params,
-                    jax.ShapeDtypeStruct((b_pad, bucket), jnp.int32),
-                    jax.ShapeDtypeStruct((b_pad,), jnp.int32),
-                    jax.random.PRNGKey(0),
-                    jnp.float32(temperature),
-                ).compile()
-            )
+            return spine_run("hbm_probe", _probe_on_lane, stream="probe")
         except Exception:
             # a lowering failure must not take the bench/audit caller
             # down, but it must be VISIBLE — a silent None here would
@@ -565,16 +587,26 @@ class GenerateEngine:
             lengths[i] = max(len(p), 1)
 
         fn = self._get_fn(b_pad, bucket, max_new, greedy=temperature == 0.0)
-        with span("generate", DEFAULT_REGISTRY):
-            out, n_emitted = fn(
+
+        def _generate_on_lane():
+            """Device phase (spine work item): upload, dispatch, and the
+            one fetch — solo generate has no pipeline to overlap, so
+            dispatch+fetch ride one item and its duration is the
+            program's device time."""
+            o, n = fn(
                 self.params,
                 jnp.asarray(ids),
                 jnp.asarray(lengths),
                 jax.random.PRNGKey(seed),
                 jnp.float32(temperature),
             )
-            out = np.asarray(out)[:b]
-            n_emitted = np.asarray(n_emitted)[:b]
+            return np.asarray(o)[:b], np.asarray(n)[:b]
+
+        with span("generate", DEFAULT_REGISTRY):
+            out, n_emitted = spine_run(
+                "generate", _generate_on_lane,
+                cost_key=(b_pad, bucket, max_new, temperature == 0.0),
+            )
 
         return [
             [int(t) for t in row[:count]]

@@ -655,6 +655,13 @@ class EnginePool:
         for r in self._replicas:
             r.batcher.warmup(buckets=buckets)
 
+    def annotate_costs(self) -> bool:
+        """Register program cost models with the observatory (batcher
+        passthrough).  ONE replica suffices: the pool shares a single
+        compiled program set across replicas, so the cost model of
+        replica 0's programs is the cost model of every replica's."""
+        return self._replicas[0].batcher.annotate_costs()
+
     # ---- failover ------------------------------------------------------------
 
     def _on_worker_death(self, idx: int, batcher: ContinuousBatcher, queued):

@@ -47,6 +47,7 @@ from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.index.store import NEG_INF, SearchResult, _search_single
 from docqa_tpu.models.encoder import encode_batch
@@ -86,21 +87,36 @@ class FusedAnswer:
 
     def prompt_tokens(self) -> List[int]:
         """Fetch the packed prompt (costs a sync; tests/debugging only)."""
-        toks = np.asarray(self._prompt_dev)[0]
-        n = int(np.asarray(self._prompt_len_dev)[0])
+        toks, n = spine_run(
+            "fused_rag_fetch",
+            lambda: (
+                np.asarray(self._prompt_dev)[0],
+                int(np.asarray(self._prompt_len_dev)[0]),
+            ),
+        )
         return [int(t) for t in toks[:n]]
 
     def hits(self) -> List[SearchResult]:
         if self._hits is None:
-            vals = np.asarray(self._vals_dev)[:1]
-            row_ids = np.asarray(self._row_ids_dev)[:1]
+            vals, row_ids = spine_run(
+                "fused_rag_fetch",
+                lambda: (
+                    np.asarray(self._vals_dev)[:1],
+                    np.asarray(self._row_ids_dev)[:1],
+                ),
+            )
             self._hits = self._rag.store.assemble_results(vals, row_ids)[0]
         return self._hits
 
     def resolve(self) -> Dict[str, Any]:
         hits = self.hits()  # fetch hits first: overlaps decode
-        out = np.asarray(self._out_dev)[0]
-        n = int(np.asarray(self._n_dev)[0])
+        out, n = spine_run(
+            "fused_rag_fetch",
+            lambda: (
+                np.asarray(self._out_dev)[0],
+                int(np.asarray(self._n_dev)[0]),
+            ),
+        )
         answer = self._rag.generator.tokenizer.decode_ids(
             [int(t) for t in out[:n]]
         )
@@ -390,10 +406,16 @@ class FusedRAG:
         gfn = gen._get_fn(
             1, l_bucket, max_new, greedy=gen.gen.temperature == 0.0
         )
-        with span("fused_rag_generate", DEFAULT_REGISTRY):
-            out, n_emitted = gfn(
+
+        def _generate_on_lane():
+            return gfn(
                 gen.params, prompt, total, jax.random.PRNGKey(0),
                 jnp.float32(gen.gen.temperature),
+            )
+
+        with span("fused_rag_generate", DEFAULT_REGISTRY):
+            out, n_emitted = spine_run(
+                "fused_rag_generate", _generate_on_lane
             )
         return FusedAnswer(
             self, row_ids, vals, out, n_emitted,

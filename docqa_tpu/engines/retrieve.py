@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
 from docqa_tpu.engines.encoder import marshal_texts
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.index.store import (
     SearchResult,
     VectorStore,
@@ -321,8 +322,8 @@ class FusedTieredRetriever:
         fn = self._get_fn(fetch, nprobe, k_tail)
         if deadline is not None:  # marshal/rebuild may have eaten the budget
             deadline.check("retrieve_dispatch")
-        with span("fused_tiered_query", DEFAULT_REGISTRY):
-            bulk_vals, bulk_ids, tail_vals, tail_ids = fn(
+        def _tiered_on_lane():
+            return fn(
                 self.encoder.params,
                 jnp.asarray(ids_p),
                 jnp.asarray(len_p),
@@ -333,6 +334,14 @@ class FusedTieredRetriever:
                 ivf._spill_ids,
                 tail_dev,
                 jnp.int32(n_live),
+            )
+
+        with span("fused_tiered_query", DEFAULT_REGISTRY):
+            # async like the exact path: the lane covers trace/compile +
+            # enqueue; the np.asarray fetches below block on the caller
+            # (an executor lane, not a dispatch stream) as before
+            bulk_vals, bulk_ids, tail_vals, tail_ids = spine_run(
+                "retrieve", _tiered_on_lane, deadline=deadline
             )
         bulk_vals = np.asarray(bulk_vals, np.float32)[:n]
         bulk_ids = np.asarray(bulk_ids)[:n]

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from docqa_tpu.config import Seq2SeqConfig
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.models.seq2seq import (
     Params,
     beam_summarize_fn,
@@ -128,16 +129,18 @@ class Seq2SeqEngine:
             ids[i, : len(s)] = s
             lengths[i] = max(len(s), 1)
         fn = self._get_fn(max_new)
-        ids_j, len_j = jnp.asarray(ids), jnp.asarray(lengths)
-        if self.mesh is not None and self.mesh.n_data > 1:
-            ids_j = jax.device_put(ids_j, self.mesh.batch_sharded)
-            len_j = jax.device_put(len_j, self.mesh.batch_sharded)
+
+        def _summarize_on_lane():
+            """Device phase (spine work item): upload, forward, fetch."""
+            ids_j, len_j = jnp.asarray(ids), jnp.asarray(lengths)
+            if self.mesh is not None and self.mesh.n_data > 1:
+                ids_j = jax.device_put(ids_j, self.mesh.batch_sharded)
+                len_j = jax.device_put(len_j, self.mesh.batch_sharded)
+            o, ne = fn(self.params, src_ids=ids_j, src_lengths=len_j)
+            return np.asarray(o)[:b], np.asarray(ne)[:b]
+
         with span("seq2seq_generate", DEFAULT_REGISTRY):
-            out, n_emitted = fn(
-                self.params, src_ids=ids_j, src_lengths=len_j,
-            )
-        out = np.asarray(out)[:b]
-        n_emitted = np.asarray(n_emitted)[:b]
+            out, n_emitted = spine_run("seq2seq_generate", _summarize_on_lane)
         return [
             [int(t) for t in row[:count]]
             for row, count in zip(out, n_emitted)

@@ -81,6 +81,7 @@ from docqa_tpu.engines.paged import (
     ragged_prefill_forward,
 )
 from docqa_tpu.engines.generate import accept_drafts, draft_tokens
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.models.decoder import (
     init_decoder_params,  # noqa: F401  (re-export convenience for tests)
 )
@@ -445,24 +446,12 @@ class ContinuousBatcher:
         # guard)
         self._grow_margin = 2 * (self.chunk + max(self.spec_k, 1)) + 2
 
-        # device state (host-held references; donated through each dispatch)
+        # device state (host-held references; donated through each dispatch).
+        # Allocation is a spine work item like every other device phase:
+        # a pool-monitor rebuild constructing a replacement batcher must
+        # not become its own device stream (engines/spine.py).
         self._alloc = BlockAllocator(self.n_blocks, self.block_size)
-        self._pools = init_paged_pools(
-            self.cfg, self.n_blocks, self.block_size
-        )
-        if self.mesh is not None and self.mesh.n_devices > 1:
-            from docqa_tpu.parallel.sharding import shard_paged_pools
-
-            self._pools = shard_paged_pools(self._pools, self.cfg, self.mesh)
-        self._tok = jnp.zeros((self.n_slots,), jnp.int32)
-        self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
-        self._active = jnp.zeros((self.n_slots,), bool)
-        # per-slot bigram tables (speculation only): table[slot, prev]=next
-        self._table = (
-            jnp.full((self.n_slots, self.cfg.vocab_size), -1, jnp.int32)
-            if self.spec_k
-            else None
-        )
+        spine_run("serve_alloc", self._init_device_state_on_lane)
 
         # host-side slot bookkeeping
         self._slot_req: List[Optional[_Request]] = [None] * self.n_slots
@@ -487,6 +476,11 @@ class ContinuousBatcher:
         self._tables_dev = None
         self._caps_dev = None
         self._tables_dirty = True
+        # slots retired on host whose device-side `active` lane has not
+        # been cleared yet: applied FIRST inside the next device work
+        # item (prefill or decode), so the worker never touches device
+        # state outside a spine lane.  Worker-thread state.
+        self._deact_pending: List[int] = []
         # id() of the queue head last marked block-starved: one trace
         # event + one serve_block_pool_wait count per starvation
         # episode, not per worker poll (guarded by _cv like the queue)
@@ -799,6 +793,21 @@ class ContinuousBatcher:
         active = jnp.zeros((self.n_slots,), bool)
         return pools, table, tok, lengths, active
 
+    def _init_device_state_on_lane(self):
+        """Fresh pools + zeroed slot state ASSIGNED to self — the ONE
+        initialization shared by construction (``serve_alloc``) and the
+        failed-dispatch reset (``serve_reset``), both spine work items.
+        Returns the new device arrays so strict mode can sync them (a
+        None-returning closure would leave the allocation programs in
+        flight after the lane freed)."""
+        pools, table, tok, lengths, active = self._fresh_device_state()
+        self._pools = pools
+        self._table = table
+        self._tok = tok
+        self._lengths = lengths
+        self._active = active
+        return pools, table, tok, lengths, active
+
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
         """Compile the whole admission-path shape set ahead of traffic.
 
@@ -825,7 +834,12 @@ class ContinuousBatcher:
         fn = self._get_prefill_fn()
         S = self.n_slots
         oob_row = self.n_blocks * self.block_size
-        for T in warm:
+
+        # each warm compile is one BACKGROUND spine item: a warmup can
+        # never again become the third concurrent client stream (the
+        # serve_cluster_loop --warm-thread deadlock), and it can occupy
+        # at most n_lanes-1 lanes while live traffic keeps the rest
+        def _warm_prefill_on_lane(T: int):
             pools, table, _tok, _lengths, _active = (
                 self._fresh_device_state()
             )
@@ -836,34 +850,127 @@ class ContinuousBatcher:
             last_rows = jnp.zeros((S,), jnp.int32)
             slots = jnp.full((S,), S, jnp.int32)  # OOB == dropped
             if self.spec_k:
-                fn(
+                out = fn(
                     self.engine.params, pools, table, ids, seg, pos,
                     dest, last_rows, slots, self._next_rng(),
                 )
             else:
-                fn(
+                out = fn(
                     self.engine.params, pools, ids, seg, pos, dest,
                     last_rows, slots, self._next_rng(),
                 )
+            return out
+
+        for T in warm:
+            spine_run(
+                "serve_warmup", _warm_prefill_on_lane, T,
+                stream="warmup", sync=True,
+            )
+
         # decode chunk: one shape regardless of prompt mix — all-inactive
         # lanes still trace/compile the full program (all-sentinel tables)
         dfn = self._get_decode_fn()
-        pools, table, tok, lengths, active = self._fresh_device_state()
-        tables = jnp.full(
-            (S, self.blocks_per_seq), self.n_blocks, jnp.int32
-        )
-        caps = jnp.zeros((S,), jnp.int32)
-        if self.spec_k:
-            dfn(self.engine.params, pools, tables, caps, table, tok,
-                lengths, active)
-        else:
-            dfn(
-                self.engine.params, pools, tables, caps, tok, lengths,
-                active, self._next_rng(),
+
+        def _warm_decode_on_lane():
+            pools, table, tok, lengths, active = self._fresh_device_state()
+            tables = jnp.full(
+                (S, self.blocks_per_seq), self.n_blocks, jnp.int32
             )
+            caps = jnp.zeros((S,), jnp.int32)
+            if self.spec_k:
+                out = dfn(self.engine.params, pools, tables, caps, table,
+                          tok, lengths, active)
+            else:
+                out = dfn(
+                    self.engine.params, pools, tables, caps, tok, lengths,
+                    active, self._next_rng(),
+                )
+            return out
+
+        spine_run(
+            "serve_warmup", _warm_decode_on_lane, stream="warmup", sync=True,
+        )
         # warmed shapes cover the admission path: worker iterations are
         # now bounded by real chunk rounds, so liveness checks may engage
         self._cold = False
+
+    def annotate_costs(self) -> bool:
+        """Register the prefill/decode programs' ``cost_analysis()``
+        FLOPs/bytes with the observatory (``obs/observatory.py``), so
+        the spine's measured device time yields per-stage MFU instead
+        of wall-clock guesses.
+
+        Costs key the stages that MEASURE device time at the one-fetch
+        boundary: each prefill token budget T under
+        ``("serve_prefill_fetch", T)`` and the decode chunk under
+        ``("serve_decode_chunk", "decode")``.  Pure host tracing
+        (``lower()`` on abstract shapes — no allocation, no compile),
+        still routed as a background probe item so no caller thread
+        grows a client stream.  Returns False when the backend offers
+        no estimate; never raises."""
+        from docqa_tpu.obs.observatory import DEFAULT_OBSERVATORY
+
+        S = self.n_slots
+
+        def _annotate_on_lane() -> bool:
+            try:
+                pools_s = jax.eval_shape(
+                    lambda: init_paged_pools(
+                        self.cfg, self.n_blocks, self.block_size
+                    )
+                )
+                params_s = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    self.engine.params,
+                )
+                i32 = jnp.int32
+                rng_s = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                table_s = jax.ShapeDtypeStruct(
+                    (S, self.cfg.vocab_size), i32
+                )
+                ok = False
+                fn = self._get_prefill_fn()
+                for T in self._token_buckets:
+                    args = (
+                        jax.ShapeDtypeStruct((T,), i32),  # ids
+                        jax.ShapeDtypeStruct((T,), i32),  # seg
+                        jax.ShapeDtypeStruct((T,), i32),  # pos
+                        jax.ShapeDtypeStruct((T,), i32),  # dest
+                        jax.ShapeDtypeStruct((S,), i32),  # last_rows
+                        jax.ShapeDtypeStruct((S,), i32),  # slots
+                        rng_s,
+                    )
+                    if self.spec_k:
+                        low = fn.lower(params_s, pools_s, table_s, *args)
+                    else:
+                        low = fn.lower(params_s, pools_s, *args)
+                    ok = DEFAULT_OBSERVATORY.annotate_lowered(
+                        "serve_prefill_fetch", low, key=T
+                    ) or ok
+                dfn = self._get_decode_fn()
+                tables_s = jax.ShapeDtypeStruct(
+                    (S, self.blocks_per_seq), i32
+                )
+                caps_s = jax.ShapeDtypeStruct((S,), i32)
+                tok_s = jax.ShapeDtypeStruct((S,), i32)
+                len_s = jax.ShapeDtypeStruct((S,), i32)
+                act_s = jax.ShapeDtypeStruct((S,), jnp.bool_)
+                if self.spec_k:
+                    low = dfn.lower(params_s, pools_s, tables_s, caps_s,
+                                    table_s, tok_s, len_s, act_s)
+                else:
+                    low = dfn.lower(params_s, pools_s, tables_s, caps_s,
+                                    tok_s, len_s, act_s, rng_s)
+                ok = DEFAULT_OBSERVATORY.annotate_lowered(
+                    "serve_decode_chunk", low, key="decode"
+                ) or ok
+                return ok
+            except Exception:
+                log.exception("cost annotation failed (MFU stays unknown)")
+                return False
+
+        return bool(spine_run("serve_costs", _annotate_on_lane,
+                              stream="probe"))
 
     def _pick_token_bucket(self, n_tokens: int) -> int:
         """Smallest packed token budget covering ``n_tokens`` (the
@@ -1299,7 +1406,7 @@ class ContinuousBatcher:
                 self._admitting = len(self._admitting_reqs)
                 self._cv.notify_all()
         if not good:
-            return [], None
+            return [], None, []
 
         # Register slot state BEFORE the dispatch: if the dispatch dies,
         # _fail_active sweeps these slots and releases their fresh block
@@ -1337,35 +1444,62 @@ class ContinuousBatcher:
         fn = self._get_prefill_fn()
         S = self.n_slots
         oob_row = self.n_blocks * self.block_size
-        toks_parts = []
-        t_prefill0 = _now()
-        with span("serve_prefill", DEFAULT_REGISTRY):
-            for group in groups:
-                total = sum(
-                    round_up(len(ids), RAGGED_ALIGN) for _, _, ids, _ in group
+        # host marshal: one packed numpy input set per dispatch group —
+        # everything that touches the device happens inside the spine
+        # work item below
+        group_inputs = []
+        for group in groups:
+            total = sum(
+                round_up(len(ids), RAGGED_ALIGN) for _, _, ids, _ in group
+            )
+            T = self._pick_token_bucket(total)
+            ids_flat = np.full((T,), self.gen.pad_id, np.int32)
+            seg = np.full((T,), -1, np.int32)
+            pos = np.zeros((T,), np.int32)
+            dest = np.full((T,), oob_row, np.int32)
+            last_rows = np.zeros((S,), np.int32)
+            slots_arr = np.full((S,), S, np.int32)  # OOB == dropped
+            off = 0
+            for lane, (slot, _req, ids, table) in enumerate(group):
+                n = len(ids)
+                ids_flat[off: off + n] = ids
+                seg[off: off + n] = lane
+                p = np.arange(n, dtype=np.int32)
+                pos[off: off + n] = p
+                blocks = np.asarray(table.blocks, np.int64)
+                dest[off: off + n] = (
+                    blocks[p // self.block_size] * self.block_size
+                    + p % self.block_size
                 )
-                T = self._pick_token_bucket(total)
-                ids_flat = np.full((T,), self.gen.pad_id, np.int32)
-                seg = np.full((T,), -1, np.int32)
-                pos = np.zeros((T,), np.int32)
-                dest = np.full((T,), oob_row, np.int32)
-                last_rows = np.zeros((S,), np.int32)
-                slots_arr = np.full((S,), S, np.int32)  # OOB == dropped
-                off = 0
-                for lane, (slot, _req, ids, table) in enumerate(group):
-                    n = len(ids)
-                    ids_flat[off: off + n] = ids
-                    seg[off: off + n] = lane
-                    p = np.arange(n, dtype=np.int32)
-                    pos[off: off + n] = p
-                    blocks = np.asarray(table.blocks, np.int64)
-                    dest[off: off + n] = (
-                        blocks[p // self.block_size] * self.block_size
-                        + p % self.block_size
-                    )
-                    last_rows[lane] = off + n - 1
-                    slots_arr[lane] = slot
-                    off += round_up(n, RAGGED_ALIGN)
+                last_rows[lane] = off + n - 1
+                slots_arr[lane] = slot
+                off += round_up(n, RAGGED_ALIGN)
+            group_inputs.append(
+                (T, ids_flat, seg, pos, dest, last_rows, slots_arr,
+                 len(group))
+            )
+        G = len(good)
+        slots_np = np.empty((G,), np.int32)
+        lens_np = np.empty((G,), np.int32)
+        budget_ok = np.empty((G,), bool)
+        for i, (slot, req, ids, _table) in enumerate(good):
+            slots_np[i] = slot
+            lens_np[i] = len(ids)
+            budget_ok[i] = self._slot_budget[slot] >= 2
+
+        def _prefill_on_lane():
+            """Device phase of the round (spine work item): pending lane
+            deactivations, one packed dispatch per group, then the slot
+            -state scatter.  Slot state updates ride the device (the
+            sampled first tokens are already there) — alive = (first !=
+            eos) & (budget >= 2) needs no host fetch, so the decode
+            chunk that follows this admission can dispatch immediately;
+            the host-side fetch of first tokens (_finalize_admissions)
+            then overlaps that chunk's execution."""
+            self._apply_deact_on_lane()
+            parts = []
+            for (T, ids_flat, seg, pos, dest, last_rows, slots_arr,
+                 n_lanes) in group_inputs:
                 args = (
                     jnp.asarray(ids_flat),
                     jnp.asarray(seg),
@@ -1383,7 +1517,21 @@ class ContinuousBatcher:
                     self._pools, toks = fn(
                         self.engine.params, self._pools, *args
                     )
-                toks_parts.append(toks[: len(group)])
+                parts.append(toks[:n_lanes])
+            first = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            idx = jnp.asarray(slots_np)
+            alive = (first != self.gen.eos_id) & jnp.asarray(budget_ok)
+            self._tok = self._tok.at[idx].set(first)
+            self._lengths = self._lengths.at[idx].set(jnp.asarray(lens_np))
+            self._active = self._active.at[idx].set(alive)
+            # the scatters are DOWNSTREAM of `first` — returned alongside
+            # it so strict mode's block_until_ready covers every program
+            # this item issued, not just the first-token chain
+            return first, self._tok, self._lengths, self._active
+
+        t_prefill0 = _now()
+        with span("serve_prefill", DEFAULT_REGISTRY):
+            first_toks = spine_run("serve_prefill", _prefill_on_lane)[0]
         t_prefill1 = _now()
         for gi, group in enumerate(groups):
             for slot, req, ids, table in group:
@@ -1392,31 +1540,10 @@ class ContinuousBatcher:
                     batch=len(good), dispatch=gi, slot=slot,
                     prompt_tokens=len(ids), blocks=len(table.blocks),
                 )
-        # Slot state updates ride the device (the sampled first tokens are
-        # already there) — alive = (first != eos) & (budget >= 2) needs no
-        # host fetch, so the decode chunk that follows this admission can
-        # dispatch immediately; the host-side fetch of first tokens
-        # (_finalize_admissions) then overlaps that chunk's execution.
-        G = len(good)
-        slots_np = np.empty((G,), np.int32)
-        lens_np = np.empty((G,), np.int32)
-        budget_ok = np.empty((G,), bool)
-        for i, (slot, req, ids, _table) in enumerate(good):
-            slots_np[i] = slot
-            lens_np[i] = len(ids)
-            budget_ok[i] = self._slot_budget[slot] >= 2
-        idx = jnp.asarray(slots_np)
-        first_toks = (
-            toks_parts[0]
-            if len(toks_parts) == 1
-            else jnp.concatenate(toks_parts)
-        )
-        alive_dev = (first_toks != self.gen.eos_id) & jnp.asarray(budget_ok)
-        self._tok = self._tok.at[idx].set(first_toks)
-        self._lengths = self._lengths.at[idx].set(jnp.asarray(lens_np))
-        self._active = self._active.at[idx].set(alive_dev)
         meta = [(slot, req, len(ids)) for slot, req, ids, _t in good]
-        return meta, first_toks
+        # the groups' token budgets ride along as the admission fetch's
+        # cost keys (observatory MFU accounting)
+        return meta, first_toks, [g[0] for g in group_inputs]
 
     def _finalize_admissions(self, admitted) -> bool:
         """Host-side bookkeeping for an admission round: ONE device fetch
@@ -1433,9 +1560,16 @@ class ContinuousBatcher:
 
         Returns False when the fetch itself failed (prefill died on
         device) — the caller must treat the whole pipeline as poisoned."""
-        meta, round_toks = admitted
+        meta, round_toks, cost_keys = admitted
         try:
-            firsts = np.asarray(round_toks)[: len(meta)]
+            # ONE device fetch, on a spine lane: its duration is the
+            # round's device time at the one-fetch boundary, and the
+            # group token budgets are the cost keys MFU accrues under
+            firsts = spine_run(
+                "serve_prefill_fetch",
+                lambda: np.asarray(round_toks),
+                cost_key=cost_keys,
+            )[: len(meta)]
         except Exception as e:
             log.exception("admission fetch failed; resetting")
             self._fail_active(e)
@@ -1453,6 +1587,16 @@ class ContinuousBatcher:
                 if len(req.tokens) >= budget:
                     self._retire(slot)
         return True
+
+    def _apply_deact_on_lane(self) -> None:
+        """Clear device-side ``active`` lanes for host-retired slots.
+        Called FIRST inside every device work item (prefill / decode
+        closures) — the worker thread only QUEUES deactivations
+        (``_deact_pending``); it never touches device state itself."""
+        if self._deact_pending:
+            idx = jnp.asarray(self._deact_pending, jnp.int32)
+            self._active = self._active.at[idx].set(False)
+            self._deact_pending = []
 
     def _release_slot_blocks(self, slot: int) -> None:
         """Return a slot's KV blocks to the pool (idempotent via the
@@ -1483,20 +1627,10 @@ class ContinuousBatcher:
             # allocates the replacement replica's (and would undo the
             # pool's device-state scrub of this shell)
             return
-        self._pools = init_paged_pools(
-            self.cfg, self.n_blocks, self.block_size
-        )
-        if self.mesh is not None and self.mesh.n_devices > 1:
-            from docqa_tpu.parallel.sharding import shard_paged_pools
-
-            self._pools = shard_paged_pools(self._pools, self.cfg, self.mesh)
-        self._tok = jnp.zeros((self.n_slots,), jnp.int32)
-        self._lengths = jnp.zeros((self.n_slots,), jnp.int32)
-        self._active = jnp.zeros((self.n_slots,), bool)
-        if self.spec_k:
-            self._table = jnp.full(
-                (self.n_slots, self.cfg.vocab_size), -1, jnp.int32
-            )
+        # the poisoned lanes are gone with the reset — nothing pending
+        # to deactivate on fresh all-inactive state
+        self._deact_pending = []
+        spine_run("serve_reset", self._init_device_state_on_lane)
         DEFAULT_REGISTRY.counter("serve_decode_failures").inc()
 
     def _retire(self, slot: int) -> None:
@@ -1537,9 +1671,16 @@ class ContinuousBatcher:
             # the span blocks until the chunk's device execution completes,
             # so serve_decode_chunk_ms keeps measuring real chunk rounds
             # (minus whatever host work the pipeline already overlapped) —
-            # the dispatch itself is an async enqueue and times ~0
+            # the dispatch itself is an async enqueue and times ~0.  The
+            # ONE fetch per chunk runs as a spine work item: its measured
+            # duration is the chunk's device time at the one-fetch
+            # boundary, accrued under the decode program's cost model.
             with span("serve_decode_chunk", DEFAULT_REGISTRY):
-                packed_h = np.asarray(packed_dev)  # ONE fetch per chunk
+                packed_h = spine_run(
+                    "serve_decode_chunk",
+                    lambda: np.asarray(packed_dev),
+                    cost_key="decode",
+                )
         except Exception as e:
             # the cache was donated into a failed dispatch — fail every
             # in-flight request, reset device state, and keep serving
@@ -1635,8 +1776,9 @@ class ContinuousBatcher:
             float(n_appended)
         )
         if deactivate:
-            idx = jnp.asarray(deactivate, jnp.int32)
-            self._active = self._active.at[idx].set(False)
+            # queued for the next device work item (_apply_deact_on_lane)
+            # — the worker never issues device ops from its own thread
+            self._deact_pending.extend(deactivate)
         return True
 
     def _blocks_for_admission(self, req: "_Request") -> int:
@@ -1929,55 +2071,66 @@ class ContinuousBatcher:
                     self._retire(slot)
                     shed_slots.append(slot)
             if shed_slots:
-                idx = jnp.asarray(shed_slots, jnp.int32)
-                self._active = self._active.at[idx].set(False)
+                # queued for the decode closure below (the worker never
+                # issues device ops from its own thread)
+                self._deact_pending.extend(shed_slots)
                 if not any(self._slot_req):
                     continue
             # one decode chunk for every live slot, dispatched BEFORE the
             # previous chunk's results are fetched — fetch + host work
             # below overlap this chunk's device execution
             fn = self._get_decode_fn()
-            if self._tables_dirty:
-                self._tables_dev = jnp.asarray(self._block_rows)
-                self._caps_dev = jnp.asarray(self._caps_np)
-                self._tables_dirty = False
+
+            def _decode_on_lane():
+                """Device phase (spine work item): pending deactivations,
+                dirty block-table upload, then the one chunk dispatch —
+                an async enqueue chained on the previous chunk's device
+                state, so the pipeline overlap is unchanged."""
+                self._apply_deact_on_lane()
+                if self._tables_dirty:
+                    self._tables_dev = jnp.asarray(self._block_rows)
+                    self._caps_dev = jnp.asarray(self._caps_np)
+                    self._tables_dirty = False
+                if self.spec_k:
+                    (
+                        self._pools,
+                        self._table,
+                        self._tok,
+                        self._lengths,
+                        self._active,
+                        out,
+                    ) = fn(
+                        self.engine.params,
+                        self._pools,
+                        self._tables_dev,
+                        self._caps_dev,
+                        self._table,
+                        self._tok,
+                        self._lengths,
+                        self._active,
+                    )
+                else:
+                    (
+                        self._pools,
+                        self._tok,
+                        self._lengths,
+                        self._active,
+                        out,
+                    ) = fn(
+                        self.engine.params,
+                        self._pools,
+                        self._tables_dev,
+                        self._caps_dev,
+                        self._tok,
+                        self._lengths,
+                        self._active,
+                        self._next_rng(),
+                    )
+                return out
+
             try:
                 with span("serve_decode_dispatch", DEFAULT_REGISTRY):
-                    if self.spec_k:
-                        (
-                            self._pools,
-                            self._table,
-                            self._tok,
-                            self._lengths,
-                            self._active,
-                            packed,
-                        ) = fn(
-                            self.engine.params,
-                            self._pools,
-                            self._tables_dev,
-                            self._caps_dev,
-                            self._table,
-                            self._tok,
-                            self._lengths,
-                            self._active,
-                        )
-                    else:
-                        (
-                            self._pools,
-                            self._tok,
-                            self._lengths,
-                            self._active,
-                            packed,
-                        ) = fn(
-                            self.engine.params,
-                            self._pools,
-                            self._tables_dev,
-                            self._caps_dev,
-                            self._tok,
-                            self._lengths,
-                            self._active,
-                            self._next_rng(),
-                        )
+                    packed = spine_run("serve_decode", _decode_on_lane)
             except Exception as e:
                 log.exception("decode dispatch failed; resetting slot state")
                 self._fail_active(e)

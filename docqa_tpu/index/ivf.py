@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 
 log = get_logger("docqa.ivf")
@@ -112,30 +113,47 @@ def kmeans(
     fit_on = vectors
     if sample is not None and n > sample:
         fit_on = vectors[rng.choice(n, sample, replace=False)]
-    # greedy k-center seeding on a bounded subsample (cluster coverage),
-    # random fallback only when the corpus is smaller than the seed count
-    if len(fit_on) > n_clusters:
-        seed_pool = fit_on
-        if len(seed_pool) > 65536:
-            seed_pool = seed_pool[rng.choice(len(seed_pool), 65536, replace=False)]
-        init = np.asarray(_kcenter_init(jnp.asarray(seed_pool), n_clusters))
-    else:
-        init = fit_on[
-            rng.choice(len(fit_on), n_clusters, replace=n_clusters > len(fit_on))
-        ]
-    centroids, _ = _kmeans_fit(
-        jnp.asarray(fit_on), jnp.asarray(init), n_iters, n_clusters
-    )
-    # final assignment over the full corpus, blocked to bound device memory
     n_assign = min(n_assign, n_clusters)
-    assigns = []
-    block = 1 << 18
-    cT = centroids.T
-    for start in range(0, n, block):
-        scores = jnp.asarray(vectors[start : start + block]) @ cT
-        _, top = jax.lax.top_k(scores, n_assign)
-        assigns.append(np.asarray(top))
-    return np.asarray(centroids), np.concatenate(assigns).astype(np.int32)
+
+    def _fit_on_lane():
+        """Device phase (background spine work item): seeding, the
+        kmeans fit, and the blocked full-corpus assignment — a
+        background IVF rebuild queues for a lane instead of becoming
+        another concurrent client stream."""
+        # greedy k-center seeding on a bounded subsample (cluster
+        # coverage), random fallback only when the corpus is smaller
+        # than the seed count
+        if len(fit_on) > n_clusters:
+            seed_pool = fit_on
+            if len(seed_pool) > 65536:
+                seed_pool = seed_pool[
+                    rng.choice(len(seed_pool), 65536, replace=False)
+                ]
+            init = np.asarray(_kcenter_init(jnp.asarray(seed_pool), n_clusters))
+        else:
+            init = fit_on[
+                rng.choice(
+                    len(fit_on), n_clusters, replace=n_clusters > len(fit_on)
+                )
+            ]
+        centroids, _ = _kmeans_fit(
+            jnp.asarray(fit_on), jnp.asarray(init), n_iters, n_clusters
+        )
+        # final assignment over the full corpus, blocked to bound device
+        # memory
+        assigns = []
+        block = 1 << 18
+        cT = centroids.T
+        for start in range(0, n, block):
+            scores = jnp.asarray(vectors[start : start + block]) @ cT
+            _, top = jax.lax.top_k(scores, n_assign)
+            assigns.append(np.asarray(top))
+        return np.asarray(centroids), assigns
+
+    centroids_h, assigns = spine_run(
+        "ivf_build", _fit_on_lane, stream="rebuild"
+    )
+    return centroids_h, np.concatenate(assigns).astype(np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -302,11 +320,19 @@ class IVFIndex:
                 spill_ids[j] = i
             self.cap = cap
             self.n_spilled = len(spill_rows)
-            self._cells = jnp.asarray(cells, self._dtype)
-            self._cell_ids = jnp.asarray(cell_ids)
-            self._centroids = jnp.asarray(centroids, self._dtype)
-            self._spill = jnp.asarray(spill, self._dtype)
-            self._spill_ids = jnp.asarray(spill_ids)
+
+            def _upload_on_lane():
+                # returns the uploaded arrays: strict mode must sync
+                # every transfer before the lane frees
+                self._cells = jnp.asarray(cells, self._dtype)
+                self._cell_ids = jnp.asarray(cell_ids)
+                self._centroids = jnp.asarray(centroids, self._dtype)
+                self._spill = jnp.asarray(spill, self._dtype)
+                self._spill_ids = jnp.asarray(spill_ids)
+                return (self._cells, self._cell_ids, self._centroids,
+                        self._spill, self._spill_ids)
+
+            spine_run("ivf_build", _upload_on_lane, stream="rebuild")
         self._fns: Dict[Tuple[int, int, int], Any] = {}
         log.info(
             "ivf built: n=%d C=%d cap=%d spill=%d nprobe=%d",
@@ -349,8 +375,9 @@ class IVFIndex:
         pool = nprobe * self.cap + int(self._spill_ids.shape[0])
         fetch = min(k_eff * (self.n_assign + 1), pool)
         fn = self._get_fn(len(qn), fetch, nprobe)
-        with span("ivf_search", DEFAULT_REGISTRY):
-            vals, ids = fn(
+
+        def _probe_on_lane():
+            v, i = fn(
                 self._cells,
                 self._cell_ids,
                 self._centroids,
@@ -358,8 +385,10 @@ class IVFIndex:
                 self._spill_ids,
                 jnp.asarray(qn, self._dtype),
             )
-        vals = np.asarray(vals, np.float32)
-        ids = np.asarray(ids)
+            return np.asarray(v, np.float32), np.asarray(i)
+
+        with span("ivf_search", DEFAULT_REGISTRY):
+            vals, ids = spine_run("ivf_search", _probe_on_lane)
         out = []
         for qi in range(len(qn)):
             row = []

@@ -41,6 +41,7 @@ from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.config import StoreConfig
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.ops.topk import sharded_topk
 from docqa_tpu.runtime import native
 from docqa_tpu.runtime.mesh import MeshContext
@@ -336,16 +337,28 @@ class VectorStore:
             # lands beyond count (zeros over zeros) and capacity is grown to
             # keep the padded write in bounds
             n_pad = round_up(n, 64)
-            self._grow_to(start + n_pad)
-            rows = np.zeros((n_pad, self.cfg.dim), np.float32)
-            rows[:n] = vectors
-            self._dev = self._append_jit(
-                self._dev, jnp.asarray(rows, self._dtype), start
-            )
-            if self.cfg.token_width:
-                self._append_tokens_locked(
-                    start, n, n_pad, token_rows, token_lens
+
+            def _append_on_lane():
+                """Device phase (spine work item; submitter holds the
+                store lock while blocked — the closure acquires
+                nothing): capacity growth, the donated buffer append,
+                and the token-sidecar append.  Returns the written
+                device arrays so strict mode syncs every program this
+                item issued before the lane frees."""
+                self._grow_to(start + n_pad)
+                rows = np.zeros((n_pad, self.cfg.dim), np.float32)
+                rows[:n] = vectors
+                self._dev = self._append_jit(
+                    self._dev, jnp.asarray(rows, self._dtype), start
                 )
+                if self.cfg.token_width:
+                    self._append_tokens_locked(
+                        start, n, n_pad, token_rows, token_lens
+                    )
+                    return self._dev, self._tok_dev, self._tok_len_dev
+                return self._dev
+
+            spine_run("store_add", _append_on_lane)
             self._meta.extend(dict(m) for m in metadata)
             self._append_columns(metadata)
             self._count = start + n
@@ -561,11 +574,17 @@ class VectorStore:
             # fresh device buffer from the compacted host copy
             n_pad = round_up(max(self._count, 1), 64)
             self._capacity = self._round_capacity(max(n_pad, 128))
-            buf = np.zeros((self._capacity, self.cfg.dim), np.float32)
-            buf[: self._count] = self._host[: self._count]
-            self._dev = self._place_rows(jnp.asarray(buf, self._dtype))
-            if self.cfg.token_width:
-                self._upload_tok_locked()
+
+            def _reupload_on_lane():
+                buf = np.zeros((self._capacity, self.cfg.dim), np.float32)
+                buf[: self._count] = self._host[: self._count]
+                self._dev = self._place_rows(jnp.asarray(buf, self._dtype))
+                if self.cfg.token_width:
+                    self._upload_tok_locked()
+                    return self._dev, self._tok_dev, self._tok_len_dev
+                return self._dev
+
+            spine_run("store_add", _reupload_on_lane)
             if self._count == 0:  # keep a 1-row pad so slicing stays valid
                 self._host = np.zeros((1, self.cfg.dim), np.float32)
             self._version += 1
@@ -630,14 +649,30 @@ class VectorStore:
                     host[i] = bool(where(self._meta[i]))
                 mask = host if mask is None else (mask & host)
             mask = self._compose_live_locked(mask, already_live=bool(filters))
-            fn = self._get_search_fn(len(qn), k_eff, masked=mask is not None)
-            args = [self._dev, jnp.asarray(qn, self._dtype), jnp.int32(count)]
-            if mask is not None:
-                args.append(jnp.asarray(mask))
+
+            def _search_on_lane():
+                """Dispatch phase (spine work item; submitter holds the
+                lock while blocked): program build, query upload, and
+                the async enqueue against the current buffer."""
+                fn = self._get_search_fn(
+                    len(qn), k_eff, masked=mask is not None
+                )
+                args = [
+                    self._dev, jnp.asarray(qn, self._dtype), jnp.int32(count)
+                ]
+                if mask is not None:
+                    args.append(jnp.asarray(mask))
+                return fn(*args)
+
             with span("store_search", DEFAULT_REGISTRY):
-                vals, ids = fn(*args)
-        vals = np.asarray(vals)
-        ids = np.asarray(ids)
+                vals_dev, ids_dev = spine_run("store_search", _search_on_lane)
+        # the fetch runs OUTSIDE the lock (the enqueued computation holds
+        # its own buffer reference) but still on a spine lane: blocking
+        # on the device result is device time, and bounded like any other
+        vals, ids = spine_run(
+            "store_search_fetch",
+            lambda: (np.asarray(vals_dev), np.asarray(ids_dev)),
+        )
         return self.assemble_results(vals, ids)
 
     def assemble_results(

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.index.ivf import IVFIndex
 from docqa_tpu.index.store import NEG_INF, SearchResult, VectorStore
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
@@ -274,14 +275,17 @@ class TieredIndex:
                 # recompiling per append.  The padded bucket size bounds
                 # top_k's k and only changes when the bucket grows.
                 k_tail = min(max(k_bulk, k), int(tail_dev.shape[0]))
-                vals, ids = _tail_kernel(
-                    tail_dev,
-                    jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
-                    jnp.int32(n_live),
-                    k_tail,
-                )
-                vals = np.asarray(vals, np.float32)
-                ids = np.asarray(ids)
+
+                def _tail_on_lane():
+                    v, i = _tail_kernel(
+                        tail_dev,
+                        jnp.asarray(qn, jnp.dtype(self.store.cfg.dtype)),
+                        jnp.int32(n_live),
+                        k_tail,
+                    )
+                    return np.asarray(v, np.float32), np.asarray(i)
+
+                vals, ids = spine_run("tiered_tail", _tail_on_lane)
 
         return self._merge(
             queries, bulk, vals, ids, tail_meta, covered, k
@@ -314,10 +318,14 @@ class TieredIndex:
         bucket = round_up(max(n_live, 1), 4096)  # stable jit shapes
         padded = np.zeros((bucket, self.store.cfg.dim), np.float32)
         padded[:n_live] = vecs
+        tail_dev = spine_run(
+            "tiered_tail",
+            lambda: jnp.asarray(padded, jnp.dtype(self.store.cfg.dtype)),
+        )
         cache = (
             covered,
             covered + n_live,
-            jnp.asarray(padded, jnp.dtype(self.store.cfg.dtype)),
+            tail_dev,
             n_live,
             meta,
         )

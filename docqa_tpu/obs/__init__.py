@@ -55,6 +55,11 @@ from docqa_tpu.obs.expo import (  # noqa: F401
     prometheus_text,
     telemetry_json,
 )
+from docqa_tpu.obs.observatory import (  # noqa: F401
+    DEFAULT_OBSERVATORY,
+    Observatory,
+    detect_peak_flops,
+)
 from docqa_tpu.obs.recorder import (  # noqa: F401
     DEFAULT_RECORDER,
     FlightRecorder,

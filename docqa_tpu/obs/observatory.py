@@ -1,0 +1,223 @@
+"""Device observatory: per-stage FLOP/byte cost models, device-time
+accounting, and MFU/roofline attribution.
+
+The dispatch spine (``engines/spine.py``) measures WHERE device time
+goes; this module says what that time BOUGHT.  Each compiled program is
+annotated once with its ``cost_analysis()`` FLOPs / bytes-accessed
+(``annotate_lowered`` — jax's lowered-stage estimate, no second
+compile), keyed by ``(stage, cost_key)`` where ``cost_key`` is the
+shape key the call site already uses (the prefill token budget T, the
+decode chunk program, a solo generate's ``(batch, bucket)``).  The
+spine then reports every completed item's ``(stage, cost_key,
+device_seconds)`` here, so per-stage aggregates carry *issued FLOPs*
+next to *measured device time* and
+
+    MFU = flops / device_seconds / peak_flops
+
+is an attribution, not a wall-clock guess.  ``peak_flops`` is resolved
+from the real backend when one is attached; CPU smoke runs report
+against the projected v5e peak with ``peak_flops_source:
+"projected-v5e"`` — the same honesty labeling bench already uses for
+HBM (a CPU MFU is a *ratio shape*, not a chip claim).
+
+Stdlib-only like the rest of ``docqa_tpu/obs`` (jax is only touched
+lazily inside ``annotate_lowered``/``detect_peak_flops``), so the spine
+and telemetry can import it without dragging a backend in.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+# bf16 peak of the chip the projected numbers target (v5e: 197 TFLOP/s,
+# 819 GB/s HBM) — the ridge point flops/bytes = peak_flops/peak_bw
+# classifies a program compute- vs memory-bound on the roofline
+_V5E_PEAK_FLOPS = 197e12
+_V5E_PEAK_BYTES_S = 819e9
+
+_PEAK_BY_BACKEND = {
+    # conservative, dense-bf16 numbers; override via DOCQA_PEAK_FLOPS
+    "tpu": (_V5E_PEAK_FLOPS, "tpu-v5e-bf16"),
+    "gpu": (_V5E_PEAK_FLOPS, "projected-v5e"),
+    "cpu": (_V5E_PEAK_FLOPS, "projected-v5e"),
+}
+
+
+def detect_peak_flops() -> Dict[str, Any]:
+    """(peak_flops, peak_bytes_s, source) for MFU math.  Env override
+    ``DOCQA_PEAK_FLOPS`` (absolute FLOP/s) wins; otherwise the attached
+    jax backend picks the row — never raises (obs must not)."""
+    env = os.environ.get("DOCQA_PEAK_FLOPS")
+    if env:
+        try:
+            return {
+                "peak_flops": float(env),
+                "peak_bytes_s": _V5E_PEAK_BYTES_S,
+                "peak_flops_source": "env:DOCQA_PEAK_FLOPS",
+            }
+        except ValueError:
+            pass
+    backend = "cpu"
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        pass
+    peak, source = _PEAK_BY_BACKEND.get(backend, _PEAK_BY_BACKEND["cpu"])
+    return {
+        "peak_flops": peak,
+        "peak_bytes_s": _V5E_PEAK_BYTES_S,
+        "peak_flops_source": source,
+    }
+
+
+def parse_cost_analysis(lowered) -> Optional[Dict[str, float]]:
+    """``{"flops", "bytes_accessed"}`` from a jax ``Lowered``/``Compiled``
+    object's ``cost_analysis()``, or None when the backend offers no
+    usable estimate.  The ONE parser (jax returns a bare dict on newer
+    versions and a one-element list on older ones) — the compile audit
+    and the observatory must never drift on this shape."""
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        if flops <= 0.0:
+            return None
+        return {
+            "flops": flops,
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
+        }
+    except Exception:
+        return None
+
+
+class Observatory:
+    """Cost-model registry + per-stage device-time/FLOP aggregates."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (stage, cost_key) -> {"flops": f, "bytes": b}
+        self._costs: Dict[Any, Dict[str, float]] = {}
+        # stage -> {"calls", "device_s", "flops", "bytes", "uncosted"}
+        self._stages: Dict[str, Dict[str, float]] = {}
+
+    # ---- cost registration ---------------------------------------------------
+
+    def annotate(
+        self,
+        stage: str,
+        flops: float,
+        bytes_accessed: float = 0.0,
+        key: Any = None,
+    ) -> None:
+        with self._lock:
+            self._costs[(stage, key)] = {
+                "flops": float(flops),
+                "bytes": float(bytes_accessed),
+            }
+
+    def annotate_lowered(self, stage: str, lowered, key: Any = None) -> bool:
+        """Extract FLOPs/bytes from a jax ``Lowered``/``Compiled``
+        object's ``cost_analysis()`` and register them.  Fenced: a
+        backend without the estimate returns False, never raises."""
+        cost = parse_cost_analysis(lowered)
+        if cost is None:
+            return False
+        self.annotate(stage, cost["flops"], cost["bytes_accessed"], key=key)
+        return True
+
+    def cost_of(self, stage: str, key: Any = None) -> Optional[Dict[str, float]]:
+        with self._lock:
+            c = self._costs.get((stage, key))
+            return dict(c) if c else None
+
+    # ---- accounting (called by the spine) ------------------------------------
+
+    def record(self, stage: str, cost_key: Any, device_s: float) -> None:
+        """One completed work item.  ``cost_key`` may be a tuple/list of
+        keys (a prefill round fetch covering several dispatch groups):
+        each key's cost accrues to the stage."""
+        keys = (
+            list(cost_key)
+            if isinstance(cost_key, (list, tuple))
+            else [cost_key]
+        )
+        with self._lock:
+            row = self._stages.setdefault(
+                stage,
+                {"calls": 0, "device_s": 0.0, "flops": 0.0, "bytes": 0.0,
+                 "uncosted": 0},
+            )
+            row["calls"] += 1
+            row["device_s"] += max(device_s, 0.0)
+            costed = False
+            for k in keys:
+                c = self._costs.get((stage, k))
+                if c is not None:
+                    row["flops"] += c["flops"]
+                    row["bytes"] += c["bytes"]
+                    costed = True
+            if not costed:
+                row["uncosted"] += 1
+
+    def reset(self) -> None:
+        """Zero the aggregates (bench measurement windows); registered
+        cost models survive — they describe programs, not traffic."""
+        with self._lock:
+            self._stages.clear()
+
+    # ---- attribution ---------------------------------------------------------
+
+    def stats(self, peak: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Per-stage MFU / roofline table.  Stages with no registered
+        cost report device time only (``mfu: None``) — visible gaps
+        beat silently-wrong utilization."""
+        peak = peak or detect_peak_flops()
+        peak_flops = peak["peak_flops"]
+        ridge = peak_flops / max(peak["peak_bytes_s"], 1.0)
+        with self._lock:
+            rows = {k: dict(v) for k, v in self._stages.items()}
+        out: Dict[str, Any] = {"peak": peak, "stages": {}}
+        for stage, row in sorted(rows.items()):
+            dev = row["device_s"]
+            flops = row["flops"]
+            entry: Dict[str, Any] = {
+                "calls": int(row["calls"]),
+                "device_s": round(dev, 6),
+                "flops": flops,
+                "bytes": row["bytes"],
+                "uncosted_calls": int(row["uncosted"]),
+                "mfu": None,
+                "intensity_flops_per_byte": None,
+                "roofline_bound": None,
+            }
+            if flops > 0.0 and dev > 0.0:
+                mfu = flops / dev / peak_flops
+                if mfu > 1.0:
+                    # physically impossible: the stage's measured device
+                    # time under-covers the program's execution (e.g. a
+                    # synchronous-dispatch CPU backend runs the compute
+                    # inside the DISPATCH call, leaving the fetch ~0).
+                    # Report the raw ratio for debugging, never claim it
+                    # as utilization.
+                    entry["mfu"] = None
+                    entry["mfu_raw_invalid"] = round(mfu, 6)
+                else:
+                    entry["mfu"] = round(mfu, 6)
+                if row["bytes"] > 0.0:
+                    intensity = flops / row["bytes"]
+                    entry["intensity_flops_per_byte"] = round(intensity, 3)
+                    entry["roofline_bound"] = (
+                        "compute" if intensity >= ridge else "memory"
+                    )
+            out["stages"][stage] = entry
+        return out
+
+
+DEFAULT_OBSERVATORY = Observatory()

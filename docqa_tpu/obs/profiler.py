@@ -57,6 +57,11 @@ DEVICE_STAGES = frozenset(
 
 
 def stage_kind(name: str) -> str:
+    # "dispatch:<stage>" spans are recorded by the dispatch spine
+    # (engines/spine.py) around device work items — device by
+    # construction, whatever the stage is called
+    if name.startswith("dispatch:"):
+        return "device"
     return "device" if name in DEVICE_STAGES else "host"
 
 

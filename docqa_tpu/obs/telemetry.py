@@ -513,6 +513,7 @@ class TelemetrySampler:
         recorder=None,  # obs.recorder.FlightRecorder
         engine=None,  # GenerateEngine (HBM + jit cache probes)
         slo_evaluator=None,  # obs.slo.BurnRateEvaluator
+        spine=None,  # engines.spine.DispatchSpine (duck-typed)
         sample_every_s: float = 2.0,
         hbm_refresh_s: float = 600.0,
         extra_probes: Sequence[Callable[[], Dict[str, float]]] = (),
@@ -525,6 +526,7 @@ class TelemetrySampler:
         self.recorder = recorder
         self.engine = engine
         self.slo_evaluator = slo_evaluator
+        self.spine = spine
         self.sample_every_s = float(sample_every_s)
         self.hbm_refresh_s = float(hbm_refresh_s)
         self.extra_probes = list(extra_probes)
@@ -608,6 +610,8 @@ class TelemetrySampler:
             self._fenced("recorder", lambda: self._scrape_recorder(now))
         if self.engine is not None:
             self._fenced("engine", lambda: self._scrape_engine(now))
+        if self.spine is not None:
+            self._fenced("spine", lambda: self._scrape_spine(now))
         for probe in self.extra_probes:
             self._fenced(
                 getattr(probe, "__name__", "extra"),
@@ -744,6 +748,18 @@ class TelemetrySampler:
         if self._hbm_bytes:
             for k, v in self._hbm_bytes.items():
                 self.store.record_gauge(f"hbm_decode_{k}", v, now=now)
+
+    def _scrape_spine(self, now: Optional[float]) -> None:
+        """Dispatch-spine series (``dispatch_*``; engines/spine.py):
+        live gauges — queue depth, lane occupancy (the runtime value of
+        the concurrency bound the stream ledger used to gate
+        statically) — plus cumulative per-stage device/queue-wait time
+        as counters, so ``/api/telemetry`` serves per-window device-time
+        deltas per stage."""
+        for name, value in self.spine.telemetry_gauges().items():
+            self.store.record_gauge(name, float(value), now=now)
+        for name, value in self.spine.telemetry_counters().items():
+            self.store.record_counter(name, float(value), now=now)
 
     def _scrape_extra(self, probe, now: Optional[float]) -> None:
         for name, value in (probe() or {}).items():

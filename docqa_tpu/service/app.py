@@ -106,6 +106,17 @@ class DocQARuntime:
                 self._fault_plan.seed,
             )
         multihost_init()
+        # dispatch spine FIRST: every component below routes its device
+        # work through it (engines/spine.py), so the lane count must be
+        # configured before the first lane spins up
+        from docqa_tpu.engines import spine as _spine
+
+        self.spine = _spine.configure(
+            n_lanes=self.cfg.dispatch.n_lanes,
+            max_depth=self.cfg.dispatch.max_depth,
+            inline=self.cfg.dispatch.inline,
+            strict_sync=self.cfg.dispatch.strict_sync,
+        )
         self.mesh = make_mesh(self.cfg.mesh) if jax.device_count() > 1 else None
 
         if self.cfg.flags.use_fake_encoder:
@@ -525,6 +536,9 @@ class DocQARuntime:
                 # the probe would measure
                 engine=self.generator if self.batcher is not None else None,
                 slo_evaluator=self.slo,
+                # dispatch_* series: spine queue depth / lane occupancy
+                # gauges + per-stage device-time counters
+                spine=self.spine,
                 sample_every_s=tcfg.sample_every_s,
                 hbm_refresh_s=tcfg.hbm_refresh_s,
             )
@@ -574,6 +588,13 @@ class DocQARuntime:
             self.batcher.submit_ids(
                 [1, 2, 3], max_new_tokens=2
             ).result(timeout=600)
+            # register the warmed programs' cost_analysis() FLOPs with
+            # the observatory (background probe items): /api/status and
+            # bench then report per-stage MFU instead of wall guesses
+            if self.cfg.dispatch.annotate_costs and hasattr(
+                self.batcher, "annotate_costs"
+            ):
+                self.batcher.annotate_costs()
             log.info(
                 "decode programs warm (ragged token budgets, "
                 "warm depth %s)", depth,
@@ -777,6 +798,15 @@ def make_app(rt: DocQARuntime):
                 # is WHY /api/traces?anomalous=1 just grew — the
                 # evaluator flags the firing window's timelines
                 "slo": rt.slo.status() if rt.slo is not None else None,
+                # device observatory (engines/spine.py + obs/
+                # observatory.py): spine queue/occupancy + per-stage
+                # device time with MFU/roofline where a cost model is
+                # registered — "where did device time go and what did
+                # it buy", not wall-clock guesses
+                "dispatch": {
+                    "spine": rt.spine.stats(),
+                    "observatory": obs.DEFAULT_OBSERVATORY.stats(),
+                },
             }
         )
 

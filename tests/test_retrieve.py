@@ -154,13 +154,16 @@ class TestDispatchDiscipline:
         assert [c for c, _ in calls] == ["snap", "run", "snap", "run"]
         assert calls[-1][1] is False  # second attempt ran WITHOUT the lock
 
-        # two consecutive races: the third attempt runs under the lock
+        # two consecutive races: the third attempt snapshots AND
+        # dispatches while the SUBMITTER holds the lock (adds excluded);
+        # the fn itself runs on a spine lane, which owns no app locks
         calls = []
         assert dispatch_with_donation_retry(lock, make_snap(2, calls)) == 2
         assert [c for c, _ in calls] == [
             "snap", "run", "snap", "run", "snap", "run",
         ]
-        assert calls[-1][1] is True  # final attempt held the lock
+        assert calls[-2] == ("snap", True)  # final snapshot under the lock
+        assert calls[-1][1] is False  # lane thread: no app locks held
 
         def snap_err():
             def fn():
