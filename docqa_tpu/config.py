@@ -454,6 +454,14 @@ class PoolConfig:
     hedge: bool = False
     hedge_min_delay_s: float = 0.75
     hedge_warmup: int = 20
+    # session-affine routing (docqa-prefix): a request carrying a
+    # prefix_key prefers the replica hash(key) names, so one patient's
+    # warm KV prefix blocks stay on the replica serving their session;
+    # falls back to least-queued whenever the preferred replica is more
+    # than affinity_max_queue_delta requests deeper than the shallowest
+    # (affinity must never amplify a hotspot)
+    session_affinity: bool = True
+    affinity_max_queue_delta: int = 4
 
 
 @dataclass(frozen=True)
@@ -586,6 +594,20 @@ class GenerateConfig:
     # sheds typed (serve.BlockPoolExhausted) instead of admitting work
     # the pool cannot hold.
     kv_pool_tokens: Optional[int] = None
+    # copy-on-write KV prefix cache (engines/paged.PrefixCache;
+    # docs/OPERATIONS.md "Prefix cache"): admission maps a cached,
+    # token-verified prompt prefix — keyed by the submitter's prefix_key,
+    # e.g. /ask's (template hash, retrieved-chunk-set hash) — into the
+    # new request's block table at refcount+1 and prefills only the
+    # novel suffix.  Shared runs are full blocks and 128-aligned, so
+    # warm output is bitwise-identical to a cold prefill (gated by
+    # tests/test_prefix.py); the cache LRU-evicts under block-pool
+    # pressure before any live work is shed.
+    prefix_cache: bool = True
+    # max cached prefixes per batcher replica (each entry pins its
+    # blocks until evicted; at 1024 B/token and 128-token granularity
+    # one align-unit costs 128 KB of pool HBM)
+    prefix_cache_entries: int = 32
     # prompt-lookup speculative decoding (greedy only): verify width per
     # step; 0/1 disables.  Decode is HBM-bound, so a K-token verify costs
     # one weight read like a single step but emits the matched draft

@@ -33,8 +33,10 @@ dispatch (``dispatch_streams.json`` is unchanged by this module).
 
 from __future__ import annotations
 
+import collections
+import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -61,12 +63,23 @@ class OutOfBlocks(RuntimeError):
 class BlockTable:
     """Per-request block list.  All mutation goes through the owning
     :class:`BlockAllocator` (one lock for table + free list, so a
-    release racing a grow can never tear the accounting)."""
+    release racing a grow can never tear the accounting).
 
-    __slots__ = ("blocks", "released", "_alloc")
+    The first ``n_shared`` blocks may be SHARED with other tables (a
+    cached prompt prefix mapped in at refcount+1 — see
+    :class:`PrefixCache`).  Shared blocks are immutable by contract:
+    they hold a full-block-aligned prompt prefix, and every write a
+    request ever issues lands at positions >= its own prompt length,
+    which is past the shared region by construction (copy-on-write
+    realized as never-write-shared).  ``grow`` only ever APPENDS fresh
+    private blocks; ``release`` decrements instead of freeing blocks
+    other tables still reference."""
+
+    __slots__ = ("blocks", "n_shared", "released", "_alloc")
 
     def __init__(self, alloc: "BlockAllocator") -> None:
         self.blocks: List[int] = []
+        self.n_shared = 0
         self.released = False
         self._alloc = alloc
 
@@ -89,12 +102,18 @@ class BlockTable:
 
 
 class BlockAllocator:
-    """Free-list allocator over a fixed pool of KV blocks.
+    """Free-list allocator over a fixed pool of KV blocks, REFCOUNTED
+    for copy-on-write prefix sharing (docqa-prefix).
 
     LIFO reuse keeps recently-freed blocks hot; allocation is
     all-or-nothing so a half-admitted request never strands blocks.
-    Double frees raise (rather than silently inflating the free list) —
-    the accounting IS the leak detector the chaos/drain tests assert on.
+    A block's refcount is 1 when privately owned and +1 per table the
+    prefix cache mapped it into; ``release`` decrements and only a
+    0-refcount block returns to the free list.  Double frees raise
+    (rather than silently inflating the free list) — the accounting IS
+    the leak detector the chaos/drain tests assert on, and it stays
+    exact under sharing: ``blocks_in_use`` counts UNIQUE live blocks,
+    so shared-release-is-not-a-free is directly observable.
     """
 
     def __init__(self, n_blocks: int, block_size: int) -> None:
@@ -105,6 +124,7 @@ class BlockAllocator:
         self._lock = threading.Lock()
         # LIFO stack: low block ids hand out first (stable tests/debug)
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._refs = [0] * self.n_blocks
         self._in_use = 0
 
     # ---- table lifecycle -------------------------------------------------
@@ -128,10 +148,39 @@ class BlockAllocator:
                     f"need {need} block(s), {len(self._free)} free "
                     f"(pool {self.n_blocks} x {self.block_size} tokens)"
                 )
-            table.blocks.extend(
-                self._free.pop() for _ in range(need)
-            )
+            for _ in range(need):
+                b = self._free.pop()
+                self._refs[b] = 1
+                table.blocks.append(b)
             self._in_use += need
+
+    def share(self, table: BlockTable, blocks: Sequence[int]) -> None:
+        """Map an already-live block run into ``table`` at refcount+1 —
+        the warm-admission path (and the cache's own pin).  The shared
+        run must be the table's LEADING blocks (a prompt prefix), so the
+        table must still be empty; all-or-nothing like ``grow``."""
+        blocks = [int(b) for b in blocks]
+        with self._lock:
+            if table.released:
+                raise OutOfBlocks("table already released")
+            if table.blocks:
+                raise ValueError(
+                    "shared prefix blocks must be mapped before any "
+                    "private growth (they are the table's leading run)"
+                )
+            for b in blocks:
+                if self._refs[b] <= 0:
+                    # sharing a freed block would resurrect it under a
+                    # live table — the exactly-once contract broke
+                    raise RuntimeError(
+                        f"share of a free block (id {b}): the prefix "
+                        "cache pinned a block the allocator no longer "
+                        "considers live"
+                    )
+            for b in blocks:
+                self._refs[b] += 1
+            table.blocks = list(blocks)
+            table.n_shared = len(blocks)
 
     def release(self, table: BlockTable) -> None:
         with self._lock:
@@ -140,20 +189,30 @@ class BlockAllocator:
             table.released = True
             if not table.blocks:
                 return
-            freed = set(table.blocks)
-            if len(freed) != len(table.blocks) or not freed.isdisjoint(
-                self._free
-            ):
-                # a block can be owned by exactly one live table; seeing
-                # it free (or listed twice) means the exactly-once
-                # contract broke upstream — fail loudly, never double-add
+            if len(set(table.blocks)) != len(table.blocks):
+                # a block may be referenced by many tables, but never
+                # twice by ONE — a duplicate means the table tore
                 raise RuntimeError(
-                    "double free detected: blocks already in the free "
-                    f"list ({sorted(freed & set(self._free))[:4]}...)"
+                    "double free detected: table lists a block twice"
                 )
-            self._free.extend(table.blocks)
-            self._in_use -= len(table.blocks)
+            for b in table.blocks:
+                if self._refs[b] <= 0:
+                    # decrementing past zero means a second release path
+                    # reached blocks already fully freed — fail loudly,
+                    # never double-add to the free list
+                    raise RuntimeError(
+                        f"double free detected: block {b} already at "
+                        "refcount 0"
+                    )
+            for b in table.blocks:
+                self._refs[b] -= 1
+                if self._refs[b] == 0:
+                    # a SHARED release is not a free: the block returns
+                    # only when its last referencing table lets go
+                    self._free.append(b)
+                    self._in_use -= 1
             table.blocks = []
+            table.n_shared = 0
 
     # ---- sizing / stats --------------------------------------------------
 
@@ -173,6 +232,250 @@ class BlockAllocator:
     def blocks_in_use(self) -> int:
         with self._lock:
             return self._in_use
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._refs[int(block)]
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: refcounted KV block sharing (docqa-prefix)
+# ---------------------------------------------------------------------------
+
+
+class _PrefixEntry:
+    __slots__ = ("tokens", "pin", "n_tokens")
+
+    def __init__(self, tokens: Tuple[int, ...], pin: BlockTable) -> None:
+        self.tokens = tokens
+        self.pin = pin  # a BlockTable of shared refs: the cache's pin
+        self.n_tokens = len(tokens)
+
+
+class PrefixCache:
+    """LRU cache of immutable, full-block KV prompt prefixes.
+
+    Keyed by the submitter's prefix key — for /ask that is
+    ``(template hash, retrieved-chunk-set hash)`` (service/qa.py), the
+    repeat-heavy clinical unit: many consecutive questions against one
+    patient's chunk set share the whole template+context prefix.  An
+    entry pins its blocks through its own :class:`BlockTable` of shared
+    refs, so eviction and teardown reuse the allocator's exactly-once
+    release accounting verbatim.  Entries store the prefix TOKEN IDS and
+    admission verifies them against the new prompt token by token — a
+    key collision (or template drift) degrades to a shorter shared run
+    or a miss, never to wrong attention.
+
+    Alignment contract: a shared run is always a multiple of
+    ``align`` = lcm(RAGGED_ALIGN, block_size) tokens — full blocks only
+    (immutability: no writer ever lands in a shared block) and
+    128-aligned (the packed-softmax reduction trees, and therefore the
+    emitted tokens, stay bitwise identical to a cold prefill — see
+    ops/attention.RAGGED_ALIGN).
+
+    Thread-safety: one lock, ordered BEFORE the allocator's (every path
+    that takes both nests cache -> allocator).  The batcher worker is
+    the only caller of lookup/insert; eviction may also come from
+    submit threads under :class:`BlockPoolExhausted` pressure.
+    """
+
+    def __init__(
+        self, alloc: BlockAllocator, align: int, max_entries: int = 32
+    ) -> None:
+        if align % alloc.block_size:
+            raise ValueError(
+                f"share alignment {align} must be a multiple of the "
+                f"block size {alloc.block_size} (full blocks only)"
+            )
+        self._alloc = alloc
+        self.align = int(align)
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, _PrefixEntry]" = (
+            collections.OrderedDict()
+        )
+        # lifetime counters (scraped into serve_kv_prefix_* gauges and
+        # the serve_prefix_* registry counters by the batcher)
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+        self.tokens_avoided = 0
+
+    # ---- admission-side API (batcher worker) ----------------------------
+
+    def _shared_len_locked(
+        self, entry: _PrefixEntry, ids: Sequence[int]
+    ) -> int:
+        """Longest verified, aligned, suffix-preserving shared run.
+
+        Capped one align-unit below the prompt length: the suffix must
+        keep >= 1 real token, because the prefill head samples the first
+        output from the LAST PROMPT TOKEN's hidden state — a
+        fully-cached prompt still prefills its final tokens."""
+        n = min(entry.n_tokens, len(ids))
+        n_match = 0
+        toks = entry.tokens
+        for i in range(n):
+            if toks[i] != ids[i]:
+                break
+            n_match += 1
+        return max(
+            0,
+            min(
+                (n_match // self.align) * self.align,
+                ((len(ids) - 1) // self.align) * self.align,
+            ),
+        )
+
+    def peek(self, key: Optional[str], ids: Sequence[int]) -> int:
+        """Shared-token estimate for capacity planning (the batcher's
+        admission pre-check) — no counters, no recency bump, no share."""
+        if key is None:
+            return 0
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return 0
+            return self._shared_len_locked(entry, ids)
+
+    def acquire(
+        self, key: Optional[str], ids: Sequence[int], table: BlockTable
+    ) -> int:
+        """Map the longest cached, verified, aligned prefix of ``ids``
+        into ``table`` at refcount+1; returns the shared token count
+        (0 = miss).  Atomic with eviction (one lock), so a concurrent
+        LRU eviction can never free a block between lookup and share.
+
+        Does NOT update the hit/miss stats: the caller credits via
+        :meth:`credit` once the admission actually holds — an
+        OutOfBlocks bounce-and-requeue would otherwise count the same
+        request twice, inflating the hit gauges exactly under the
+        pool pressure they exist to diagnose."""
+        if key is None:
+            return 0
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return 0
+            shared = self._shared_len_locked(entry, ids)
+            if shared <= 0:
+                return 0
+            self._alloc.share(
+                table, entry.pin.blocks[: shared // self._alloc.block_size]
+            )
+            self._entries.move_to_end(key)
+            return shared
+
+    def credit(self, shared: int) -> None:
+        """Record one keyed admission's outcome in the hit stats —
+        called only after the admission's block allocation succeeded."""
+        with self._lock:
+            if shared > 0:
+                self.hits += 1
+                self.tokens_avoided += shared
+            else:
+                self.misses += 1
+
+    def insert(self, key: Optional[str], ids: Sequence[int],
+               table: BlockTable) -> bool:
+        """Cache the aligned prefix of a just-admitted prompt (its K/V
+        will be written by the admission dispatch; the device sequences
+        every later reader after it).  Keeps the LONGEST prefix per key;
+        shorter re-inserts only refresh recency."""
+        if key is None:
+            return False
+        n = (len(ids) // self.align) * self.align
+        if n <= 0:
+            return False
+        with self._lock:
+            old = self._entries.get(key)
+            if old is not None:
+                self._entries.move_to_end(key)
+                if old.n_tokens >= n:
+                    return False
+            pin = self._alloc.new_table()
+            self._alloc.share(
+                pin, table.blocks[: n // self._alloc.block_size]
+            )
+            self._entries[key] = _PrefixEntry(tuple(ids[:n]), pin)
+            self._entries.move_to_end(key)
+            self.insertions += 1
+            evict_old = old
+            while len(self._entries) > self.max_entries:
+                _, lru = self._entries.popitem(last=False)
+                lru.pin.release()
+                self.evictions += 1
+        if evict_old is not None:
+            evict_old.pin.release()
+        return True
+
+    # ---- pressure / lifecycle -------------------------------------------
+
+    def evict_for(self, n_blocks: int) -> int:
+        """Evict entries until the allocator could satisfy an
+        ``n_blocks`` request (or nothing evictable remains) — the
+        BlockPoolExhausted-pressure valve: cached-but-IDLE prefixes are
+        the first HBM to give back, always before shedding live work.
+
+        "Idle" is literal: only entries whose pin would actually free
+        blocks now (refcount 1 — the cache is the sole reference) are
+        candidates, in LRU order.  An entry whose blocks are still
+        shared by in-flight lanes is in active use — evicting it frees
+        nothing today and only destroys the session's future hits, so
+        it is skipped (an earlier draft looped LRU-blind and could
+        empty the whole cache under live-lane pressure while recovering
+        zero HBM).  Returns the number of entries evicted."""
+        n_evicted = 0
+        with self._lock:
+            while self._entries and not self._alloc.can_alloc(n_blocks):
+                victim = None
+                for key, entry in self._entries.items():  # LRU order
+                    if any(
+                        self._alloc.refcount(b) == 1
+                        for b in entry.pin.blocks
+                    ):
+                        victim = key
+                        break
+                if victim is None:
+                    break  # nothing idle: every pin is also live
+                self._entries.pop(victim).pin.release()
+                self.evictions += 1
+                n_evicted += 1
+        return n_evicted
+
+    def clear(self) -> int:
+        """Release every pin (teardown / device-state reset: pool
+        contents are gone, so cached rows are garbage)."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            e.pin.release()
+        return len(entries)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            pinned = sum(len(e.pin.blocks) for e in self._entries.values())
+            n = len(self._entries)
+            hits, misses = self.hits, self.misses
+            return {
+                "entries": float(n),
+                "pinned_blocks": float(pinned),
+                "hits": float(hits),
+                "misses": float(misses),
+                "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+                "tokens_avoided": float(self.tokens_avoided),
+                "evictions": float(self.evictions),
+            }
+
+
+def share_alignment(block_size: int) -> int:
+    """Tokens per shareable prefix unit: full blocks AND 128-row aligned
+    (both the immutability and the bitwise-exactness contract)."""
+    from docqa_tpu.ops.attention import RAGGED_ALIGN
+
+    return math.lcm(int(block_size), RAGGED_ALIGN)
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +521,10 @@ def ragged_prefill_forward(
     last_rows,  # [B] int32 packed row of each lane's last prompt token
     *,
     rope_len: int,
+    block_tables=None,  # [B, NB] int32 (warm mode): per-lane block table
+    prefix_lens=None,  # [B] int32 (warm mode): cached tokens per lane
+    n_prefix_rows: int = 0,  # static prefix window (warm mode)
+    block_size: Optional[int] = None,
 ):
     """Prefill a whole admission round of MIXED-length prompts in one
     dispatch: every token computes through the shared trunk, scatters its
@@ -228,7 +535,18 @@ def ragged_prefill_forward(
     garbage logits the caller ignores (their scatter rows are
     out-of-bounds and dropped).  No shape family, no prompt bucket: the
     compile key is the token budget T alone.
+
+    WARM mode (``n_prefix_rows > 0``): the packed stream holds only each
+    lane's NOVEL SUFFIX (positions start at the lane's cached prefix
+    length); attention additionally reads the cached prefix K/V from the
+    pool through ``block_tables`` / ``prefix_lens``.  The prefix rows
+    are untouched by this dispatch's scatter (suffix positions map past
+    them — copy-on-write as never-write-shared), and the pool stores the
+    same bf16 K/V a cold prefill computes in flight, so warm output is
+    bitwise-identical to cold (the token-equality gate in
+    tests/test_prefix.py).
     """
+    warm = n_prefix_rows > 0  # static host int, never a tracer
 
     def attend(i, q, k, v):
         kp = pools[f"k{i}"]
@@ -239,12 +557,20 @@ def ragged_prefill_forward(
         pools[f"v{i}"] = vp.at[dest_rows].set(
             v[0].astype(vp.dtype), mode="drop"
         )
-        # attention over the packed batch itself: every KV row a prompt
-        # token needs is in-flight in this very dispatch (fresh prompts
-        # never read older pool state)
+        # attention over the packed batch itself (cold: every KV row a
+        # prompt token needs is in-flight in this very dispatch), plus —
+        # warm — the cached prefix rows of the post-scatter pool (the
+        # scatter only touches suffix rows, so prefix reads are stable)
+        kwargs = {}
+        if warm:
+            kwargs = dict(
+                k_pool=pools[f"k{i}"], v_pool=pools[f"v{i}"],
+                block_tables=block_tables, prefix_lens=prefix_lens,
+                n_prefix_rows=n_prefix_rows, block_size=block_size,
+            )
         return ragged_prefill_attention(
             q[0], k[0], v[0], seg_ids, positions,
-            sliding_window=cfg.sliding_window,
+            sliding_window=cfg.sliding_window, **kwargs,
         )[None]
 
     x = decoder_layer_stack(

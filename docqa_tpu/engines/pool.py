@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import zlib
 from time import monotonic as time_monotonic
 from time import perf_counter as _now
 from typing import Any, Dict, List, Optional, Sequence
@@ -300,6 +301,8 @@ class EnginePool:
         hedge: Optional[bool] = None,
         hedge_min_delay_s: Optional[float] = None,
         hedge_warmup: Optional[int] = None,
+        session_affinity: Optional[bool] = None,
+        affinity_max_queue_delta: Optional[int] = None,
         breaker_failure_threshold: int = 3,
         breaker_reset_s: float = 10.0,
     ) -> None:
@@ -341,6 +344,16 @@ class EnginePool:
             hedge_min_delay_s, "hedge_min_delay_s", 0.75
         )
         self.hedge_warmup = pick(hedge_warmup, "hedge_warmup", 20)
+        # session-affine routing (docqa-prefix): a request with a
+        # prefix_key prefers the replica hash(key) names, so a
+        # patient's warm KV blocks live on the replica that serves
+        # their next question — warm hits are per-replica caches
+        self.session_affinity = bool(
+            pick(session_affinity, "session_affinity", True)
+        )
+        self.affinity_max_queue_delta = int(
+            pick(affinity_max_queue_delta, "affinity_max_queue_delta", 4)
+        )
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -410,10 +423,16 @@ class EnginePool:
         # shell cannot pin a KV cache.
         if self._programs is None:
             self._programs = (
-                batcher._get_prefill_fn(), batcher._get_decode_fn()
+                batcher._get_prefill_fn(),
+                batcher._get_prefill_warm_fn(),
+                batcher._get_decode_fn(),
             )
         else:
-            batcher._prefill_fn, batcher._decode_fn = self._programs
+            (
+                batcher._prefill_fn,
+                batcher._prefill_warm_fn,
+                batcher._decode_fn,
+            ) = self._programs
         r = _Replica(idx, batcher, self._breakers[idx])
         r.generation = generation
         return r
@@ -450,7 +469,10 @@ class EnginePool:
         # across every later generation.  A still-wedged worker that
         # wakes into the None state errors into _fail_active, which
         # skips its reset for stopped batchers and exits the loop.
-        for name in ("_cache", "_tok", "_lengths", "_active", "_table"):
+        # "_pools" is the paged KV block pool (docqa-paged renamed it
+        # from the pre-paged "_cache", which the old scrub list still
+        # named — a dead shell was pinning the whole HBM pool)
+        for name in ("_pools", "_tok", "_lengths", "_active", "_table"):
             setattr(old, name, None)
         fresh = self._build_replica(r.idx, generation=r.generation + 1)
         r.batcher = fresh.batcher
@@ -501,14 +523,26 @@ class EnginePool:
 
     # ---- submit surface ------------------------------------------------------
 
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        """Pool passthrough of the batcher surface (service/qa.py checks
+        this before threading a ``prefix_key``)."""
+        return any(
+            getattr(r.batcher, "prefix_cache_enabled", False)
+            for r in self._replicas
+        )
+
     def submit_ids(
         self,
         prompt_ids: Sequence[int],
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        prefix_key: Optional[str] = None,
     ) -> PoolHandle:
         max_new = max_new_tokens or self.gen.max_new_tokens
-        req = make_request(prompt_ids, max_new, deadline=deadline)
+        req = make_request(
+            prompt_ids, max_new, deadline=deadline, prefix_key=prefix_key
+        )
         self._dispatch(req)
         return PoolHandle(self, req)
 
@@ -517,6 +551,7 @@ class EnginePool:
         prompt: str,
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        prefix_key: Optional[str] = None,
     ) -> PoolHandle:
         # same template-aware truncation contract as the bare batcher:
         # pool answers match solo-engine answers token-for-token
@@ -524,6 +559,7 @@ class EnginePool:
             self.engine.encode_prompt(prompt, self._usable),
             max_new_tokens,
             deadline=deadline,
+            prefix_key=prefix_key,
         )
 
     def _routable(self, exclude=()) -> List[_Replica]:
@@ -534,10 +570,24 @@ class EnginePool:
             and r.routable(self.heartbeat_max_age_s)
         ]
 
+    def _preferred_replica(self, req) -> Optional[int]:
+        """Session-affine preference: the replica a request's prefix key
+        hashes to (stable across processes — zlib.crc32, not the seeded
+        builtin), or None when affinity is off / the request is cold."""
+        key = getattr(req, "prefix_key", None)
+        if not self.session_affinity or not key or self.n_replicas < 2:
+            return None
+        return zlib.crc32(key.encode("utf-8")) % self.n_replicas
+
     def _try_place(self, req, exclude=()):
         """The ONE routing policy (dispatch, failover requeue, and park
         flush all use it): offer ``req`` to routable replicas in
-        least-queued order until one accepts.  Returns
+        least-queued order until one accepts — except that a request
+        with a prefix key tries its SESSION-AFFINE replica first (its
+        warm KV blocks live there), as long as that replica is not more
+        than ``affinity_max_queue_delta`` requests deeper than the
+        least-queued one (affinity is a preference, never a hotspot
+        amplifier; fallback is plain least-queued).  Returns
         ``(replica_or_None, n_full, n_candidates)`` where ``n_full``
         counts replicas that refused specifically because their queue is
         at capacity.  A :class:`Draining` refusal (the replica began
@@ -551,6 +601,26 @@ class EnginePool:
             self._routable(exclude),
             key=lambda r: (r.batcher.n_queued, r.batcher.n_active),
         )
+        want = self._preferred_replica(req)
+        # affine = the preference actually holds (preferred replica is
+        # first, naturally or by promotion); a preferred replica that
+        # was too deep and merely accepts LAST in least-queued order is
+        # NOT an affinity route and must not inflate the gauge
+        affine = False
+        if want is not None and candidates:
+            floor_q = candidates[0].batcher.n_queued
+            for i, r in enumerate(candidates):
+                if r.idx != want:
+                    continue
+                if i == 0:
+                    affine = True
+                elif (
+                    r.batcher.n_queued
+                    <= floor_q + self.affinity_max_queue_delta
+                ):
+                    candidates.insert(0, candidates.pop(i))
+                    affine = True
+                break
         n_full = 0
         for r in candidates:
             try:
@@ -562,6 +632,12 @@ class EnginePool:
                 continue
             except (WorkerDied, RuntimeError):
                 continue
+            if affine and r.idx == want:
+                # counted only when the PREFERRED replica accepted as
+                # the preference (front of the list) — neither a
+                # refused preference nor a too-deep preferred replica
+                # that happens to accept last counts
+                DEFAULT_REGISTRY.counter("pool_affinity_routed").inc()
             return r, n_full, len(candidates)
         return None, n_full, len(candidates)
 
@@ -1021,7 +1097,8 @@ class EnginePool:
                 key=lambda x: (x.batcher.n_queued, x.batcher.n_active),
             )
             twin = make_request(
-                list(req.prompt_ids), req.max_new, deadline=req.deadline
+                list(req.prompt_ids), req.max_new, deadline=req.deadline,
+                prefix_key=req.prefix_key,
             )
             # the twin rides the SAME trace so the timeline shows both
             # lanes racing
@@ -1136,13 +1213,22 @@ class EnginePool:
             occ = r.batcher.kv_block_occupancy()
             for key in (
                 "blocks_total", "blocks_used", "pool_bytes", "used_bytes",
-                "tokens_committed",
+                "tokens_committed", "prefix_entries", "prefix_blocks",
+                "prefix_hits", "prefix_misses", "prefix_tokens_avoided",
             ):
-                out[key] = out.get(key, 0) + occ[key]
+                if key in occ:
+                    out[key] = out.get(key, 0) + occ[key]
             out["block_size"] = occ["block_size"]
             out["bytes_per_token"] = occ["bytes_per_token"]
         if out.get("blocks_total"):
             out["utilization"] = out["blocks_used"] / out["blocks_total"]
+        # cross-replica hit rate re-derived from the summed raw counts
+        # (a mean of per-replica ratios would mis-weight uneven traffic)
+        lookups = out.get("prefix_hits", 0) + out.get("prefix_misses", 0)
+        if lookups:
+            out["prefix_hit_rate"] = round(
+                out["prefix_hits"] / lookups, 4
+            )
         return out
 
     def status(self) -> Dict[str, Any]:

@@ -39,11 +39,11 @@ Attention arXiv 2604.15464):
   overlap the next chunk's device execution.  On a tunneled single chip the
   fetch round-trip alone was ~60 % of a measured chunk round
   (``docs/PERF.md`` §1); locally it hides the ~26 ms fetch + host work.
-  Correctness rests on one invariant: admission never runs between a
-  chunk's dispatch and its processing (the worker drains the pipeline
-  first), so every in-flight chunk's slot→request mapping is the current
-  one; a snapshot guard drops tokens for any slot whose occupant changed
-  anyway.  Slots that retire on budget mid-pipeline decode one extra chunk
+  Correctness rests on the dispatch-time snapshot: every chunk carries
+  the slot→request mapping of its own dispatch, and tokens are
+  delivered only to slots whose occupant is still that request — so the
+  disaggregated order below (admission prefill AFTER the chunk
+  dispatch) can never misdeliver.  Slots that retire on budget mid-pipeline decode one extra chunk
   whose tokens are discarded — wasted compute, never wrong output — and an
   in-program capacity guard deactivates any lane before a K/V write could
   land past its allocated blocks (such writes are additionally dropped,
@@ -51,6 +51,20 @@ Attention arXiv 2604.15464):
   the very next admission because the pool is DONATED through every
   dispatch: an in-flight overshoot chunk's stale writes are sequenced
   before the prefill that re-populates those rows.
+
+Prefix reuse (docqa-prefix, ROADMAP item 1 follow-through): a refcounted
+copy-on-write prefix cache (``engines/paged.PrefixCache``) keyed by the
+submitter's ``prefix_key`` — for /ask, (template hash, retrieved-chunk-
+set hash) — lets the repeat-heavy clinical pattern (many consecutive
+questions against one patient's chunk set) map the shared prompt prefix
+into a new request's block table at refcount+1 and ragged-prefill ONLY
+the novel suffix.  Shared runs are full blocks and 128-aligned, so warm
+output is bitwise-identical to a cold prefill; ``release`` decrements
+instead of freeing, double frees still raise, and the cache gives its
+HBM back (LRU) under :class:`BlockPoolExhausted` pressure before any
+live work is shed.  The worker loop is DISAGGREGATED: decode chunks
+dispatch ahead of the admission prefill (which rides its own spine
+stream), so a long prefill never stalls live lanes' token cadence.
 
 TP shardings come from ``parallel/sharding.py`` (block pool: kv-heads over
 the model axis, block rows replicated); slots ride the batch axis.
@@ -75,10 +89,12 @@ from docqa_tpu import obs
 from docqa_tpu.engines.paged import (
     BlockAllocator,
     OutOfBlocks,
+    PrefixCache,
     init_paged_pools,
     kv_bytes_per_token,
     paged_decode_forward,
     ragged_prefill_forward,
+    share_alignment,
 )
 from docqa_tpu.engines.generate import accept_drafts, draft_tokens
 from docqa_tpu.engines.spine import spine_run
@@ -125,12 +141,20 @@ class _Request:
     # admission round, or retires its slot at the next chunk boundary.
     # A plain bool is enough — one writer flips it, the worker only reads.
     cancelled: bool = False
+    # prefix-cache key (docqa-prefix): for /ask this is the
+    # (template hash, retrieved-chunk-set hash) pair service/qa.py
+    # computes — requests sharing it share a prompt prefix the batcher
+    # can serve from cached KV blocks instead of re-prefilling.  Also
+    # the session-affinity routing key in engines/pool.py.  None =
+    # always-cold (canaries, bulk tools, foreign prompts).
+    prefix_key: Optional[str] = None
 
 
 def make_request(
     prompt_ids: Sequence[int],
     max_new: int,
     deadline: Optional[Deadline] = None,
+    prefix_key: Optional[str] = None,
 ) -> _Request:
     """Build a :class:`_Request`, capturing the SUBMITTER's trace position
     (the worker thread records every later stage on it explicitly).
@@ -144,7 +168,9 @@ def make_request(
         # arrives already out of budget must not take a queue slot
         DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
         deadline.check("serve_submit")
-    req = _Request(list(prompt_ids), max_new, deadline=deadline)
+    req = _Request(
+        list(prompt_ids), max_new, deadline=deadline, prefix_key=prefix_key
+    )
     ctx = obs.current()
     if ctx is not None:
         req.trace = ctx.trace
@@ -383,6 +409,7 @@ class ContinuousBatcher:
         max_queue: Optional[int] = 256,
         kv_block_size: Optional[int] = None,
         kv_pool_tokens: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
     ) -> None:
         self.engine = engine
         self.cfg = engine.cfg
@@ -451,6 +478,26 @@ class ContinuousBatcher:
         # a pool-monitor rebuild constructing a replacement batcher must
         # not become its own device stream (engines/spine.py).
         self._alloc = BlockAllocator(self.n_blocks, self.block_size)
+        # ---- copy-on-write prefix cache (docqa-prefix) ----
+        # Shared runs are full blocks AND 128-aligned (immutability +
+        # bitwise warm-vs-cold equality; engines/paged.share_alignment).
+        # A cache whose alignment reaches the packed capacity could
+        # never leave >= 1 suffix token — disabled rather than dead
+        # weight (tiny-cache test configs).
+        self._share_align = share_alignment(self.block_size)
+        self._prefix_cache: Optional[PrefixCache] = None
+        want_cache = (
+            bool(getattr(self.gen, "prefix_cache", True))
+            if prefix_cache is None
+            else bool(prefix_cache)  # bench A/B + test override
+        )
+        if want_cache and self._share_align < self.seq_capacity:
+            self._prefix_cache = PrefixCache(
+                self._alloc, self._share_align,
+                max_entries=int(
+                    getattr(self.gen, "prefix_cache_entries", 32)
+                ),
+            )
         spine_run("serve_alloc", self._init_device_state_on_lane)
 
         # host-side slot bookkeeping
@@ -530,6 +577,7 @@ class ContinuousBatcher:
         # solo batcher, every request fails typed immediately.
         self.on_worker_death = None
         self._prefill_fn = None
+        self._prefill_warm_fn = None
         self._decode_fn = None
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="continuous-batcher"
@@ -548,7 +596,8 @@ class ContinuousBatcher:
         )
 
     def _prefill_program(self, params, pools, ids, seg, pos, dest,
-                         last_rows, slots, rng, table=None):
+                         last_rows, slots, rng, table=None,
+                         block_tables=None, prefix_lens=None):
         """Ragged prefill: one PACKED dispatch admits a whole round of
         mixed-length prompts (engines/paged.py).
 
@@ -564,11 +613,26 @@ class ContinuousBatcher:
         REPLACED by each prompt's bigram table (built from the same
         packed stream: consecutive same-segment pairs) plus the confirmed
         last-prompt-token -> first-token pair — the drafting source for
-        the speculative decode chunks."""
+        the speculative decode chunks.
+
+        WARM variant (``block_tables``/``prefix_lens`` set — the prefix
+        -cache path): the packed stream carries only each lane's novel
+        SUFFIX and attention additionally reads the cached prefix K/V
+        through the block tables (engines/paged.py).  A warm lane's
+        bigram drafting table covers only its suffix — drafts stay
+        verified, so output is unaffected, just fewer accepted drafts
+        on heavily-cached prompts."""
         S = self.n_slots
+        warm_kw = {}
+        if block_tables is not None:
+            warm_kw = dict(
+                block_tables=block_tables, prefix_lens=prefix_lens,
+                n_prefix_rows=self.seq_capacity,
+                block_size=self.block_size,
+            )
         logits, pools = ragged_prefill_forward(
             params, self.cfg, pools, ids, seg, pos, dest, last_rows,
-            rope_len=self.seq_capacity,
+            rope_len=self.seq_capacity, **warm_kw,
         )
         toks = sample(
             logits, rng, self.gen.temperature, self.gen.top_k,
@@ -758,6 +822,35 @@ class ContinuousBatcher:
                 )
         return self._prefill_fn
 
+    def _get_prefill_warm_fn(self):
+        """The WARM ragged-prefill jit (prefix-cache admissions): same
+        packed-token-budget shapes as the cold program plus the block
+        tables / per-lane prefix lengths.  A separate jit object so COLD
+        rounds keep compiling (and running) exactly the pre-prefix
+        program — cold numerics and cold cost are untouched; the warm
+        family adds at most ``len(self._token_buckets)`` programs to the
+        compile surface (compile_budget.json gates the new total)."""
+        if self._prefill_warm_fn is None:
+            if self.spec_k:
+                self._prefill_warm_fn = jax.jit(
+                    lambda p, c, t, i, sg, po, d, lr, sl, bt, pl, r:
+                    self._prefill_program(
+                        p, c, i, sg, po, d, lr, sl, r, table=t,
+                        block_tables=bt, prefix_lens=pl,
+                    ),
+                    donate_argnums=(1, 2),
+                )
+            else:
+                self._prefill_warm_fn = jax.jit(
+                    lambda p, c, i, sg, po, d, lr, sl, bt, pl, r:
+                    self._prefill_program(
+                        p, c, i, sg, po, d, lr, sl, r,
+                        block_tables=bt, prefix_lens=pl,
+                    ),
+                    donate_argnums=(1,),
+                )
+        return self._prefill_warm_fn
+
     def _get_decode_fn(self):
         if self._decode_fn is None:
             if self.spec_k:
@@ -839,7 +932,7 @@ class ContinuousBatcher:
         # never again become the third concurrent client stream (the
         # serve_cluster_loop --warm-thread deadlock), and it can occupy
         # at most n_lanes-1 lanes while live traffic keeps the rest
-        def _warm_prefill_on_lane(T: int):
+        def _warm_prefill_on_lane(T: int, prefix: bool = False):
             pools, table, _tok, _lengths, _active = (
                 self._fresh_device_state()
             )
@@ -849,15 +942,27 @@ class ContinuousBatcher:
             dest = jnp.full((T,), oob_row, jnp.int32)  # dropped writes
             last_rows = jnp.zeros((S,), jnp.int32)
             slots = jnp.full((S,), S, jnp.int32)  # OOB == dropped
+            args = (ids, seg, pos, dest, last_rows, slots)
+            if prefix:
+                # the warm-admission program family: all-sentinel tables
+                # and zero prefix lengths trace/compile the full prefix
+                # -gather path without reading a live block
+                tabs = jnp.full(
+                    (S, self.blocks_per_seq), self.n_blocks, jnp.int32
+                )
+                plens = jnp.zeros((S,), jnp.int32)
+                args = args + (tabs, plens)
+                use = self._get_prefill_warm_fn()
+            else:
+                use = fn
             if self.spec_k:
-                out = fn(
-                    self.engine.params, pools, table, ids, seg, pos,
-                    dest, last_rows, slots, self._next_rng(),
+                out = use(
+                    self.engine.params, pools, table, *args,
+                    self._next_rng(),
                 )
             else:
-                out = fn(
-                    self.engine.params, pools, ids, seg, pos, dest,
-                    last_rows, slots, self._next_rng(),
+                out = use(
+                    self.engine.params, pools, *args, self._next_rng(),
                 )
             return out
 
@@ -866,6 +971,11 @@ class ContinuousBatcher:
                 "serve_warmup", _warm_prefill_on_lane, T,
                 stream="warmup", sync=True,
             )
+            if self._prefix_cache is not None:
+                spine_run(
+                    "serve_warmup", _warm_prefill_on_lane, T, True,
+                    stream="warmup", sync=True,
+                )
 
         # decode chunk: one shape regardless of prompt mix — all-inactive
         # lanes still trace/compile the full program (all-sentinel tables)
@@ -930,16 +1040,20 @@ class ContinuousBatcher:
                 )
                 ok = False
                 fn = self._get_prefill_fn()
+                tabs_s = jax.ShapeDtypeStruct(
+                    (S, self.blocks_per_seq), i32
+                )
+                plens_s = jax.ShapeDtypeStruct((S,), i32)
                 for T in self._token_buckets:
-                    args = (
+                    packed = (
                         jax.ShapeDtypeStruct((T,), i32),  # ids
                         jax.ShapeDtypeStruct((T,), i32),  # seg
                         jax.ShapeDtypeStruct((T,), i32),  # pos
                         jax.ShapeDtypeStruct((T,), i32),  # dest
                         jax.ShapeDtypeStruct((S,), i32),  # last_rows
                         jax.ShapeDtypeStruct((S,), i32),  # slots
-                        rng_s,
                     )
+                    args = packed + (rng_s,)
                     if self.spec_k:
                         low = fn.lower(params_s, pools_s, table_s, *args)
                     else:
@@ -947,6 +1061,21 @@ class ContinuousBatcher:
                     ok = DEFAULT_OBSERVATORY.annotate_lowered(
                         "serve_prefill_fetch", low, key=T
                     ) or ok
+                    if self._prefix_cache is not None:
+                        # the warm program has its own cost model (the
+                        # prefix gather + wider score axis); its fetch
+                        # accrues under ("warm", T) cost keys
+                        wargs = packed + (tabs_s, plens_s, rng_s)
+                        wfn = self._get_prefill_warm_fn()
+                        if self.spec_k:
+                            wlow = wfn.lower(
+                                params_s, pools_s, table_s, *wargs
+                            )
+                        else:
+                            wlow = wfn.lower(params_s, pools_s, *wargs)
+                        ok = DEFAULT_OBSERVATORY.annotate_lowered(
+                            "serve_prefill_fetch", wlow, key=("warm", T)
+                        ) or ok
                 dfn = self._get_decode_fn()
                 tables_s = jax.ShapeDtypeStruct(
                     (S, self.blocks_per_seq), i32
@@ -983,15 +1112,26 @@ class ContinuousBatcher:
 
     # ---- public API ----------------------------------------------------------
 
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        """Submitters (service/qa.py) check this before passing a
+        ``prefix_key`` — batcher stand-ins without the kwarg stay
+        compatible."""
+        return self._prefix_cache is not None
+
     def submit_ids(
         self,
         prompt_ids: Sequence[int],
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        prefix_key: Optional[str] = None,
     ) -> Handle:
         max_new = max_new_tokens or self.gen.max_new_tokens
         return self.submit_request(
-            make_request(prompt_ids, max_new, deadline=deadline)
+            make_request(
+                prompt_ids, max_new, deadline=deadline,
+                prefix_key=prefix_key,
+            )
         )
 
     def submit_request(self, req: _Request) -> Handle:
@@ -1015,6 +1155,11 @@ class ContinuousBatcher:
             ):
                 DEFAULT_REGISTRY.counter("serve_shed").inc()
                 n_active = sum(1 for r in self._slot_req if r is not None)
+                if self._alloc.n_free == 0 and self._prefix_cache is not None:
+                    # under BlockPoolExhausted pressure, cached-but-idle
+                    # prefixes give their HBM back BEFORE live work is
+                    # shed — only refcount-1 (cache-only) blocks free
+                    self._prefix_cache.evict_for(1)
                 if self._alloc.n_free == 0:
                     # the queue backed up BECAUSE the block pool is dry:
                     # name the real bottleneck (HBM overcommit, not queue
@@ -1053,6 +1198,7 @@ class ContinuousBatcher:
         prompt: str,
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        prefix_key: Optional[str] = None,
     ) -> Handle:
         # same text entry contract as GenerateEngine.generate_texts: the
         # configured chat template wraps here too (template-aware
@@ -1063,6 +1209,7 @@ class ContinuousBatcher:
             self.engine.encode_prompt(prompt, usable),
             max_new_tokens,
             deadline=deadline,
+            prefix_key=prefix_key,
         )
 
     def generate_texts(
@@ -1138,9 +1285,11 @@ class ContinuousBatcher:
         # block accounting closes with the batcher: every slot's table
         # returns to the pool exactly once (release is idempotent and
         # allocator-locked, so a wedged worker racing its own retire
-        # cannot double-free)
+        # cannot double-free), and the prefix cache's pins go with it
         for slot in range(self.n_slots):
             self._release_slot_blocks(slot)
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
 
     # ---- liveness / graceful-drain contract (engines/pool.py) ---------------
 
@@ -1265,9 +1414,12 @@ class ContinuousBatcher:
         # close the block accounting (idempotent; a later zombie retire
         # is a no-op).  The pool itself dies with this batcher — the
         # rebuild allocates a fresh one — so freed ids are never handed
-        # to a new admission a zombie write could corrupt.
+        # to a new admission a zombie write could corrupt.  Cache pins
+        # release too: a killed batcher's pool is garbage.
         for slot in range(self.n_slots):
             self._release_slot_blocks(slot)
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
 
     @property
     def n_active(self) -> int:
@@ -1314,7 +1466,7 @@ class ContinuousBatcher:
             req = self._slot_req[slot]
             if req is not None:
                 tokens += self._slot_prompt[slot] + len(req.tokens)
-        return {
+        out = {
             "blocks_total": self.n_blocks,
             "blocks_used": used,
             "block_size": self.block_size,
@@ -1324,6 +1476,21 @@ class ContinuousBatcher:
             "tokens_committed": tokens,
             "utilization": used / self.n_blocks,
         }
+        if self._prefix_cache is not None:
+            # prefix-cache occupancy (docqa-prefix): entries + the
+            # blocks the cache pins, plus the lifetime hit economics —
+            # the sampler turns these into serve_kv_prefix_* gauges.
+            # Raw hit/miss counts ride along so aggregators (the pool's
+            # cross-replica rate, chaos evidence) can sum THIS surface
+            # instead of reaching into the cache object.
+            pstats = self._prefix_cache.stats()
+            out["prefix_entries"] = pstats["entries"]
+            out["prefix_blocks"] = pstats["pinned_blocks"]
+            out["prefix_hits"] = pstats["hits"]
+            out["prefix_misses"] = pstats["misses"]
+            out["prefix_hit_rate"] = round(pstats["hit_rate"], 4)
+            out["prefix_tokens_avoided"] = pstats["tokens_avoided"]
+        return out
 
     # ---- worker loop ---------------------------------------------------------
 
@@ -1351,7 +1518,10 @@ class ContinuousBatcher:
         # budget >= 1 — otherwise prompts in the band truncate "in bounds"
         # but retire with zero output (a 200 with an empty answer).
         usable = self.cache_len - 2 - self.spec_k
-        good: List[Tuple[int, "_Request", List[int], Any]] = []
+        # entry: (slot, req, ids, table, shared) — shared > 0 marks a
+        # WARM lane whose leading blocks were mapped from the prefix
+        # cache (only the novel suffix ids[shared:] is packed/prefilled)
+        good: List[Tuple[int, "_Request", List[int], Any, int]] = []
         send_back: List["_Request"] = []
         for slot, req in pairs:
             if req.deadline is not None and req.deadline.expired:
@@ -1374,14 +1544,24 @@ class ContinuousBatcher:
                 _finish(req)
                 continue
             table = self._alloc.new_table()
+            shared = 0
             try:
+                if self._prefix_cache is not None:
+                    # longest cached, token-verified, aligned prefix in
+                    # at refcount+1 — this is the prefill work avoided
+                    shared = self._prefix_cache.acquire(
+                        req.prefix_key, ids, table
+                    )
                 table.ensure(
                     min(len(ids) + self._grow_margin, self.seq_capacity)
                 )
             except OutOfBlocks:
                 # the pool drained between the _pop_free_slots capacity
                 # check and here (same thread, so only by THIS round's
-                # earlier allocations) — requeue at the head, keep order
+                # earlier allocations) — requeue at the head, keep
+                # order.  Release FIRST: a partial share would otherwise
+                # strand refcounts on a table nobody owns.
+                table.release()
                 DEFAULT_REGISTRY.counter("serve_block_pool_wait").inc()
                 _req_mark(
                     req, "block_pool_exhausted", queued=True,
@@ -1389,7 +1569,33 @@ class ContinuousBatcher:
                 )
                 send_back.append(req)
                 continue
-            good.append((slot, req, ids, table))
+            if self._prefix_cache is not None and req.prefix_key is not None:
+                # stats credit only AFTER ensure() held: a bounced
+                # admission re-acquires next round and must not count
+                # twice (cache stats and registry counters stay in step)
+                self._prefix_cache.credit(shared)
+            if shared:
+                DEFAULT_REGISTRY.counter("serve_prefix_hits").inc()
+                DEFAULT_REGISTRY.counter(
+                    "serve_prefix_tokens_avoided"
+                ).inc(shared)
+                _req_mark(
+                    req, "prefix_hit", anomalous=False,
+                    shared_tokens=shared, prompt_tokens=len(ids),
+                )
+            if self._prefix_cache is not None:
+                # insert IN the allocation loop, not after it: a later
+                # request of the SAME key in this very round then
+                # acquires this entry and shares in-round (consecutive
+                # questions of one session routinely land in one
+                # admission round under load).  Device ordering makes
+                # it exact: cold groups dispatch before warm ones, and
+                # within a dispatch the layer scatter precedes the
+                # prefix gather — the shared rows are always written
+                # before any sharer reads them.  Abort paths stay
+                # leak-free: a failed round clears the whole cache.
+                self._prefix_cache.insert(req.prefix_key, ids, table)
+            good.append((slot, req, ids, table, shared))
         if send_back:
             sent = {id(r) for r in send_back}
             with self._cv:
@@ -1411,7 +1617,7 @@ class ContinuousBatcher:
         # Register slot state BEFORE the dispatch: if the dispatch dies,
         # _fail_active sweeps these slots and releases their fresh block
         # tables along with everything else (exactly-once accounting).
-        for slot, req, ids, table in good:
+        for slot, req, ids, table, _shared in good:
             n_ids = len(ids)
             budget = min(req.max_new, self.cache_len - n_ids - 1 - self.spec_k)
             self._slot_req[slot] = req
@@ -1424,22 +1630,32 @@ class ContinuousBatcher:
             self._caps_np[slot] = table.capacity
         self._tables_dirty = True
 
-        # pack into dispatch groups: each prompt starts on a
-        # RAGGED_ALIGN boundary (the exactness contract in
-        # ops/attention.py) and a group never exceeds the largest budget
-        groups: List[List[Tuple[int, "_Request", List[int], Any]]] = []
-        cur: List[Tuple[int, "_Request", List[int], Any]] = []
-        cur_tokens = 0
-        max_t = self._token_buckets[-1]
-        for entry in good:
-            n_aligned = round_up(len(entry[2]), RAGGED_ALIGN)
-            if cur and cur_tokens + n_aligned > max_t:
-                groups.append(cur)
-                cur, cur_tokens = [], 0
-            cur.append(entry)
-            cur_tokens += n_aligned
-        if cur:
-            groups.append(cur)
+        # pack into dispatch groups: each prompt's NOVEL portion starts
+        # on a RAGGED_ALIGN boundary (the exactness contract in
+        # ops/attention.py) and a group never exceeds the largest
+        # budget.  Warm lanes (shared > 0) pack only their suffix and
+        # group separately from cold ones: cold rounds keep dispatching
+        # the exact pre-prefix program (numerics untouched by
+        # construction), warm rounds pay the prefix-gather program.
+        def _packed_len(entry) -> int:
+            return round_up(len(entry[2]) - entry[4], RAGGED_ALIGN)
+
+        groups: List[List[tuple]] = []
+        for warm_flag in (False, True):
+            cur: List[tuple] = []
+            cur_tokens = 0
+            max_t = self._token_buckets[-1]
+            for entry in good:
+                if bool(entry[4]) != warm_flag:
+                    continue
+                n_aligned = _packed_len(entry)
+                if cur and cur_tokens + n_aligned > max_t:
+                    groups.append((warm_flag, cur))
+                    cur, cur_tokens = [], 0
+                cur.append(entry)
+                cur_tokens += n_aligned
+            if cur:
+                groups.append((warm_flag, cur))
 
         fn = self._get_prefill_fn()
         S = self.n_slots
@@ -1448,10 +1664,8 @@ class ContinuousBatcher:
         # everything that touches the device happens inside the spine
         # work item below
         group_inputs = []
-        for group in groups:
-            total = sum(
-                round_up(len(ids), RAGGED_ALIGN) for _, _, ids, _ in group
-            )
+        for warm_flag, group in groups:
+            total = sum(_packed_len(e) for e in group)
             T = self._pick_token_bucket(total)
             ids_flat = np.full((T,), self.gen.pad_id, np.int32)
             seg = np.full((T,), -1, np.int32)
@@ -1459,30 +1673,46 @@ class ContinuousBatcher:
             dest = np.full((T,), oob_row, np.int32)
             last_rows = np.zeros((S,), np.int32)
             slots_arr = np.full((S,), S, np.int32)  # OOB == dropped
+            tables_np = plens_np = None
+            if warm_flag:
+                tables_np = np.full(
+                    (S, self.blocks_per_seq), self.n_blocks, np.int32
+                )
+                plens_np = np.zeros((S,), np.int32)
             off = 0
-            for lane, (slot, _req, ids, table) in enumerate(group):
+            for lane, (slot, _req, ids, table, shared) in enumerate(group):
                 n = len(ids)
-                ids_flat[off: off + n] = ids
-                seg[off: off + n] = lane
-                p = np.arange(n, dtype=np.int32)
-                pos[off: off + n] = p
+                # pack only the novel suffix; positions stay ABSOLUTE
+                # (warm queries RoPE/attend at their true offsets; the
+                # cached prefix rows cover positions [0, shared))
+                p = np.arange(shared, n, dtype=np.int32)
+                n_sfx = n - shared
+                ids_flat[off: off + n_sfx] = ids[shared:]
+                seg[off: off + n_sfx] = lane
+                pos[off: off + n_sfx] = p
                 blocks = np.asarray(table.blocks, np.int64)
-                dest[off: off + n] = (
+                dest[off: off + n_sfx] = (
                     blocks[p // self.block_size] * self.block_size
                     + p % self.block_size
                 )
-                last_rows[lane] = off + n - 1
+                last_rows[lane] = off + n_sfx - 1
                 slots_arr[lane] = slot
-                off += round_up(n, RAGGED_ALIGN)
+                if warm_flag:
+                    tables_np[lane, : len(table.blocks)] = table.blocks
+                    plens_np[lane] = shared
+                off += round_up(n_sfx, RAGGED_ALIGN)
             group_inputs.append(
                 (T, ids_flat, seg, pos, dest, last_rows, slots_arr,
-                 len(group))
+                 len(group), warm_flag, tables_np, plens_np)
             )
-        G = len(good)
+        # flattened group-major order: slot scatters and the first-token
+        # fetch must line up with the concatenated dispatch outputs
+        ordered = [e for _w, group in groups for e in group]
+        G = len(ordered)
         slots_np = np.empty((G,), np.int32)
         lens_np = np.empty((G,), np.int32)
         budget_ok = np.empty((G,), bool)
-        for i, (slot, req, ids, _table) in enumerate(good):
+        for i, (slot, req, ids, _table, _shared) in enumerate(ordered):
             slots_np[i] = slot
             lens_np[i] = len(ids)
             budget_ok[i] = self._slot_budget[slot] >= 2
@@ -1499,22 +1729,30 @@ class ContinuousBatcher:
             self._apply_deact_on_lane()
             parts = []
             for (T, ids_flat, seg, pos, dest, last_rows, slots_arr,
-                 n_lanes) in group_inputs:
-                args = (
+                 n_lanes, warm_flag, tables_np, plens_np) in group_inputs:
+                packed = (
                     jnp.asarray(ids_flat),
                     jnp.asarray(seg),
                     jnp.asarray(pos),
                     jnp.asarray(dest),
                     jnp.asarray(last_rows),
                     jnp.asarray(slots_arr),
-                    self._next_rng(),
                 )
+                if warm_flag:
+                    use = self._get_prefill_warm_fn()
+                    args = packed + (
+                        jnp.asarray(tables_np), jnp.asarray(plens_np),
+                        self._next_rng(),
+                    )
+                else:
+                    use = fn
+                    args = packed + (self._next_rng(),)
                 if self.spec_k:
-                    self._pools, self._table, toks = fn(
+                    self._pools, self._table, toks = use(
                         self.engine.params, self._pools, self._table, *args
                     )
                 else:
-                    self._pools, toks = fn(
+                    self._pools, toks = use(
                         self.engine.params, self._pools, *args
                     )
                 parts.append(toks[:n_lanes])
@@ -1531,19 +1769,30 @@ class ContinuousBatcher:
 
         t_prefill0 = _now()
         with span("serve_prefill", DEFAULT_REGISTRY):
-            first_toks = spine_run("serve_prefill", _prefill_on_lane)[0]
+            # the prefill rides its own spine stream ("prefill"): lanes
+            # schedule decode-class items ahead of it, so one replica's
+            # long admission prefill cannot head-of-line block another
+            # replica's decode chunks (the disaggregated-lane split)
+            first_toks = spine_run(
+                "serve_prefill", _prefill_on_lane, stream="prefill"
+            )[0]
         t_prefill1 = _now()
-        for gi, group in enumerate(groups):
-            for slot, req, ids, table in group:
+        for gi, (warm_flag, group) in enumerate(groups):
+            for slot, req, ids, table, shared in group:
                 _req_span(
                     req, "serve_prefill", t_prefill0, t_prefill1,
                     batch=len(good), dispatch=gi, slot=slot,
                     prompt_tokens=len(ids), blocks=len(table.blocks),
+                    shared_tokens=shared,
                 )
-        meta = [(slot, req, len(ids)) for slot, req, ids, _t in good]
+        meta = [(slot, req, len(ids)) for slot, req, ids, _t, _s in ordered]
         # the groups' token budgets ride along as the admission fetch's
-        # cost keys (observatory MFU accounting)
-        return meta, first_toks, [g[0] for g in group_inputs]
+        # cost keys (observatory MFU accounting; warm groups accrue
+        # under their own ("warm", T) cost models)
+        cost_keys = [
+            ("warm", g[0]) if g[8] else g[0] for g in group_inputs
+        ]
+        return meta, first_toks, cost_keys
 
     def _finalize_admissions(self, admitted) -> bool:
         """Host-side bookkeeping for an admission round: ONE device fetch
@@ -1621,6 +1870,11 @@ class ContinuousBatcher:
                 _finish(req)
                 self._slot_req[slot] = None
             self._release_slot_blocks(slot)
+        # the reset below replaces the device pools: every cached prefix
+        # row is garbage from here — invalidate the whole cache (pins
+        # release; warm admissions start over against the fresh pools)
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
         if self._stopped:
             # a killed batcher never serves again — re-allocating a fresh
             # block pool here would waste HBM right as the pool's rebuild
@@ -1782,13 +2036,24 @@ class ContinuousBatcher:
         return True
 
     def _blocks_for_admission(self, req: "_Request") -> int:
-        """Blocks an admission would allocate for ``req`` (prompt after
-        truncation, plus the grow margin, capped at one sequence)."""
+        """FRESH blocks an admission would allocate for ``req`` (prompt
+        after truncation plus the grow margin, capped at one sequence) —
+        net of any cached prefix the request would map in shared (warm
+        admissions cost the pool only their novel suffix, which is what
+        lets a repeat-heavy mix admit deeper into the same HBM)."""
         usable = self.cache_len - 2 - self.spec_k
         n_ids = max(1, min(len(req.prompt_ids), usable))
-        return self._alloc.blocks_for(
+        total = self._alloc.blocks_for(
             min(n_ids + self._grow_margin, self.seq_capacity)
         )
+        if self._prefix_cache is not None and req.prefix_key is not None:
+            try:
+                ids = [int(t) for t in req.prompt_ids][-usable:]
+            except (TypeError, ValueError):
+                return total  # bad request: _admit_round fails it alone
+            shared = self._prefix_cache.peek(req.prefix_key, ids)
+            total -= shared // self.block_size
+        return max(total, 0)
 
     def _pop_free_slots(
         self, pairs: List[Tuple[int, "_Request"]]
@@ -1817,9 +2082,26 @@ class ContinuousBatcher:
             while self._queue and not filled:
                 head = self._queue[0]
                 need = self._blocks_for_admission(head)
-                if (
+                head_live = (
                     head.deadline is None or not head.deadline.expired
-                ) and not head.cancelled and not self._alloc.can_alloc(
+                ) and not head.cancelled
+                if (
+                    head_live
+                    and self._prefix_cache is not None
+                    and not self._alloc.can_alloc(planned + need)
+                ):
+                    # starving LIVE head (a cancelled/expired one is
+                    # about to be shed below — never dump warm state
+                    # for it): cached-but-idle prefixes give their HBM
+                    # back before the head is left queued (the
+                    # BlockPoolExhausted-pressure valve).  Re-estimate
+                    # afterwards: the eviction may have taken the
+                    # head's OWN entry, so its peek-discounted need is
+                    # stale and admitting on it would just bounce off
+                    # OutOfBlocks in _admit_round.
+                    if self._prefix_cache.evict_for(planned + need):
+                        need = self._blocks_for_admission(head)
+                if head_live and not self._alloc.can_alloc(
                     planned + need
                 ):
                     # pool exhausted for now: leave it queued (typed
@@ -1899,6 +2181,22 @@ class ContinuousBatcher:
             self._run_loop()
         except BaseException as e:
             self._worker_died(e)
+        finally:
+            # a kill() that lands mid-iteration lets THIS loop finish
+            # its admission round — registering fresh block tables
+            # AFTER the kill's own release sweep — before it notices
+            # _stopped and exits.  Close the accounting on the way
+            # out (release is idempotent and allocator-locked, so
+            # racing stop()'s sweep is safe); crash exits already
+            # swept in _worker_died, and a live batcher never takes
+            # this branch.
+            with self._cv:
+                stopped = self._stopped
+            if stopped:
+                for slot in range(self.n_slots):
+                    self._release_slot_blocks(slot)
+                if self._prefix_cache is not None:
+                    self._prefix_cache.clear()
 
     def _worker_died(self, e: BaseException) -> None:
         """The loop crashed out: fail-fast every request with a TYPED
@@ -1948,13 +2246,20 @@ class ContinuousBatcher:
                 req.error = err
                 _req_mark(req, "worker_died", slot=slot)
                 _finish(req)
+        # the dead worker's device state dies with it: cached prefix
+        # rows are unreachable garbage — release the pins so the
+        # allocator balances to zero on this generation
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
 
     def _run_loop(self) -> None:
         # The one dispatched-but-unprocessed decode chunk: (packed device
-        # array, dispatch-time slot→request snapshot).  Invariant: no
-        # admission happens between that chunk's dispatch and its
-        # processing — the loop drains it before every admission round —
-        # so the snapshot's live entries are always current occupants.
+        # array, dispatch-time slot→request snapshot).  The snapshot is
+        # taken at DISPATCH time, so a prefill admitted between the
+        # chunk's dispatch and its processing (the disaggregated order)
+        # maps to slots the snapshot holds as None — the guard in
+        # _process_chunk delivers tokens only where the occupant is
+        # still the snapshot's request.
         pending: Optional[Tuple[jax.Array, List[Optional[_Request]]]] = None
         while True:
             self._beat = time_monotonic()
@@ -2001,39 +2306,18 @@ class ContinuousBatcher:
                 # on drain failure the device state was reset; the popped
                 # requests were never slot-resident, so admit them into
                 # the fresh state below
-            admitted = None
-            if pairs:
-                try:
-                    admitted = self._admit_round(pairs)
-                    if not admitted[0]:
-                        admitted = None
-                except Exception as e:
-                    # the round's dispatch died; the pool was donated
-                    # through it — fail in-flight and reset.  Requests
-                    # _admit_round already sent BACK to the queue
-                    # (block-starved) were never in the dispatch: they
-                    # stay queued for the next round, not failed here.
-                    log.exception("admission round failed; resetting")
-                    with self._cv:
-                        requeued = {id(r) for r in self._queue}
-                    for _slot, req in pairs:
-                        if id(req) in requeued:
-                            continue
-                        if not req.done.is_set():
-                            req.error = RuntimeError(f"prefill failed: {e!r}")
-                            _finish(req)
-                    self._fail_active(e)
-                    pending = None
-                    continue
-                finally:
-                    # every pair is slot-resident or finished by now —
-                    # drain() may judge quiescence again
-                    with self._cv:
-                        self._admitting = 0
-                        self._admitting_reqs = []
-                        self._cv.notify_all()
-            if not any(self._slot_req):
-                continue
+            # ---- disaggregated prefill/decode (docqa-prefix): the
+            # decode chunk for ALREADY-LIVE lanes is dispatched BEFORE
+            # this round's admission prefill, so a long prefill no
+            # longer sits between two decode chunks — live lanes keep
+            # their chunk cadence and the prefill (its own spine
+            # stream, scheduled below decode-class items) only delays
+            # the NEW requests' second chunk by one iteration.  On
+            # device the chunk is sequenced first through the donated
+            # pools, so an in-flight overshoot chunk's stale writes
+            # still land before any prefill that re-populates freed
+            # rows (the PR-9 re-use guarantee, order now explicit).
+            #
             # grow-at-decode: top up every live lane's block table to the
             # margin BEFORE dispatching (the in-program capacity guard
             # must never be what stops a live lane).  A lane the pool
@@ -2051,7 +2335,18 @@ class ContinuousBatcher:
                 if table.capacity >= target:
                     continue
                 try:
-                    table.ensure(target)
+                    try:
+                        table.ensure(target)
+                    except OutOfBlocks:
+                        if self._prefix_cache is None:
+                            raise
+                        # a live lane beats a cached idle prefix: evict
+                        # LRU pins and retry once before shedding typed
+                        self._prefix_cache.evict_for(
+                            self._alloc.blocks_for(target)
+                            - len(table.blocks)
+                        )
+                        table.ensure(target)
                     row = self._block_rows[slot]
                     row[: len(table.blocks)] = table.blocks
                     self._caps_np[slot] = table.capacity
@@ -2071,11 +2366,9 @@ class ContinuousBatcher:
                     self._retire(slot)
                     shed_slots.append(slot)
             if shed_slots:
-                # queued for the decode closure below (the worker never
+                # queued for the next device closure (the worker never
                 # issues device ops from its own thread)
                 self._deact_pending.extend(shed_slots)
-                if not any(self._slot_req):
-                    continue
             # one decode chunk for every live slot, dispatched BEFORE the
             # previous chunk's results are fetched — fetch + host work
             # below overlap this chunk's device execution
@@ -2128,20 +2421,82 @@ class ContinuousBatcher:
                     )
                 return out
 
-            try:
-                with span("serve_decode_dispatch", DEFAULT_REGISTRY):
-                    packed = spine_run("serve_decode", _decode_on_lane)
-            except Exception as e:
-                log.exception("decode dispatch failed; resetting slot state")
-                self._fail_active(e)
-                pending = None
-                continue
+            packed = snap = None
+            if any(self._slot_req):
+                # snapshot at DISPATCH time: slots this chunk advances.
+                # Lanes admitted by the prefill BELOW were free here —
+                # the chunk carries nothing for them, and the snapshot
+                # guard in _process_chunk drops any slot whose occupant
+                # changed (retired during finalize) either way.
+                snap = list(self._slot_req)
+                try:
+                    with span("serve_decode_dispatch", DEFAULT_REGISTRY):
+                        packed = spine_run("serve_decode", _decode_on_lane)
+                except Exception as e:
+                    log.exception(
+                        "decode dispatch failed; resetting slot state"
+                    )
+                    self._fail_active(e)
+                    pending = None
+                    continue
+            admitted = None
+            if pairs:
+                try:
+                    admitted = self._admit_round(pairs)
+                    if not admitted[0]:
+                        admitted = None
+                except Exception as e:
+                    # the round's dispatch died; the pool was donated
+                    # through it — fail in-flight and reset.  Requests
+                    # _admit_round already sent BACK to the queue
+                    # (block-starved) were never in the dispatch: they
+                    # stay queued for the next round, not failed here.
+                    # The chunk dispatched above chains into the same
+                    # poisoned pool lineage: drop it (its requests were
+                    # failed by the reset).
+                    log.exception("admission round failed; resetting")
+                    with self._cv:
+                        requeued = {id(r) for r in self._queue}
+                    for _slot, req in pairs:
+                        if id(req) in requeued:
+                            continue
+                        if not req.done.is_set():
+                            req.error = RuntimeError(f"prefill failed: {e!r}")
+                            _finish(req)
+                    self._fail_active(e)
+                    pending = None
+                    continue
+                finally:
+                    # every pair is slot-resident or finished by now —
+                    # drain() may judge quiescence again
+                    with self._cv:
+                        self._admitting = 0
+                        self._admitting_reqs = []
+                        self._cv.notify_all()
             ok = True
             if admitted is not None:
-                # overlaps the chunk: prefill output is already complete
+                # the first-token fetch blocks on the prefill, which the
+                # device sequences after the chunk above — host-side
+                # token bookkeeping for BOTH lands while the next
+                # iteration's work queues up
                 ok = self._finalize_admissions(admitted)
             if ok and pending is not None:
                 ok = self._process_chunk(*pending)
-            # snapshot AFTER finalize/processing: slots they retired are
-            # None here, so the overshoot chunk's tokens for them drop
-            pending = (packed, list(self._slot_req)) if ok else None
+            if ok and packed is None and any(self._slot_req):
+                # admission-only iteration (no lane was decoding when
+                # the chunk slot came up, so there was no cadence to
+                # protect): give the fresh lanes their first chunk NOW
+                # instead of one loop later — burst starts and
+                # idle-arrival requests keep the pre-split latency
+                snap = list(self._slot_req)
+                try:
+                    with span("serve_decode_dispatch", DEFAULT_REGISTRY):
+                        packed = spine_run("serve_decode", _decode_on_lane)
+                except Exception as e:
+                    log.exception(
+                        "decode dispatch failed; resetting slot state"
+                    )
+                    self._fail_active(e)
+                    pending = None
+                    continue
+            pending = (packed, snap) if ok and packed is not None else None

@@ -58,6 +58,14 @@ log = get_logger("docqa.spine")
 
 # serving-class streams get lane priority; everything else is background
 BACKGROUND_STREAMS = frozenset({"warmup", "probe", "rebuild", "background"})
+# the disaggregated admission lane (docqa-prefix): prefill work items
+# are serving-class but schedule BELOW decode-class items, so one
+# replica's long admission prefill cannot head-of-line block another
+# replica's decode chunks on the shared lanes.  An aged prefill head
+# (waited past this bound) promotes to serving priority — steady decode
+# load can delay admissions, never starve them.
+PREFILL_STREAMS = frozenset({"prefill"})
+PREFILL_MAX_WAIT_S = 0.1
 
 
 class SpineSaturated(RuntimeError):
@@ -170,8 +178,11 @@ class DispatchSpine:
         self.inline = bool(inline)
         self.name = name
         self._cv = threading.Condition()
-        # two FIFO queues: serving-class items always beat background
+        # three FIFO queues: decode/serving-class items beat prefill
+        # items (unless the prefill head has aged — see PREFILL_STREAMS)
+        # and both beat background
         self._ready: collections.deque = collections.deque()
+        self._ready_pf: collections.deque = collections.deque()
         self._ready_bg: collections.deque = collections.deque()
         self._busy = 0
         self._busy_bg = 0
@@ -232,8 +243,20 @@ class DispatchSpine:
                 item = None
                 while item is None:
                     gate = not self._strict or self._busy == 0
-                    if self._ready and gate:
+                    # prefill lane discipline: an aged prefill head wins
+                    # over fresh decode items (no starvation); otherwise
+                    # decode/serving work always runs first
+                    pf_aged = bool(self._ready_pf) and (
+                        _now() - self._ready_pf[0].t_submit
+                        > PREFILL_MAX_WAIT_S
+                    )
+                    if self._ready and gate and not pf_aged:
                         item = self._ready.popleft()
+                    elif self._ready_pf and gate:
+                        # covers both "serving queue empty" and the
+                        # aged-head promotion (pf_aged implies this
+                        # queue is non-empty)
+                        item = self._ready_pf.popleft()
                     elif self._ready_bg and gate and (
                         self._busy_bg < max(1, self.n_lanes - 1)
                         or self.n_lanes == 1
@@ -398,7 +421,10 @@ class DispatchSpine:
             self._submitted += 1
             run_inline = self.inline
             if not run_inline:
-                depth = len(self._ready) + len(self._ready_bg)
+                depth = (
+                    len(self._ready) + len(self._ready_pf)
+                    + len(self._ready_bg)
+                )
                 if depth >= self.max_depth:
                     self._submitted -= 1
                     raise SpineSaturated(
@@ -406,6 +432,8 @@ class DispatchSpine:
                     )
                 if stream in BACKGROUND_STREAMS:
                     self._ready_bg.append(item)
+                elif stream in PREFILL_STREAMS:
+                    self._ready_pf.append(item)
                 else:
                     self._ready.append(item)
                 self._peak_depth = max(self._peak_depth, depth + 1)
@@ -474,7 +502,7 @@ class DispatchSpine:
 
     def _cancel(self, item: _Item) -> bool:
         with self._cv:
-            for q in (self._ready, self._ready_bg):
+            for q in (self._ready, self._ready_pf, self._ready_bg):
                 try:
                     q.remove(item)
                 except ValueError:
@@ -498,7 +526,10 @@ class DispatchSpine:
     @property
     def queue_depth(self) -> int:
         with self._cv:
-            return len(self._ready) + len(self._ready_bg)
+            return (
+                len(self._ready) + len(self._ready_pf)
+                + len(self._ready_bg)
+            )
 
     @property
     def occupancy(self) -> float:
@@ -515,7 +546,10 @@ class DispatchSpine:
             }
             completed, errors = self._completed, self._errors
         with self._cv:
-            depth = len(self._ready) + len(self._ready_bg)
+            depth = (
+                len(self._ready) + len(self._ready_pf)
+                + len(self._ready_bg)
+            )
             busy, busy_bg = self._busy, self._busy_bg
             n_lanes, max_depth = self.n_lanes, self.max_depth
             inline, peak = self.inline, self._peak_depth
@@ -543,11 +577,16 @@ class DispatchSpine:
     def telemetry_gauges(self) -> Dict[str, float]:
         """Live gauges for the telemetry sampler (``dispatch_*``)."""
         with self._cv:
-            depth = len(self._ready) + len(self._ready_bg)
+            depth = (
+                len(self._ready) + len(self._ready_pf)
+                + len(self._ready_bg)
+            )
+            pf_depth = len(self._ready_pf)
             busy, busy_bg = self._busy, self._busy_bg
             n_lanes = self.n_lanes
         return {
             "dispatch_queue_depth": float(depth),
+            "dispatch_prefill_queue_depth": float(pf_depth),
             "dispatch_occupancy": busy / n_lanes,
             "dispatch_lanes": float(n_lanes),
             "dispatch_busy_background": float(busy_bg),
@@ -583,8 +622,12 @@ class DispatchSpine:
         with self._cv:
             if not self._closed:
                 self._closed = True
-                queued = list(self._ready) + list(self._ready_bg)
+                queued = (
+                    list(self._ready) + list(self._ready_pf)
+                    + list(self._ready_bg)
+                )
                 self._ready.clear()
+                self._ready_pf.clear()
                 self._ready_bg.clear()
                 self._cv.notify_all()
                 t_close = _now()

@@ -651,6 +651,10 @@ class TelemetrySampler:
                 "blocks_total", "blocks_used", "block_size",
                 "bytes_per_token", "pool_bytes", "used_bytes",
                 "tokens_committed", "utilization",
+                # prefix-cache occupancy (docqa-prefix): entries, the
+                # blocks the cache pins, and the lifetime hit economics
+                "prefix_entries", "prefix_blocks", "prefix_hit_rate",
+                "prefix_tokens_avoided",
             ):
                 if key in occ:
                     rec(f"serve_kv_{key}", float(occ[key]), now=now)

@@ -115,7 +115,10 @@ RAGGED_ALIGN = 128
 
 
 def ragged_prefill_attention(q, k, v, seg_ids, positions, *,
-                             sliding_window=None, scale=None):
+                             sliding_window=None, scale=None,
+                             k_pool=None, v_pool=None, block_tables=None,
+                             prefix_lens=None, n_prefix_rows=0,
+                             block_size=None):
     """Self-attention over a PACKED batch of variable-length prompts —
     the prefill half of Ragged Paged Attention, XLA reference path.
 
@@ -139,6 +142,23 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, *,
     the same [T] axis), so the block split cannot perturb greedy
     outputs.  The Pallas RPA kernel that also skips cross-segment
     blocks entirely is the TPU follow-up.
+
+    WARM mode (``n_prefix_rows > 0``, the copy-on-write prefix-cache
+    path, docqa-prefix): each segment may additionally attend a CACHED
+    prompt prefix read from the paged KV pool through its block table.
+    ``positions`` then start at the segment's prefix length, and the key
+    axis becomes ``[n_prefix_rows ; T]`` — per query block, the owning
+    lane's first ``prefix_lens[lane]`` pool rows are gathered in
+    position order ahead of the packed keys.  Because a shared prefix is
+    RAGGED_ALIGN-aligned (engines/paged.py ``share_alignment``), every
+    valid key keeps its position residue mod the alignment, and the
+    pool's stored K/V are the very bf16 values a cold prefill would
+    compute in-flight — so the softmax reduction trees, and therefore
+    the sampled tokens, are bitwise identical to prefilling the whole
+    prompt cold.  ``n_prefix_rows`` is a static shape (the sequence
+    capacity); unused rows are masked.  Segment starts must be aligned
+    (each query block then belongs to exactly one segment, so one block
+    table row serves the whole block).
     """
     t, hq, d = q.shape
     _, hkv, _ = k.shape
@@ -153,9 +173,22 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, *,
         vf = jnp.repeat(vf, groups, axis=1)
 
     valid = seg_ids >= 0
+    # n_prefix_rows is a STATIC host int (the batcher's seq capacity) —
+    # never a tracer; no cast so the jit-purity host-sync rule stays
+    # meaningful here
+    warm = n_prefix_rows > 0
+    if warm:
+        if t % RAGGED_ALIGN:
+            raise ValueError(
+                "warm ragged prefill needs a RAGGED_ALIGN-multiple "
+                f"packed axis (got T={t})"
+            )
+        n_blocks = k_pool.shape[0] // block_size
+        pool_rows = k_pool.shape[0]
+        pfx_cols = jnp.arange(n_prefix_rows)
 
     def attend_rows(row_idx):
-        """One query block: rows ``row_idx`` [bq] against all T keys."""
+        """One query block: rows ``row_idx`` [bq] against all keys."""
         qb = qf[row_idx]  # [bq, hq, d]
         seg_q = seg_ids[row_idx]
         pos_q = positions[row_idx]
@@ -168,11 +201,58 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, *,
         if sliding_window is not None:
             mask &= positions[None, :] > pos_q[:, None] - sliding_window
         mask = mask[None, :, :]  # [1, bq, T]
-        scores = jnp.where(mask, scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        # fully-masked rows (padding) output zeros, like the dense path
-        probs = jnp.where(jnp.any(mask, axis=-1, keepdims=True), probs, 0.0)
-        return jnp.einsum("hqk,khd->qhd", probs, vf)  # [bq, hq, d]
+        if not warm:
+            scores = jnp.where(mask, scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1)
+            # fully-masked rows (padding) output zeros, like the dense
+            # path
+            probs = jnp.where(
+                jnp.any(mask, axis=-1, keepdims=True), probs, 0.0
+            )
+            return jnp.einsum("hqk,khd->qhd", probs, vf)  # [bq, hq, d]
+        # ---- warm: prepend the lane's cached prefix K/V (pool rows in
+        # position order) to the key axis.  Aligned segment starts mean
+        # this whole query block belongs to ONE lane (or is padding).
+        lane = jnp.max(seg_q)  # -1 when the block is all padding
+        lane_c = jnp.maximum(lane, 0)
+        row_tab = jax.lax.dynamic_index_in_dim(
+            block_tables, lane_c, axis=0, keepdims=False
+        )  # [NB]
+        blk = row_tab[pfx_cols // block_size]
+        rows = jnp.minimum(
+            blk * block_size + pfx_cols % block_size, pool_rows - 1
+        )
+        kp = k_pool[rows].astype(jnp.float32)  # [PFX, hkv, d]
+        vp = v_pool[rows].astype(jnp.float32)
+        if groups > 1:
+            kp = jnp.repeat(kp, groups, axis=1)
+            vp = jnp.repeat(vp, groups, axis=1)
+        plen = jax.lax.dynamic_index_in_dim(
+            prefix_lens, lane_c, axis=0, keepdims=False
+        )
+        scores_p = jnp.einsum("qhd,khd->hqk", qb, kp)  # [hq, bq, PFX]
+        mask_p = (
+            (lane >= 0)
+            & valid[row_idx][:, None]
+            & (pfx_cols[None, :] < plen)
+            & (blk[None, :] < n_blocks)
+            & (pfx_cols[None, :] <= pos_q[:, None])
+        )
+        if sliding_window is not None:
+            mask_p &= pfx_cols[None, :] > pos_q[:, None] - sliding_window
+        mask_p = mask_p[None, :, :]  # [1, bq, PFX]
+        # ONE flat softmax over [prefix ; packed] in position order:
+        # masked rows contribute exact zeros, and alignment keeps every
+        # valid key's reduction-tile residue — bitwise equal to cold
+        full_scores = jnp.concatenate([scores_p, scores], axis=-1)
+        full_mask = jnp.concatenate([mask_p, mask], axis=-1)
+        full_scores = jnp.where(full_mask, full_scores, NEG_INF)
+        probs = jax.nn.softmax(full_scores, axis=-1)
+        probs = jnp.where(
+            jnp.any(full_mask, axis=-1, keepdims=True), probs, 0.0
+        )
+        vcat = jnp.concatenate([vp, vf], axis=0)  # [PFX + T, hq, d]
+        return jnp.einsum("hqk,khd->qhd", probs, vcat)
 
     if t % RAGGED_ALIGN or t <= RAGGED_ALIGN:
         out = attend_rows(jnp.arange(t))

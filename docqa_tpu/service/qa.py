@@ -17,6 +17,7 @@ never provided (SURVEY §1 "aspirational API layer"):
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -43,6 +44,25 @@ QA_TEMPLATE = (
     "dis-le explicitement.\n\n"
     "Contexte:\n{context}\n\nQuestion: {question}\n\nRéponse:"
 )
+
+# Template half of the prefix-cache key (docqa-prefix): stamped once per
+# process so a template edit invalidates every cached prefix by key.
+_TEMPLATE_HASH = hashlib.sha1(QA_TEMPLATE.encode("utf-8")).hexdigest()[:12]
+
+
+def prefix_key_for(chunks: List[str]) -> str:
+    """The (template hash, retrieved-chunk-set hash) prefix-cache /
+    session-affinity key: consecutive questions against the SAME
+    retrieved chunk set — the repeat-heavy clinical pattern — share the
+    whole template+context prompt prefix, which is exactly what the
+    batcher's KV prefix cache can serve without re-prefilling.  The
+    chunk hash is order-sensitive (context order changes the prompt
+    tokens, so a reordered set must not key the same entry)."""
+    h = hashlib.sha1()
+    for c in chunks:
+        h.update(c.encode("utf-8", "surrogatepass"))
+        h.update(b"\x1f")
+    return f"{_TEMPLATE_HASH}:{h.hexdigest()[:16]}"
 
 
 def extractive_answer(chunks: List[str], max_chars: int = 600) -> str:
@@ -290,8 +310,13 @@ class QAService:
             faults.perturb("decoder")  # resilience_site: decoder
             if self.batcher is not None:
                 # deadline passed only when set: batcher stand-ins (tests,
-                # alternative schedulers) need not know the kwarg
+                # alternative schedulers) need not know the kwarg.  Same
+                # opt-in for the prefix key: only a batcher that
+                # advertises a prefix cache receives it (it doubles as
+                # the pool's session-affinity key).
                 kw = {} if deadline is None else {"deadline": deadline}
+                if getattr(self.batcher, "prefix_cache_enabled", False):
+                    kw["prefix_key"] = prefix_key_for(chunks)
                 return PendingAnswer(
                     sources=sources,
                     handle=self.batcher.submit_text(prompt, **kw),

@@ -145,10 +145,18 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
         replicas=2,
         n_slots=2,
         chunk=4,
-        cache_len=128,
+        # 256: large enough that the 128-aligned KV prefix cache is
+        # ENABLED (docqa-prefix) — the chaos windows then exercise
+        # refcounted shared blocks under crash/wedge/drain failover,
+        # and the exact-accounting assertion below has teeth
+        cache_len=256,
         # tight liveness so the smoke's wedge window is seconds, not the
-        # production minute (every shape is pre-warmed below)
-        heartbeat_max_age_s=1.0,
+        # production minute (every shape is pre-warmed below).  2.5 s —
+        # not the old 1.0 — because the repeat-heavy 140-token prompts
+        # and the warm-family warmup compiles stretch legitimate worker
+        # iterations on the strict-serialized CPU spine; the injected
+        # wedge delay below stays comfortably past this bound.
+        heartbeat_max_age_s=2.5,
         canary_interval_s=0.5,
         canary_timeout_s=5.0,
         health_interval_s=0.05,
@@ -156,15 +164,36 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
     )
     outcomes: list = []
     lock = threading.Lock()
+    # every batcher generation the pool ever runs (rebuilds swap fresh
+    # ones in): the exact-accounting sweep below must balance them ALL
+    seen_batchers = []
+
+    def _track_batchers():
+        for r in pool._replicas:
+            if r.batcher not in seen_batchers:
+                seen_batchers.append(r.batcher)
+
+    # repeat-heavy prompts (docqa-prefix): three "patients" per wave,
+    # each with a 140-token shared context — consecutive questions
+    # against one context share a 128-aligned prefix, so the chaos
+    # windows kill/wedge/drain replicas while REFCOUNTED shared blocks
+    # are live in slot tables AND pinned by the cache
+    patient_ctx = [
+        [(3 + p * 11 + i * 7) % 120 + 1 for i in range(140)]
+        for p in range(3)
+    ]
 
     def submit_wave(tag: str, n: int, deadline_s: float = 30.0):
         waiters = []
+        _track_batchers()
         for i in range(n):
+            pid = i % len(patient_ctx)
             try:
                 h = pool.submit_ids(
-                    [3 + i % 13, 5, 9, 4 + i % 3],
+                    patient_ctx[pid] + [3 + i % 13, 5, 9, 4 + i % 3],
                     max_new_tokens=6,
                     deadline=Deadline.after(deadline_s),
+                    prefix_key=f"chaos-{pid}",
                 )
             except (QueueFull, DeadlineExceeded) as e:
                 with lock:
@@ -210,7 +239,7 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
         plan = FaultPlan(
             [
                 FaultRule(
-                    "serve.worker_loop", at_steps=(4,), delay_s=2.5,
+                    "serve.worker_loop", at_steps=(4,), delay_s=5.0,
                     raise_error=False,
                 )
             ],
@@ -233,8 +262,39 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
             w.join()
     finally:
         status = pool.status()
+        _track_batchers()
+        prefix_stats = {"hits": 0.0, "tokens_avoided": 0.0}
+        for b in seen_batchers:
+            cache = getattr(b, "_prefix_cache", None)
+            if cache is not None:
+                st = cache.stats()
+                prefix_stats["hits"] += st["hits"]
+                prefix_stats["tokens_avoided"] += st["tokens_avoided"]
         sampler.stop()
         pool.stop()
+
+    # exact block accounting under refcounted sharing: every batcher
+    # generation (including killed/rebuilt ones) must balance to ZERO
+    # live blocks after stop — a shared release that double-freed would
+    # have raised; one that leaked shows up right here
+    leaked = {
+        i: b._alloc.blocks_in_use
+        for i, b in enumerate(seen_batchers)
+        if b._alloc.blocks_in_use
+    }
+    if leaked:
+        print(
+            f"BLOCK ACCOUNTING LEAK under prefix sharing: {leaked} "
+            f"(batcher index -> blocks still live)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"prefix sharing exercised: {int(prefix_stats['hits'])} warm "
+        f"hit(s), {int(prefix_stats['tokens_avoided'])} prefill tokens "
+        f"avoided; {len(seen_batchers)} batcher generation(s) balanced "
+        "to zero live blocks"
+    )
 
     hung = [o for o in outcomes if o[2] == "HUNG"]
     untyped = [o for o in outcomes if o[2] == "untyped"]

@@ -137,17 +137,51 @@ def measure(telemetry_out: str | None = None) -> dict:
         metrics["load_qps"] = round(n_req / wall, 2)
         metrics["load_p50_ms"] = round(float(np.percentile(lat, 50)), 1)
         metrics["load_p95_ms"] = round(float(np.percentile(lat, 95)), 1)
+        # repeat-heavy warm-prefix smoke (docqa-prefix): one session's
+        # context asked N consecutive questions — the deterministic CPU
+        # analogue of bench's prefix_reuse section.  The first question
+        # resolves ALONE (cold: it inserts the prefix), then the rest
+        # run concurrently and must all warm-hit; a silent cache
+        # regression shows up as this hit rate collapsing (structural
+        # gate, not a timing).
+        ctx = [(3 + i * 7) % 250 + 1 for i in range(160)]
+        hits0 = DEFAULT_REGISTRY.counter("serve_prefix_hits").value
+        av0 = DEFAULT_REGISTRY.counter("serve_prefix_tokens_avoided").value
+        b.submit_ids(
+            ctx + [5, 9], max_new_tokens=8, prefix_key="smoke-patient"
+        ).result()
+        n_warm = 5
+        warm_handles = [
+            b.submit_ids(
+                ctx + [6 + q, 4], max_new_tokens=8,
+                prefix_key="smoke-patient",
+            )
+            for q in range(n_warm)
+        ]
+        for h in warm_handles:
+            h.result()
+        hits = DEFAULT_REGISTRY.counter("serve_prefix_hits").value - hits0
+        metrics["warm_prefix_hit_rate"] = round(hits / n_warm, 3)
+        metrics["warm_prefill_tokens_avoided"] = int(
+            DEFAULT_REGISTRY.counter("serve_prefix_tokens_avoided").value
+            - av0
+        )
+
         # paged-KV ratchet (docqa-paged): per-token KV bytes (block
         # granularity — a regression back to per-bucket reservation
         # shows up as this growing) and the batcher's whole compiled
-        # program count (ragged prefill budgets + decode chunk; the
-        # pre-paged matrix was 2 families x buckets)
+        # program count (ragged prefill budgets, cold + warm prefix
+        # family, + decode chunk; the pre-paged matrix was 2 families x
+        # buckets)
         from docqa_tpu.analysis.compile_audit import jit_cache_size
 
         occ = b.kv_block_occupancy()
         metrics["kv_bytes_per_token"] = occ["bytes_per_token"]
+        warm_fn = getattr(b, "_prefill_warm_fn", None)
         metrics["serve_compiled_programs"] = int(
-            jit_cache_size(b._prefill_fn) + jit_cache_size(b._decode_fn)
+            jit_cache_size(b._prefill_fn)
+            + (jit_cache_size(warm_fn) if warm_fn is not None else 0)
+            + jit_cache_size(b._decode_fn)
         )
     finally:
         if sampler is not None:
@@ -327,6 +361,11 @@ def write_baseline(
         # only move when the KV layout or the compile matrix changes
         "kv_bytes_per_token": ("lower", 10),
         "serve_compiled_programs": ("lower", 10),
+        # structural prefix-cache gates (docqa-prefix): the smoke's
+        # warm phase is deterministic, so a silent cache regression
+        # (hit rate or avoided-token collapse) is a red build
+        "warm_prefix_hit_rate": ("higher", 10),
+        "warm_prefill_tokens_avoided": ("higher", 10),
     }
     # context-only outputs (exact token counts, sample sizes) are for
     # humans reading the report, not latency budgets

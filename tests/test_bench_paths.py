@@ -141,6 +141,45 @@ class TestBenchLoadSweepShapes:
         assert vals and max(vals) > 0  # peak occupancy was observable
         assert max(vals) <= occ["blocks_total"]
 
+    def test_prefix_reuse_ab_call_shape(self):
+        """bench prefix_reuse section: the SAME repeat-heavy session mix
+        through two batchers (sharing disabled, then enabled) with the
+        serve_prefix_* counter deltas the section reports — the exact
+        API sequence at toy size.  The enabled arm must record warm
+        hits; the disabled arm must record none."""
+        from docqa_tpu.engines.generate import GenerateEngine
+        from docqa_tpu.engines.serve import ContinuousBatcher
+        from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY
+
+        eng = GenerateEngine(
+            TINY, GenerateConfig(max_new_tokens=8, prefill_buckets=(16,))
+        )
+        ctx = [(3 + i * 7) % 60 + 1 for i in range(140)]
+        mix = [(ctx + [5 + q], "bench-patient-0") for q in range(4)]
+        hits = {}
+        for label, enabled in (("off", False), ("on", True)):
+            b = ContinuousBatcher(
+                eng, n_slots=2, chunk=8, cache_len=256,
+                prefix_cache=enabled,
+            )
+            h0 = DEFAULT_REGISTRY.counter("serve_prefix_hits").value
+            try:
+                assert b.prefix_cache_enabled is enabled
+                # sequential like a session: later questions can hit
+                for p, key in mix:
+                    out = b.submit_ids(
+                        p, max_new_tokens=8, prefix_key=key
+                    ).result(timeout=120)
+                    assert len(out) <= 8
+            finally:
+                b.stop()
+            hits[label] = (
+                DEFAULT_REGISTRY.counter("serve_prefix_hits").value - h0
+            )
+            assert b._alloc.blocks_in_use == 0
+        assert hits["off"] == 0
+        assert hits["on"] >= len(mix) - 1
+
     def test_delta_windowed_histogram_math(self):
         """bench 5b's serve_tokens_per_chunk delta-mean formula."""
         from docqa_tpu.runtime.metrics import Histogram

@@ -251,19 +251,28 @@ class TestLiveWorkloads:
     runs in full; the rest ride scripts/compile_audit.py in CI."""
 
     def test_serve_workload_paged_contract(self):
-        """The docqa-paged headline: the batcher's WHOLE compile matrix
-        is <= 3 programs (ragged token budgets + one decode chunk), with
-        mixed prompt lengths sharing the warm programs retrace-free —
-        the pre-paged matrix was (2 shape families x buckets) = 4 at
-        this audit config."""
+        """The docqa-paged headline, extended by docqa-prefix: the
+        batcher's WHOLE compile matrix is bounded by the token budgets
+        — one COLD + one WARM (prefix-gather) prefill program per
+        budget plus the one decode chunk — with mixed prompt lengths
+        AND warm-prefix re-admissions sharing the warm programs
+        retrace-free.  The pre-paged matrix was (2 shape families x
+        buckets) = 4 at this audit config."""
         result = ca._AUDITS["serve"]()
         prefill = result["roots"]["serve_prefill"]
+        warm = result["roots"]["serve_prefill_warm"]
         decode = result["roots"]["serve_decode"]
         assert result["meta"]["paged"] is True
+        assert result["meta"]["prefix_cache"] is True
         n_buckets = len(result["meta"]["token_buckets"])
         assert prefill["compiles"] == prefill["expected_shapes"] == n_buckets
-        assert prefill["compiles"] + decode["compiles"] <= 3
+        assert warm["compiles"] == warm["expected_shapes"] == n_buckets
+        assert (
+            prefill["compiles"] + warm["compiles"] + decode["compiles"]
+            <= 2 * n_buckets + 1
+        )
         assert prefill["steady_state_retraces"] == 0
+        assert warm["steady_state_retraces"] == 0
         assert decode["compiles"] == 1
         assert decode["steady_state_retraces"] == 0
         # per-token KV accounting rides the meta (block granularity)

@@ -1,0 +1,422 @@
+"""Copy-on-write KV prefix sharing (docqa-prefix).
+
+The contracts that matter:
+
+* allocator refcount accounting is exact under sharing — a shared-block
+  release DECREMENTS instead of freeing, a double free still RAISES,
+  and copy-on-write growth never hands out (or mutates) a block another
+  table still references;
+* warm output is bitwise token-equal to cold: the same prompt answered
+  through a cache hit matches both a cold batcher run and the solo
+  engine (the 128-aligned split + full-block immutability contract);
+* zero leaked blocks after drain / steal / worker death / stop with a
+  WARM cache — the cache's pins release exactly once alongside the slot
+  tables;
+* LRU eviction under BlockPoolExhausted pressure frees cached-but-idle
+  prefixes before live work is shed;
+* pool routing is session-affine: a prefix key prefers its hashed
+  replica, falling back to least-queued.
+"""
+
+import threading
+import time
+
+import pytest
+
+from docqa_tpu.config import DecoderConfig, GenerateConfig
+from docqa_tpu.engines.generate import GenerateEngine
+from docqa_tpu.engines.paged import (
+    BlockAllocator,
+    OutOfBlocks,
+    PrefixCache,
+    share_alignment,
+)
+from docqa_tpu.engines.serve import ContinuousBatcher
+
+CFG = DecoderConfig(
+    vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=512,
+    dtype="float32",
+)
+GEN = GenerateConfig(temperature=0.0, eos_id=2)
+
+ALIGN = share_alignment(16)  # 128 for the default 16-token blocks
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GenerateEngine(CFG, GEN, seed=7)
+
+
+def _ctx(n=200, seed=3):
+    return [(seed + i * 7) % 120 + 1 for i in range(n)]
+
+
+class TestRefcountedAllocator:
+    def test_shared_release_is_not_a_free(self):
+        a = BlockAllocator(n_blocks=8, block_size=4)
+        owner = a.new_table()
+        owner.ensure(8)  # 2 blocks
+        shared_ids = list(owner.blocks)
+        t2 = a.new_table()
+        a.share(t2, shared_ids)
+        assert a.refcount(shared_ids[0]) == 2
+        assert a.blocks_in_use == 2  # unique blocks, not references
+        # releasing ONE referencing table must not free the blocks
+        t2.release()
+        assert a.refcount(shared_ids[0]) == 1
+        assert a.blocks_in_use == 2
+        owner.release()
+        assert a.blocks_in_use == 0 and a.n_free == 8
+
+    def test_double_free_still_raises_under_sharing(self):
+        a = BlockAllocator(n_blocks=4, block_size=4)
+        owner = a.new_table()
+        owner.ensure(8)
+        stolen = list(owner.blocks)
+        t2 = a.new_table()
+        a.share(t2, stolen)
+        t2.release()
+        owner.release()  # refcount hits 0: blocks free
+        forged = a.new_table()
+        forged.blocks = stolen
+        with pytest.raises(RuntimeError, match="double free"):
+            forged.release()
+
+    def test_share_of_free_block_raises(self):
+        a = BlockAllocator(n_blocks=4, block_size=4)
+        t = a.new_table()
+        t.ensure(4)
+        freed = list(t.blocks)
+        t.release()
+        fresh = a.new_table()
+        with pytest.raises(RuntimeError, match="share of a free block"):
+            a.share(fresh, freed)
+
+    def test_cow_grow_never_hands_out_shared_blocks(self):
+        """Copy-on-write realized as never-write-shared: while a block
+        is referenced (refcount >= 1), no grow() on ANY table may be
+        handed that block id — so a suffix/decode write can never land
+        in a shared prefix block."""
+        a = BlockAllocator(n_blocks=8, block_size=4)
+        owner = a.new_table()
+        owner.ensure(8)
+        shared_ids = set(owner.blocks)
+        warm = a.new_table()
+        a.share(warm, list(owner.blocks))
+        owner.release()  # cache-analogue pin (warm) keeps them alive
+        grower = a.new_table()
+        grower.ensure(16)  # 4 of the 6 remaining blocks
+        assert shared_ids.isdisjoint(grower.blocks)
+        warm.ensure(16)  # warm table grows PRIVATE blocks past the prefix
+        assert set(warm.blocks[warm.n_shared:]).isdisjoint(shared_ids)
+        with pytest.raises(OutOfBlocks):
+            a.new_table().ensure(4)  # pool dry; shared blocks NOT free
+        grower.release()
+        warm.release()
+        assert a.blocks_in_use == 0 and a.n_free == 8
+
+
+class TestPrefixCache:
+    def test_verified_aligned_acquire_and_suffix_floor(self):
+        a = BlockAllocator(n_blocks=64, block_size=16)
+        cache = PrefixCache(a, ALIGN, max_entries=4)
+        ids = _ctx(2 * ALIGN + 7)
+        t = a.new_table()
+        t.ensure(len(ids))
+        assert cache.insert("k", ids, t)
+        # exact-key, diverging tail: shares the verified aligned run
+        warm = a.new_table()
+        got = cache.acquire("k", ids[: 2 * ALIGN] + [9, 9, 9], warm)
+        assert got == 2 * ALIGN
+        assert warm.n_shared == 2 * ALIGN // 16
+        warm.release()
+        # prompt exactly the cached run: one align unit held back so
+        # the suffix keeps >= 1 real token for the prefill head
+        warm2 = a.new_table()
+        assert cache.acquire("k", ids[: 2 * ALIGN], warm2) == ALIGN
+        warm2.release()
+        # token mismatch inside the first align unit = miss, never
+        # wrong attention (collision safety)
+        warm3 = a.new_table()
+        assert cache.acquire("k", [5] + ids[1:], warm3) == 0
+        warm3.release()
+        t.release()
+        cache.clear()
+        assert a.blocks_in_use == 0
+
+    def test_lru_eviction_frees_only_cache_pinned_blocks(self):
+        a = BlockAllocator(n_blocks=16, block_size=16)  # 256 tokens
+        cache = PrefixCache(a, ALIGN, max_entries=4)
+        t1 = a.new_table()
+        t1.ensure(ALIGN)
+        cache.insert("hot", _ctx(ALIGN, 1), t1)
+        t2 = a.new_table()
+        t2.ensure(ALIGN)
+        cache.insert("cold", _ctx(ALIGN, 2), t2)
+        t2.release()  # "cold" now pinned by the cache alone
+        assert a.n_free == 0
+        # pressure: evicts LRU entries until the request could fit;
+        # "hot"'s blocks stay live (t1 still references them)
+        evicted = cache.evict_for(8)
+        assert evicted >= 1
+        assert a.n_free >= 8
+        assert not t1.released and a.refcount(t1.blocks[0]) >= 1
+        t1.release()
+        cache.clear()
+        assert a.blocks_in_use == 0
+
+
+class TestWarmColdEquality:
+    def test_warm_equals_cold_equals_solo(self, engine):
+        """The acceptance gate: a warm (cache-hit) admission emits
+        bitwise the same tokens as a cold batcher admission AND the
+        solo engine — for both the session's repeat question shape and
+        a diverging-tail question."""
+        ctx = _ctx(300)
+        prompts = [ctx + [5, 9, 11], ctx + [8, 4], ctx + [77]]
+        solo = [
+            engine.generate_ids([p], max_new_tokens=32)[0] for p in prompts
+        ]
+        # cold reference run: caching off entirely
+        b_cold = ContinuousBatcher(
+            engine, n_slots=2, chunk=4, cache_len=512, prefix_cache=False
+        )
+        try:
+            cold = [
+                b_cold.submit_ids(p, max_new_tokens=32).result(timeout=300)
+                for p in prompts
+            ]
+        finally:
+            b_cold.stop()
+        b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=512)
+        try:
+            warm = [
+                b.submit_ids(
+                    p, max_new_tokens=32, prefix_key="patient-7"
+                ).result(timeout=300)
+                for p in prompts
+            ]
+            st = b._prefix_cache.stats()
+            assert st["hits"] >= 2 and st["tokens_avoided"] >= 2 * ALIGN
+        finally:
+            b.stop()
+        assert warm == cold == solo
+        assert b._alloc.blocks_in_use == 0
+
+    def test_concurrent_warm_batch_matches_solo(self, engine):
+        """A batched round of mixed warm+cold lanes (one packed warm
+        dispatch + cold group) still matches solo token-for-token."""
+        ctx = _ctx(260, seed=11)
+        session = [ctx + [10 + i] for i in range(4)]
+        foreign = [[3, 5, 9 + i] for i in range(2)]
+        b = ContinuousBatcher(engine, n_slots=4, chunk=4, cache_len=512)
+        try:
+            # seed the cache, then a concurrent mixed burst
+            b.submit_ids(
+                session[0], max_new_tokens=16, prefix_key="s"
+            ).result(timeout=300)
+            handles = [
+                b.submit_ids(p, max_new_tokens=16, prefix_key="s")
+                for p in session[1:]
+            ] + [
+                b.submit_ids(p, max_new_tokens=16) for p in foreign
+            ]
+            got = [h.result(timeout=300) for h in handles]
+        finally:
+            b.stop()
+        want = [
+            engine.generate_ids([p], max_new_tokens=16)[0]
+            for p in session[1:] + foreign
+        ]
+        assert got == want
+        assert b._alloc.blocks_in_use == 0
+
+
+class TestWarmCacheLifecycle:
+    def test_zero_leak_after_drain_with_warm_cache(self, engine):
+        b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=256)
+        try:
+            ctx = _ctx(150)
+            for i in range(4):
+                b.submit_ids(
+                    ctx + [5 + i], max_new_tokens=8, prefix_key="p"
+                ).result(timeout=300)
+            assert b._prefix_cache.stats()["hits"] >= 1
+            assert b.drain(timeout=120)
+            # drained but alive: the warm cache legitimately keeps its
+            # pins (that is the point — the next session question hits);
+            # live blocks == exactly the cache's pinned blocks
+            st = b._prefix_cache.stats()
+            assert b._alloc.blocks_in_use == st["pinned_blocks"] > 0
+            b.resume()
+        finally:
+            b.stop()
+        # stop() closes the accounting, cache pins included
+        assert b._alloc.blocks_in_use == 0
+
+    def test_zero_leak_after_kill_and_worker_death_warm(self, engine):
+        for mode in ("kill", "death"):
+            b = ContinuousBatcher(
+                engine, n_slots=2, chunk=4, cache_len=256, max_queue=16
+            )
+            ctx = _ctx(150)
+            b.submit_ids(
+                ctx + [5], max_new_tokens=8, prefix_key="p"
+            ).result(timeout=300)
+            handles = [
+                b.submit_ids(
+                    ctx + [6 + i], max_new_tokens=60, prefix_key="p"
+                )
+                for i in range(4)
+            ]
+            deadline = time.monotonic() + 30
+            while not b._alloc.blocks_in_use and time.monotonic() < deadline:
+                time.sleep(0.002)
+            if mode == "kill":
+                b.kill(RuntimeError("wedged"))
+                # kill() never joins (the worker may be wedged); here it
+                # is merely mid-round — wait it out so the worker-exit
+                # sweep (the kill-vs-in-flight-admission accounting
+                # close) has run before asserting
+                b._worker.join(timeout=60)
+                assert not b._worker.is_alive()
+            else:
+                t = threading.Thread(
+                    target=b._worker_died, args=(RuntimeError("crash"),)
+                )
+                t.start()
+                t.join(timeout=30)
+                b._stopped = True
+                with b._cv:
+                    b._cv.notify_all()
+                b._worker.join(timeout=60)  # its exit sweep closes books
+            for h in handles:
+                with pytest.raises(Exception):
+                    h.result(timeout=10)
+            assert b._alloc.blocks_in_use == 0, mode
+
+    def test_eviction_under_pool_pressure_before_shedding(self, engine):
+        """A dry pool whose only free-able HBM is cached idle prefixes
+        must evict them and ADMIT the new request instead of shedding."""
+        b = ContinuousBatcher(
+            engine, n_slots=2, chunk=4, cache_len=256, kv_block_size=16,
+            kv_pool_tokens=256,  # one maximal lane's worth
+        )
+        try:
+            ctx = _ctx(150)
+            b.submit_ids(
+                ctx + [5], max_new_tokens=4, prefix_key="p"
+            ).result(timeout=300)
+            st = b._prefix_cache.stats()
+            assert st["pinned_blocks"] > 0  # cache holds pool HBM
+            # a foreign near-maximal prompt needs more than the free
+            # remainder: the cache must give its pins back
+            big = _ctx(200, seed=5)
+            out = b.submit_ids(big, max_new_tokens=4).result(timeout=300)
+            assert len(out) > 0
+            assert b._prefix_cache.stats()["evictions"] >= 1
+        finally:
+            b.stop()
+        assert b._alloc.blocks_in_use == 0
+
+
+class TestSessionAffinity:
+    def test_prefix_key_prefers_hashed_replica(self, engine):
+        from docqa_tpu.engines.pool import EnginePool
+
+        pool = EnginePool(
+            engine, replicas=2, n_slots=2, chunk=4, cache_len=256,
+            canary_interval_s=600.0, health_interval_s=0.2,
+        )
+        try:
+            import zlib
+
+            key = "patient-affinity"
+            want = zlib.crc32(key.encode()) % 2
+            routed_before = [r.routed for r in pool._replicas]
+            for i in range(3):
+                pool.submit_ids(
+                    _ctx(140) + [5 + i], max_new_tokens=4, prefix_key=key
+                ).result(timeout=300)
+            delta = [
+                r.routed - routed_before[i]
+                for i, r in enumerate(pool._replicas)
+            ]
+            assert delta[want] == 3 and delta[1 - want] == 0
+            # cold requests (no key) still spread by least-queued
+            pool.submit_ids([3, 5], max_new_tokens=2).result(timeout=300)
+        finally:
+            pool.stop()
+
+    def test_affinity_falls_back_when_preferred_deep(self, engine):
+        from docqa_tpu.engines.pool import EnginePool
+
+        pool = EnginePool(
+            engine, replicas=2, n_slots=2, chunk=4, cache_len=256,
+            canary_interval_s=600.0, health_interval_s=0.2,
+            affinity_max_queue_delta=0,
+        )
+        try:
+            import zlib
+
+            key = "deep-patient"
+            want = zlib.crc32(key.encode()) % 2
+            # pile queued work onto the preferred replica only
+            pref = pool._replicas[want].batcher
+            pref.drain(timeout=30)
+            pref.resume()
+            with pref._cv:
+                pass
+            for _ in range(6):
+                pref.submit_request(
+                    __import__(
+                        "docqa_tpu.engines.serve", fromlist=["make_request"]
+                    ).make_request([3, 5], 2)
+                )
+            placed, _, _ = pool._try_place(
+                __import__(
+                    "docqa_tpu.engines.serve", fromlist=["make_request"]
+                ).make_request(_ctx(140), 2, prefix_key=key)
+            )
+            # preferred replica is 6 deep with delta 0: least-queued wins
+            assert placed is not None and placed.idx != want
+        finally:
+            pool.stop()
+
+
+class TestTelemetrySurface:
+    def test_occupancy_and_counters_exposed(self, engine):
+        from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY
+
+        b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=256)
+        try:
+            h0 = DEFAULT_REGISTRY.counter("serve_prefix_hits").value
+            ctx = _ctx(150)
+            for i in range(3):
+                b.submit_ids(
+                    ctx + [5 + i], max_new_tokens=4, prefix_key="p"
+                ).result(timeout=300)
+            occ = b.kv_block_occupancy()
+            for key in (
+                "prefix_entries", "prefix_blocks", "prefix_hit_rate",
+                "prefix_tokens_avoided",
+            ):
+                assert key in occ, key
+            assert occ["prefix_entries"] >= 1
+            assert occ["prefix_tokens_avoided"] >= ALIGN
+            assert (
+                DEFAULT_REGISTRY.counter("serve_prefix_hits").value - h0 >= 2
+            )
+        finally:
+            b.stop()
+
+    def test_qa_prefix_key_shape(self):
+        from docqa_tpu.service.qa import prefix_key_for
+
+        k1 = prefix_key_for(["chunk a", "chunk b"])
+        assert k1 == prefix_key_for(["chunk a", "chunk b"])  # stable
+        assert k1 != prefix_key_for(["chunk b", "chunk a"])  # order matters
+        assert k1 != prefix_key_for(["chunk a"])
+        tmpl, _, chunks = k1.partition(":")
+        assert tmpl and chunks
