@@ -50,7 +50,7 @@ import json
 import os
 import sys
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -613,9 +613,14 @@ def main() -> None:
     flush_details()
 
     # ---- shared measurement helpers -----------------------------------------
-    def make_ask(engine):
+    def make_ask(engine, retr=None):
+        """Classic ask loop (search -> context join -> decode).  ``retr``
+        swaps the retrieval path (sec_retrieval_quality's tiered A/B);
+        default is the fused-exact retriever."""
+        r = retr if retr is not None else retriever
+
         def ask(q: str) -> None:
-            hits = retriever.search_texts([q], k=3)[0]
+            hits = r.search_texts([q], k=3)[0]
             ctx = "\n\n".join(
                 h.metadata.get("text_content") or h.metadata["source"]
                 for h in hits
@@ -1176,6 +1181,11 @@ def main() -> None:
             "prefill_tokens_avoided": (
                 (telem.get("prefix") or {}).get("prefill_tokens_avoided")
             ),
+            # recall honesty column (docqa-recallscope): stamped by
+            # sec_retrieval_quality with the online shadow estimate, so
+            # no round can quote a tiered speedup without its recall
+            # cost beside it; null means the estimator did not run
+            "retrieval_recall": None,
             # the winner run's live telemetry: queue/block-pool/KV
             # series + the sampler's measured CPU share vs its 2% budget
             "telemetry": telem,
@@ -2285,10 +2295,188 @@ def main() -> None:
             f"{t_exact20*1e3:.1f}ms; batch-1 tiered {t_tier1*1e3:.1f}ms "
             f"vs exact {t_exact1*1e3:.1f}ms"
         )
-        del ft, tiered
+        # hand the built tier to sec_retrieval_quality (rebuilding a
+        # 1M-row IVF just to measure its recall would double the cost)
+        S["tiered"] = tiered
+        del ft
         gc.collect()
 
     run_section("ivf", sec_ivf, 400 if not small else 90)
+
+    # ---- retrieval quality: online recall, frontier, shadow overhead --------
+    def sec_retrieval_quality():
+        """docqa-recallscope measured on the bench corpus: the shadow
+        estimator's online recall@10 + Wilson CI at the serving nprobe,
+        the observed nprobe recall/latency frontier, and the
+        shadow-sampling overhead A/B on the tiered qa_e2e path — same
+        2% budget discipline as the trace/telemetry/dispatch overhead
+        sections.  The OFF arm must show ZERO shadow dispatches (the
+        acceptance bullet), counted at the spine stage."""
+        from docqa_tpu import obs as _obs
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+        from docqa_tpu.engines.spine import get_spine
+        from docqa_tpu.index.tiered import TieredIndex
+
+        tiered = S.pop("tiered", None)
+        if tiered is None:  # sec_ivf skipped on budget: build our own
+            tiered = TieredIndex(
+                store, nprobe=32, min_rows=10_000,
+                rebuild_tail_rows=10 * n_chunks,
+                n_clusters=None if small else 1000,
+            )
+            tiered.rebuild()
+        ft = FusedTieredRetriever(encoder, tiered)
+
+        def shadow_stage_count():
+            row = get_spine().stats()["stages"].get("retrieve_shadow")
+            return row["count"] if row else 0
+
+        # -- phase 1: recall estimate + frontier (every retrieval
+        # shadowed so the smoke-corpus estimate converges in seconds)
+        robs = _obs.RetrievalObservatory(
+            sample_every=1, seed=0, frontier_every=3, min_frontier_n=1,
+            registry=_REG,
+        ).start()
+        _obs.set_retrieval_observatory(robs)
+        try:
+            probes = clustered_vectors(rng, 20, dim, centers)
+            tiered.search(probes, k=10)  # compile at the measured shape
+            for _ in range(12):
+                tiered.search(probes, k=10)
+            drained = robs.drain(180)
+            st = robs.status()
+        finally:
+            _obs.set_retrieval_observatory(None)
+            robs.stop()
+        est = st["estimate"] or {}
+        out = {
+            "recall_estimate": est.get("recall"),
+            "recall_ci": [est.get("ci_lo"), est.get("ci_hi")],
+            "comparisons": est.get("comparisons"),
+            "nprobe": (st["current"] or {}).get("nprobe"),
+            "recall_target": st["recall_target"],
+            "recommended_nprobe": st["recommended_nprobe"],
+            "frontier": st["frontier"],
+            "counts": st["counts"],
+            "drained": drained,
+        }
+
+        # -- phase 2: overhead A/B on the tiered qa_e2e path, THREE
+        # arms: off / the shipped default sampling rate (the arm the 2%
+        # budget applies to) / worst-case 1-in-1 (every retrieval
+        # shadowed — informative ceiling, not the shipped config).
+        # Frontier probing off in both ON arms (a boot-class compile
+        # cost, excluded like the telemetry A/B excludes the AOT HBM
+        # probe).  The deterministic sampler fires exactly once per
+        # sample_every retrievals (one hashed slot per window), so the
+        # off and default arms run 2x the rate in requests — fewer
+        # would measure an arm containing ZERO shadows and call the
+        # jitter "overhead".
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True),
+                mesh=mesh,
+            )
+        ask_tiered = make_ask(S["gen1"], retr=ft)
+        for q in q_texts[:2]:  # compile at the measured shapes
+            ask_tiered(q)
+        n_ab = max(n_e2e, 8)
+
+        def run_p50(n_req: int) -> float:
+            lats = []
+            for i in range(n_req):
+                q = q_texts[2 + i % n_queries]
+                t0 = time.perf_counter()
+                ask_tiered(q)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            return float(np.percentile(lats, 50))
+
+        from docqa_tpu.config import RetrievalQualityConfig
+
+        default_rate = RetrievalQualityConfig().sample_every
+        n_def = 2 * default_rate  # exactly 2 sampled shadows per arm
+        off0 = shadow_stage_count()
+        p50_off = run_p50(n_def)
+        off_shadow = shadow_stage_count() - off0
+
+        def run_sampled(sample_every: int, n_req: int) -> Tuple[float, int]:
+            robs2 = _obs.RetrievalObservatory(
+                sample_every=sample_every, frontier_every=0,
+                registry=_REG,
+            ).start()
+            _obs.set_retrieval_observatory(robs2)
+            try:
+                p50 = run_p50(n_req)
+                robs2.drain(60)
+                sampled = robs2.status()["counts"]["sampled"]
+            finally:
+                _obs.set_retrieval_observatory(None)
+                robs2.stop()
+            return p50, sampled
+
+        p50_def, def_sampled = run_sampled(default_rate, n_def)
+        p50_all, _ = run_sampled(1, n_ab)
+        overhead_def = (
+            (p50_def - p50_off) / p50_off * 100.0 if p50_off else 0.0
+        )
+        overhead_all = (
+            (p50_all - p50_off) / p50_off * 100.0 if p50_off else 0.0
+        )
+        out["overhead"] = {
+            "qa_e2e_p50_off_ms": round(p50_off, 2),
+            "qa_e2e_p50_default_ms": round(p50_def, 2),
+            "qa_e2e_p50_worstcase_ms": round(p50_all, 2),
+            # the shipped config (1-in-N sampling) is what the 2% budget
+            # governs; the 1-in-1 ceiling is reported beside it so the
+            # amortization claim stays checkable
+            "overhead_pct": round(overhead_def, 2),
+            "overhead_worstcase_pct": round(overhead_all, 2),
+            "sampling_default": f"1-in-{default_rate}",
+            "samples_off_and_default": n_def,
+            "default_arm_shadows_sampled": def_sampled,
+            "samples_worstcase": n_ab,
+            "budget_pct": 2.0,
+            "within_budget": overhead_def <= 2.0,
+            # MUST be zero: sampling disabled == zero shadow dispatches
+            "off_arm_shadow_dispatches": off_shadow,
+        }
+        if off_shadow:
+            log(
+                f"RETRIEVAL QUALITY VIOLATION: {off_shadow} shadow "
+                "dispatches with sampling disabled (must be 0)"
+            )
+        DETAILS["retrieval_quality"] = out
+        # honesty column (the rag_load fix): every section quoting
+        # tiered latency now carries the measured recall beside it
+        recall_col = {
+            "recall_estimate": out["recall_estimate"],
+            "recall_ci": out["recall_ci"],
+            "nprobe": out["nprobe"],
+            "source": "retrieval_quality (online shadow estimator)",
+        }
+        for key in ("ivf", "rag_load", "rag_load_7b_int8"):
+            sec = DETAILS.get(key)
+            if isinstance(sec, dict):
+                sec["retrieval_recall"] = recall_col
+        log(
+            f"retrieval_quality: recall@10 {out['recall_estimate']} "
+            f"CI {out['recall_ci']} at nprobe {out['nprobe']} "
+            f"(target {out['recall_target']}, recommended "
+            f"{out['recommended_nprobe']}); shadow overhead "
+            f"{overhead_def:+.2f}% at 1-in-{default_rate} (budget 2%; "
+            f"1-in-1 ceiling {overhead_all:+.2f}%), off-arm shadow "
+            f"dispatches {off_shadow}"
+        )
+        del ft, tiered
+        gc.collect()
+
+    run_section("retrieval_quality", sec_retrieval_quality,
+                420 if not small else 90)
+    # if the section was budget-SKIPPED, the tier sec_ivf parked in S
+    # must still be freed here — pinning 1M-row cell tensors through the
+    # HBM-hungry 7B/int4 sections would shift their numbers
+    S.pop("tiered", None)
+    gc.collect()
 
     # ---- IVF crossover at 2M/4M rows (VERDICT r4 item 4) --------------------
     # Vectors only (no sidecar), measured in the regime the bytes model

@@ -541,6 +541,53 @@ class TelemetryConfig:
 
 
 @dataclass(frozen=True)
+class RetrievalQualityConfig:
+    """Retrieval-quality observatory (``obs/retrieval_observatory.py``;
+    docqa-recallscope, docs/OBSERVABILITY.md "Retrieval quality").
+
+    A deterministic 1-in-``sample_every`` fraction of tiered retrievals
+    gets an asynchronous exact-scan shadow query on the spine's
+    background stream; served-vs-exact comparisons yield windowed
+    recall@k estimates with Wilson CIs (``/api/retrieval``, the
+    ``retrieve_recall_*`` telemetry series), a recall SLO burn alert,
+    and a measured nprobe recall/latency frontier with a recommendation
+    for ``recall_target``."""
+
+    enabled: bool = True
+    # 1-in-N shadow sampling of tiered retrievals (deterministic seeded
+    # hash — replayed workloads sample identical request indices).  The
+    # measured overhead budget (bench retrieval_quality section) is 2%
+    # of qa_e2e p50 at this default.
+    sample_every: int = 32
+    seed: int = 0
+    # per-QUERY comparisons retained per (tier, nprobe) estimate window
+    window: int = 512
+    # bounded shadow-job queue; a backlogged worker DROPS (counted) —
+    # shadow evidence is sampled anyway, so dropping beats queueing
+    max_pending: int = 8
+    # every Nth sampled shadow also probes neighboring nprobe values
+    # (frontier_factors x current nprobe, clamped to [1, n_clusters])
+    frontier_every: int = 4
+    frontier_factors: Tuple[float, ...] = (0.25, 0.5, 1.0, 2.0, 4.0)
+    # minimum frontier comparisons (per-query, not per shadow job)
+    # before a row can back a recommendation
+    min_frontier_n: int = 5
+    # the measured recall objective (ROADMAP item 2: ">= 0.95, not
+    # 1.0"): drives the recommended nprobe AND the recall SLO objective
+    recall_target: float = 0.95
+    # apply the recommended nprobe live via TieredIndex.set_nprobe.
+    # DEFAULT OFF: recommendation-only — an operator reads
+    # /api/retrieval and decides (docs/OPERATIONS.md runbook)
+    auto_apply_nprobe: bool = False
+    # recall SLO burn policy (obs/slo.py default_retrieval_slos), in
+    # telemetry rollup windows like the /ask SLOs
+    slo_short_windows: int = 2
+    slo_long_windows: int = 30
+    slo_burn_threshold: float = 4.0
+    slo_min_events: int = 6
+
+
+@dataclass(frozen=True)
 class GenerateConfig:
     """Decode-loop policy."""
 
@@ -639,6 +686,9 @@ class Config:
     pool: PoolConfig = field(default_factory=PoolConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     dispatch: DispatchConfig = field(default_factory=DispatchConfig)
+    retrieval_quality: RetrievalQualityConfig = field(
+        default_factory=RetrievalQualityConfig
+    )
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

@@ -49,6 +49,8 @@ def dispatch_with_donation_retry(
     lock,
     snapshot_and_build: Callable[[], Tuple[Optional[Callable], Any]],
     deadline: Optional[Deadline] = None,
+    stage: str = "retrieve",
+    stream: str = "serve",
 ):
     """Run ``fn(*args)`` from a consistent snapshot, compiling OUTSIDE the
     lock.
@@ -70,7 +72,13 @@ def dispatch_with_donation_retry(
     ``deadline`` (resilience/deadline.py) is checked before every
     attempt: a request whose end-to-end budget is gone sheds HERE —
     before a possibly multi-second trace+compile — instead of paying for
-    a dispatch whose answer nobody can use."""
+    a dispatch whose answer nobody can use.
+
+    ``stage``/``stream`` relabel the spine work item: the retrieval
+    observatory's shadow queries run this exact discipline but under the
+    ``retrieve_shadow`` stage on the background ``probe`` stream, so
+    their cost is attributable and they can never occupy the last
+    serving lane."""
     for unlocked_try in range(2):
         if deadline is not None:
             deadline.check("dispatch")
@@ -85,7 +93,9 @@ def dispatch_with_donation_retry(
             # and a lane is never held for the program's device time.
             # A donation race surfaces at dispatch (tracing re-reads the
             # donated buffers) exactly as it did pre-spine.
-            return spine_run("retrieve", fn, *args, deadline=deadline)
+            return spine_run(
+                stage, fn, *args, stream=stream, deadline=deadline
+            )
         except RuntimeError as e:
             if not _is_deleted_buffer_error(e):
                 raise
@@ -110,4 +120,4 @@ def dispatch_with_donation_retry(
         # still a spine item even under the lock: the submitter holds
         # the store lock while BLOCKED on the ticket; the lane runs the
         # closure without acquiring anything, so no lock-order edge
-        return spine_run("retrieve", fn, *args, deadline=deadline)
+        return spine_run(stage, fn, *args, stream=stream, deadline=deadline)

@@ -23,6 +23,7 @@ therefore pays the same single host->device round-trip as one chip.
 from __future__ import annotations
 
 import functools
+from time import perf_counter
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -32,6 +33,7 @@ import numpy as np
 from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from docqa_tpu import obs
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.engines.spine import spine_run
@@ -42,9 +44,20 @@ from docqa_tpu.index.store import (
     _search_single,
 )
 from docqa_tpu.models.encoder import encode_batch
-from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, span
+from docqa_tpu.obs.retrieval_observatory import (
+    ShadowJob,
+    get_retrieval_observatory,
+)
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
+
+log = get_logger("docqa.retrieve")
 
 QUERY_BATCH_BUCKETS = (1, 4, 16)
+
+# the first off-mesh fallback warns; later ones only count + trace-flag
+# (one warning per process names the condition, a log line per request
+# would be noise exactly when a mesh serves sustained traffic)
+_OFFMESH_WARNED = False
 
 
 def sharded_search(store_mesh, emb, buf, count, mask, k: int):
@@ -137,12 +150,22 @@ class FusedRetriever:
         k: Optional[int] = None,
         filters: Optional[Dict[str, Any]] = None,
         deadline=None,  # resilience.Deadline: shed before marshal/dispatch
-    ) -> List[List[SearchResult]]:
-        """Same contract as ``store.search`` but from raw query texts."""
+        stage: str = "retrieve",
+        stream: str = "serve",
+        return_emb: bool = False,
+    ) -> Any:
+        """Same contract as ``store.search`` but from raw query texts.
+
+        ``stage``/``stream`` relabel the spine work item — the retrieval
+        observatory's exact-scan shadow runs THIS path under
+        ``("retrieve_shadow", "probe")`` so ground truth and serving can
+        never drift.  ``return_emb=True`` additionally returns the
+        program's query embeddings as ``(results, emb [n, d] float32)``
+        (the frontier probes reuse them instead of re-encoding)."""
         store = self.store
         k = k or store.cfg.default_k
         if not len(texts):
-            return []
+            return ([], np.zeros((0, 0), np.float32)) if return_emb else []
         if deadline is not None:
             deadline.check("retrieve")
         n = len(texts)
@@ -179,16 +202,29 @@ class FusedRetriever:
                     args.append(jnp.asarray(mask))
             return fn, args
 
-        with span("fused_query", DEFAULT_REGISTRY):
+        # shadow relabels keep their own histogram: a background-stream
+        # ground-truth scan must not pollute the SERVING fused_query
+        # percentiles it exists to audit
+        span_name = "fused_query" if stage == "retrieve" else stage
+        with span(span_name, DEFAULT_REGISTRY):
             out = dispatch_with_donation_retry(
-                store._lock, snapshot_and_build, deadline=deadline
+                store._lock, snapshot_and_build, deadline=deadline,
+                stage=stage, stream=stream,
             )
         if out is None:  # empty store
-            return [[] for _ in texts]
-        vals, row_ids, _emb = out
+            empty: List[List[SearchResult]] = [[] for _ in texts]
+            if return_emb:
+                return empty, np.zeros(
+                    (n, self.encoder.cfg.embed_dim), np.float32
+                )
+            return empty
+        vals, row_ids, emb = out
         vals = np.asarray(vals)[:n]
         row_ids = np.asarray(row_ids)[:n]
-        return store.assemble_results(vals, row_ids)
+        results = store.assemble_results(vals, row_ids)
+        if return_emb:
+            return results, np.asarray(emb, np.float32)[:n]
+        return results
 
 
 class FusedTieredRetriever:
@@ -283,6 +319,28 @@ class FusedTieredRetriever:
             # full-scan the store the operator configured tiered serving
             # to avoid).  The exact fused path composes with the mesh
             # (sharded_search); fusing the probe kernel is future work.
+            # LOUD (ROADMAP item 2 named this fallback silent): the
+            # request pays two extra host<->device round-trips, so it is
+            # counted, trace-flagged, and warned once per process.
+            global _OFFMESH_WARNED
+            DEFAULT_REGISTRY.counter("retrieve_offmesh_fallback").inc()
+            obs.flag("offmesh_fallback")
+            obs.event(
+                "offmesh_fallback",
+                n_model=mesh.n_model,
+                n_data=mesh.n_data,
+            )
+            if not _OFFMESH_WARNED:
+                _OFFMESH_WARNED = True
+                log.warning(
+                    "fused tiered probe falling back OFF-mesh (mesh "
+                    "n_model=%d n_data=%d): serving the three-dispatch "
+                    "tiered path — each such request pays two extra "
+                    "host<->device round-trips until the probe kernel is "
+                    "mesh-native (ROADMAP item 2); counted as "
+                    "retrieve_offmesh_fallback_total",
+                    mesh.n_model, mesh.n_data,
+                )
             if deadline is not None:  # shed before three paid dispatches
                 deadline.check("retrieve_dispatch")
             emb = np.asarray(
@@ -306,9 +364,13 @@ class FusedTieredRetriever:
             self._tier_token = ivf
         k_bulk = tiered._k_bulk(k, covered)
         # mirror IVFIndex.search's duplicate-id over-fetch: rows assigned
-        # to multiple cells can appear nprobe times in the raw top list
-        pool = ivf.nprobe * ivf.cap + int(ivf._spill_ids.shape[0])
-        nprobe = min(ivf.nprobe, ivf.n_clusters)
+        # to multiple cells can appear nprobe times in the raw top list.
+        # ONE nprobe read: set_nprobe (auto-apply/operator) may land
+        # mid-request, and pool/fetch derived from two different values
+        # could hand the program a top_k k larger than its candidate axis
+        nprobe_live = ivf.nprobe
+        pool = nprobe_live * ivf.cap + int(ivf._spill_ids.shape[0])
+        nprobe = min(nprobe_live, ivf.n_clusters)
         fetch = min(min(k_bulk, ivf.n) * (ivf.n_assign + 1), pool)
 
         _, _, tail_dev, n_live, tail_meta = tiered._tail_device(covered)
@@ -336,6 +398,7 @@ class FusedTieredRetriever:
                 jnp.int32(n_live),
             )
 
+        t_probe = perf_counter()
         with span("fused_tiered_query", DEFAULT_REGISTRY):
             # async like the exact path: the lane covers trace/compile +
             # enqueue; the np.asarray fetches below block on the caller
@@ -347,10 +410,18 @@ class FusedTieredRetriever:
         bulk_ids = np.asarray(bulk_ids)[:n]
         tail_vals = np.asarray(tail_vals, np.float32)[:n]
         tail_ids = np.asarray(tail_ids)[:n]
+        # the fused program collapses encode+probe+tail into ONE
+        # dispatch, so the split the two-step path reports per tier is
+        # unobservable here — the combined dispatch+fetch gets its own
+        # honestly-named digest instead of impersonating the bulk probe
+        DEFAULT_REGISTRY.histogram("retrieve_tier_ms_fused_probe").observe(
+            (perf_counter() - t_probe) * 1e3
+        )
 
         # host dedup (IVFIndex.search's loop) -> bulk candidate rows
         from docqa_tpu.index.store import NEG_INF
 
+        t_merge = perf_counter()
         bulk_rows = []
         for qi in range(n):
             row = []
@@ -371,9 +442,78 @@ class FusedTieredRetriever:
         # computed?  The program keeps them on device; re-encoding a rare
         # fallback query host-side is cheaper than always fetching them.
         q_for_fallback = _FallbackQueries(self.encoder, texts)
-        return tiered._merge(
+        out = tiered._merge(
             q_for_fallback, bulk_rows, tail_vals, tail_ids, tail_meta,
             covered, k,
+        )
+        DEFAULT_REGISTRY.histogram("retrieve_tier_ms_merge").observe(
+            (perf_counter() - t_merge) * 1e3
+        )
+        self._observe_quality(
+            texts, out, ivf, covered, covered + n_live, k, nprobe
+        )
+        return out
+
+    def _observe_quality(
+        self,
+        texts: Sequence[str],
+        out: List[List[SearchResult]],
+        ivf,
+        covered: int,
+        seen_count: int,
+        k: int,
+        nprobe: int,
+    ) -> None:
+        """Shadow-sampling hook for the fused path (docqa-recallscope).
+        Ground truth is the SAME fused exact program the pre-tier path
+        serves (encode + masked exact top-k in one dispatch), relabeled
+        onto the background ``probe`` stream under ``retrieve_shadow``;
+        its returned query embeddings feed the neighbor-nprobe frontier
+        probes so the shadow never re-encodes."""
+        robs = get_retrieval_observatory()
+        if robs is None or not robs.sample():
+            return
+        served = [[(r.row_id, r.score) for r in row] for row in out]
+        margins = [
+            row[0].score - row[-1].score for row in out if len(row) >= 2
+        ]
+        texts_copy = list(texts)
+        exact = self._exact
+        count_cap = seen_count
+
+        def shadow_fn():
+            rows, emb = exact.search_texts(
+                texts_copy, k=k, stage="retrieve_shadow", stream="probe",
+                return_emb=True,
+            )
+            # the fused program scans the CURRENT count; clamp hits to
+            # the rows the served query could have seen (ids beyond the
+            # serving snapshot are a concurrent-ingest artifact, not a
+            # tier miss)
+            rows = [
+                [
+                    (r.row_id, r.score)
+                    for r in row
+                    if r.row_id < count_cap
+                ]
+                for row in rows
+            ]
+            return rows, emb
+
+        robs.submit(
+            ShadowJob(
+                tier="tiered_fused",
+                # the nprobe the served dispatch actually used, not a
+                # re-read racing a concurrent set_nprobe
+                nprobe=int(nprobe),
+                k=k,
+                served=served,
+                shadow_fn=shadow_fn,
+                frontier_fn=lambda qn, p: ivf.timed_probe(qn, k=k, nprobe=p),
+                covered=covered,
+                n_clusters=ivf.n_clusters,
+                served_margins=margins,
+            )
         )
 
 

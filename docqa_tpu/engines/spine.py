@@ -57,6 +57,9 @@ from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
 log = get_logger("docqa.spine")
 
 # serving-class streams get lane priority; everything else is background
+# ("probe" also carries the retrieval observatory's exact-scan shadow
+# queries and nprobe-frontier probes — stage "retrieve_shadow" — so
+# shadow sampling can never occupy the last serving lane)
 BACKGROUND_STREAMS = frozenset({"warmup", "probe", "rebuild", "background"})
 # the disaggregated admission lane (docqa-prefix): prefill work items
 # are serving-class but schedule BELOW decode-class items, so one

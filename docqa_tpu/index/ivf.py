@@ -389,8 +389,16 @@ class IVFIndex:
 
         with span("ivf_search", DEFAULT_REGISTRY):
             vals, ids = spine_run("ivf_search", _probe_on_lane)
+        return self._dedup_rows(vals, ids, k_eff)
+
+    def _dedup_rows(
+        self, vals: np.ndarray, ids: np.ndarray, k_eff: int
+    ) -> List[List[Tuple[float, int, Dict[str, Any]]]]:
+        """Host dedup of the raw top list (rows assigned to multiple
+        cells appear once per probed copy) down to k_eff per query —
+        shared by :meth:`search` and :meth:`timed_probe`."""
         out = []
-        for qi in range(len(qn)):
+        for qi in range(len(vals)):
             row = []
             seen = set()
             for score, rid in zip(vals[qi], ids[qi]):
@@ -402,3 +410,64 @@ class IVFIndex:
                     break
             out.append(row)
         return out
+
+    def timed_probe(
+        self,
+        queries: np.ndarray,
+        k: int = 10,
+        nprobe: Optional[int] = None,
+    ) -> Tuple[List[List[Tuple[int, float]]], float, bool]:
+        """One coarse probe at an explicit ``nprobe`` as a BACKGROUND
+        work item, timed on the lane — the retrieval observatory's
+        nprobe-frontier instrument (``obs/retrieval_observatory.py``).
+
+        Returns ``(rows, seconds, fresh_compile)`` where rows are
+        per-query ``(row_id, score)`` pairs and ``seconds`` covers
+        dispatch + device + fetch as measured AROUND the device phase on
+        the lane (queue wait excluded — the frontier's latency axis must
+        reflect the probe, not background-stream scheduling).  The first
+        call at a new (batch, k, nprobe) shape traces+compiles inside
+        the timed window; ``fresh_compile`` flags exactly those samples
+        so the observatory can exclude them from the latency axis (a
+        per-nprobe first-sample drop would miss later compiles at new
+        batch sizes)."""
+        from time import perf_counter
+
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        qn = queries / np.maximum(
+            np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+        )
+        nprobe = min(nprobe or self.nprobe, self.n_clusters)
+        k_eff = min(k, self.n)
+        pool = nprobe * self.cap + int(self._spill_ids.shape[0])
+        fetch = min(k_eff * (self.n_assign + 1), pool)
+        # a cached wrapper has been invoked (and so compiled) before:
+        # search() and timed_probe() both go through _get_fn and always
+        # call the fn they get back
+        fresh_compile = (len(qn), fetch, nprobe) not in self._fns
+        fn = self._get_fn(len(qn), fetch, nprobe)
+
+        def _shadow_probe_on_lane():
+            t0 = perf_counter()
+            v, i = fn(
+                self._cells,
+                self._cell_ids,
+                self._centroids,
+                self._spill,
+                self._spill_ids,
+                jnp.asarray(qn, self._dtype),
+            )
+            v = np.asarray(v, np.float32)
+            i = np.asarray(i)
+            return v, i, perf_counter() - t0
+
+        vals, ids, seconds = spine_run(
+            "retrieve_shadow", _shadow_probe_on_lane, stream="probe"
+        )
+        rows = [
+            [(rid, score) for score, rid, _md in row]
+            for row in self._dedup_rows(vals, ids, k_eff)
+        ]
+        return rows, seconds, fresh_compile

@@ -675,6 +675,61 @@ class VectorStore:
         )
         return self.assemble_results(vals, ids)
 
+    def shadow_search(
+        self, queries: np.ndarray, k: int, count_cap: Optional[int] = None
+    ) -> List[List[SearchResult]]:
+        """Exact tombstone-masked top-k as a BACKGROUND probe — the
+        retrieval observatory's ground-truth scan (``obs/retrieval_
+        observatory.py``).  Identical ranking semantics to :meth:`search`
+        (same kernels, same live-mask composition, no filters), but the
+        device work rides the spine's background ``probe`` stream under
+        the dedicated ``retrieve_shadow`` stage: capped at n_lanes-1, it
+        can never occupy the last serving lane, and ``dispatch_*``
+        telemetry attributes exactly what shadow sampling costs.
+
+        ``count_cap`` bounds the scanned rows to the corpus size the
+        SERVED query saw: a shadow that lags a concurrent ingest must
+        not count rows the tier could not have returned as misses."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        qn = queries / np.maximum(
+            np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+        )
+        # dispatch under the lock / fetch outside: the same donation
+        # discipline as search() (see the comment there)
+        with self._lock:
+            count = self._count
+            if count_cap is not None:
+                count = min(count, int(count_cap))
+            if count == 0:
+                return [[] for _ in queries]
+            k_eff = min(k, count)
+            mask = self._compose_live_locked(None, already_live=False)
+
+            def _shadow_on_lane():
+                """Dispatch phase (spine work item; submitter holds the
+                lock while blocked — the closure acquires nothing)."""
+                fn = self._get_search_fn(
+                    len(qn), k_eff, masked=mask is not None
+                )
+                args = [
+                    self._dev, jnp.asarray(qn, self._dtype), jnp.int32(count)
+                ]
+                if mask is not None:
+                    args.append(jnp.asarray(mask))
+                return fn(*args)
+
+            vals_dev, ids_dev = spine_run(
+                "retrieve_shadow", _shadow_on_lane, stream="probe"
+            )
+        vals, ids = spine_run(
+            "retrieve_shadow",
+            lambda: (np.asarray(vals_dev), np.asarray(ids_dev)),
+            stream="probe",
+        )
+        return self.assemble_results(vals, ids)
+
     def assemble_results(
         self, vals: np.ndarray, ids: np.ndarray
     ) -> List[List[SearchResult]]:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import threading
+from time import perf_counter
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -34,6 +35,10 @@ import numpy as np
 from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.index.ivf import IVFIndex
 from docqa_tpu.index.store import NEG_INF, SearchResult, VectorStore
+from docqa_tpu.obs.retrieval_observatory import (
+    ShadowJob,
+    get_retrieval_observatory,
+)
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 from docqa_tpu.utils import round_up
 
@@ -255,9 +260,23 @@ class TieredIndex:
             queries = queries[None]
         k_bulk = self._k_bulk(k, covered)
         with span("tiered_search", DEFAULT_REGISTRY):
-            bulk = ivf.search(queries, k=k_bulk, nprobe=self.nprobe)
+            # per-tier latency split (docqa-recallscope): bulk probe /
+            # tail scan / host merge each get their own digest, so the
+            # nprobe frontier's latency axis can be read against what
+            # /ask actually pays per stage (the aggregate retrieve span
+            # alone could not attribute a regression to a tier)
+            t_stage = perf_counter()
+            # one nprobe read: a set_nprobe landing mid-request must not
+            # make _observe_quality label this comparison with a value
+            # the probe above never used
+            nprobe_now = self.nprobe
+            bulk = ivf.search(queries, k=k_bulk, nprobe=nprobe_now)
+            DEFAULT_REGISTRY.histogram("retrieve_tier_ms_bulk_ivf").observe(
+                (perf_counter() - t_stage) * 1e3
+            )
 
             _, _, tail_dev, n_live, tail_meta = self._tail_device(covered)
+            t_stage = perf_counter()
             if n_live == 0:
                 # empty tail: bulk-only, but still through the merge loop
                 # below so the under-fill fallback applies
@@ -286,10 +305,90 @@ class TieredIndex:
                     return np.asarray(v, np.float32), np.asarray(i)
 
                 vals, ids = spine_run("tiered_tail", _tail_on_lane)
+            DEFAULT_REGISTRY.histogram("retrieve_tier_ms_tail_exact").observe(
+                (perf_counter() - t_stage) * 1e3
+            )
 
-        return self._merge(
+        t_stage = perf_counter()
+        out = self._merge(
             queries, bulk, vals, ids, tail_meta, covered, k
         )
+        DEFAULT_REGISTRY.histogram("retrieve_tier_ms_merge").observe(
+            (perf_counter() - t_stage) * 1e3
+        )
+        self._observe_quality(
+            queries, out, ivf, covered, covered + n_live, k, nprobe_now
+        )
+        return out
+
+    def _observe_quality(
+        self,
+        queries: np.ndarray,
+        out: List[List[SearchResult]],
+        ivf: IVFIndex,
+        covered: int,
+        seen_count: int,
+        k: int,
+        nprobe: int,
+    ) -> None:
+        """Shadow-sampling hook (docqa-recallscope): hand the retrieval
+        observatory this request's served top-k plus closures that
+        reproduce the exact ground truth and the neighbor-nprobe probes
+        on the spine's background stream.  ``seen_count`` pins the
+        shadow's corpus view to the rows this query could have seen, so
+        a concurrent ingest cannot read as a recall miss.  Non-sampled
+        calls cost one counter bump and one hash."""
+        robs = get_retrieval_observatory()
+        if robs is None or not robs.sample():
+            return
+        served = [[(r.row_id, r.score) for r in row] for row in out]
+        margins = [
+            row[0].score - row[-1].score for row in out if len(row) >= 2
+        ]
+        norms = [float(n) for n in np.linalg.norm(queries, axis=1)]
+        q_copy = np.array(queries, np.float32, copy=True)
+        store = self.store
+
+        def shadow_fn():
+            rows = store.shadow_search(q_copy, k, count_cap=seen_count)
+            return (
+                [[(r.row_id, r.score) for r in row] for row in rows],
+                q_copy,
+            )
+
+        robs.submit(
+            ShadowJob(
+                tier="tiered",
+                # the nprobe the served probe actually used, not a
+                # re-read racing a concurrent set_nprobe
+                nprobe=int(min(nprobe, ivf.n_clusters)),
+                k=k,
+                served=served,
+                shadow_fn=shadow_fn,
+                frontier_fn=lambda qn, p: ivf.timed_probe(qn, k=k, nprobe=p),
+                covered=covered,
+                n_clusters=ivf.n_clusters,
+                query_norms=norms,
+                served_margins=margins,
+            )
+        )
+
+    def set_nprobe(self, nprobe: int) -> int:
+        """Apply a new serving nprobe live — the observatory's
+        recommendation hook (``retrieval_quality.auto_apply_nprobe``)
+        and the operator's /api/retrieval-guided knob.  Covers both the
+        two-step path (reads ``self.nprobe`` per search) and the fused
+        program path (reads the active tier's ``ivf.nprobe``); future
+        rebuilds inherit it via ``self.nprobe``."""
+        n = max(1, int(nprobe))
+        tier = self._tier  # one read: (ivf, covered) stay consistent
+        # plain int publishes (GIL-atomic): a search mid-flight reads
+        # either the old or the new value, both coherent configurations
+        self.nprobe = n
+        if tier is not None:
+            tier[0].nprobe = min(n, tier[0].n_clusters)
+        log.info("tiered: serving nprobe set to %d", n)
+        return n
 
     def reset(self) -> None:
         """Drop the IVF tier and tail cache (searches fall back to exact

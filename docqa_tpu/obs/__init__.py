@@ -17,7 +17,12 @@ for the rest of the framework:
   / :class:`TelemetrySampler` (time-series rollups of the serving
   plane), :class:`BurnRateEvaluator` + :func:`default_ask_slos` (SLO
   burn-rate alerting), :func:`prometheus_text` / :func:`telemetry_json`
-  / :func:`lint_prometheus_text` (exposition).
+  / :func:`lint_prometheus_text` (exposition);
+* retrieval quality (ISSUE 13): :class:`RetrievalObservatory` +
+  :func:`get_retrieval_observatory` / :func:`set_retrieval_observatory`
+  (shadow-sampling online recall estimation, the measured nprobe
+  frontier), :func:`wilson_interval` / :func:`compare_topk` (estimator
+  math), :func:`default_retrieval_slos` (the recall burn objective).
 
 Depends only on the stdlib (jax is imported lazily inside the profiler
 window), so ``runtime/metrics.py`` can import it without cycles.
@@ -71,10 +76,19 @@ from docqa_tpu.obs.recorder import (  # noqa: F401
     new_trace,
     set_enabled,
 )
+from docqa_tpu.obs.retrieval_observatory import (  # noqa: F401
+    RetrievalObservatory,
+    ShadowJob,
+    compare_topk,
+    get_retrieval_observatory,
+    set_retrieval_observatory,
+    wilson_interval,
+)
 from docqa_tpu.obs.slo import (  # noqa: F401
     BurnRateEvaluator,
     SLODef,
     default_ask_slos,
+    default_retrieval_slos,
 )
 from docqa_tpu.obs.spans import Span, Trace, start_span  # noqa: F401
 from docqa_tpu.obs.telemetry import (  # noqa: F401

@@ -122,6 +122,37 @@ def default_ask_slos(
     ]
 
 
+def default_retrieval_slos(
+    recall_target: float = 0.95,
+    short_windows: int = 2,
+    long_windows: int = 30,
+    burn_threshold: float = 4.0,
+    min_events: int = 6,
+) -> List[SLODef]:
+    """The retrieval-quality objective (docqa-recallscope): a ratio-kind
+    SLO over the shadow estimator's per-comparison counters — good
+    fraction == online recall@k, objective == the configured recall
+    target — so a recall regression burns and alerts EXACTLY like an
+    availability or latency burn, flagging the window's /ask traces
+    anomalous.  ``retrieve_shadow_expected`` / ``retrieve_shadow_missed``
+    are stamped by ``obs/retrieval_observatory.py`` per shadow
+    comparison and rolled into windows by the telemetry sampler."""
+    return [
+        SLODef(
+            name="retrieve_recall",
+            kind="ratio",
+            objective=recall_target,
+            total_series="retrieve_shadow_expected",
+            bad_series="retrieve_shadow_missed",
+            short_windows=short_windows,
+            long_windows=long_windows,
+            burn_threshold=burn_threshold,
+            min_events=min_events,
+            trace_names=("ask", "ask_stream"),
+        ),
+    ]
+
+
 @dataclass
 class _AlertState:
     firing: bool = False

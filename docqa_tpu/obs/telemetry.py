@@ -514,6 +514,7 @@ class TelemetrySampler:
         engine=None,  # GenerateEngine (HBM + jit cache probes)
         slo_evaluator=None,  # obs.slo.BurnRateEvaluator
         spine=None,  # engines.spine.DispatchSpine (duck-typed)
+        retrieval=None,  # obs.retrieval_observatory.RetrievalObservatory
         sample_every_s: float = 2.0,
         hbm_refresh_s: float = 600.0,
         extra_probes: Sequence[Callable[[], Dict[str, float]]] = (),
@@ -527,6 +528,7 @@ class TelemetrySampler:
         self.engine = engine
         self.slo_evaluator = slo_evaluator
         self.spine = spine
+        self.retrieval = retrieval
         self.sample_every_s = float(sample_every_s)
         self.hbm_refresh_s = float(hbm_refresh_s)
         self.extra_probes = list(extra_probes)
@@ -612,6 +614,8 @@ class TelemetrySampler:
             self._fenced("engine", lambda: self._scrape_engine(now))
         if self.spine is not None:
             self._fenced("spine", lambda: self._scrape_spine(now))
+        if self.retrieval is not None:
+            self._fenced("retrieval", lambda: self._scrape_retrieval(now))
         for probe in self.extra_probes:
             self._fenced(
                 getattr(probe, "__name__", "extra"),
@@ -764,6 +768,17 @@ class TelemetrySampler:
             self.store.record_gauge(name, float(value), now=now)
         for name, value in self.spine.telemetry_counters().items():
             self.store.record_counter(name, float(value), now=now)
+
+    def _scrape_retrieval(self, now: Optional[float]) -> None:
+        """Retrieval-quality series (``retrieve_recall_*``; obs/
+        retrieval_observatory.py): the shadow estimator's live recall
+        estimate + Wilson CI bounds, pending shadow depth, and the
+        current/recommended nprobe as gauges.  The per-comparison
+        counters (``retrieve_shadow_expected``/``_missed`` — the recall
+        SLO's ratio inputs) ride the registry scrape like every other
+        counter."""
+        for name, value in self.retrieval.telemetry_gauges().items():
+            self.store.record_gauge(name, float(value), now=now)
 
     def _scrape_extra(self, probe, now: Optional[float]) -> None:
         for name, value in (probe() or {}).items():

@@ -492,6 +492,33 @@ class DocQARuntime:
             retrieval=retrieval, summarizer=self.summarizer
         )
 
+        # ---- retrieval-quality observatory (docqa-recallscope,
+        # docs/OBSERVABILITY.md "Retrieval quality"): shadow-sampling
+        # online recall estimation + the measured nprobe frontier.
+        # Constructed for every runtime the config enables it on and
+        # installed as the process hook point (the tiered/fused search
+        # paths look it up per retrieval); the worker starts in start().
+        # Exact serving produces no shadow jobs (recall is 1.0 by
+        # construction there), so the observatory idles at zero cost.
+        rq = self.cfg.retrieval_quality
+        self.retrieval_obs = None
+        if rq.enabled:
+            apply_cb = getattr(self.search_index, "set_nprobe", None)
+            self.retrieval_obs = obs.RetrievalObservatory(
+                sample_every=rq.sample_every,
+                seed=rq.seed,
+                window=rq.window,
+                max_pending=rq.max_pending,
+                frontier_every=rq.frontier_every,
+                frontier_factors=rq.frontier_factors,
+                min_frontier_n=rq.min_frontier_n,
+                recall_target=rq.recall_target,
+                auto_apply=rq.auto_apply_nprobe,
+                apply_nprobe=apply_cb,
+                registry=DEFAULT_REGISTRY,
+            )
+            obs.set_retrieval_observatory(self.retrieval_obs)
+
         # ---- telemetry: time-series rollups + SLO burn-rate alerting
         # (docqa-telemetry, docs/OBSERVABILITY.md).  Built last so the
         # sampler scrapes fully-constructed components; started in
@@ -508,16 +535,27 @@ class DocQARuntime:
             self.telemetry = obs.TelemetryStore(
                 interval_s=tcfg.interval_s, points=tcfg.points
             )
+            slos = obs.default_ask_slos(
+                p95_objective_ms=tcfg.slo_ask_p95_ms,
+                availability=tcfg.slo_ask_availability,
+                degraded_budget=tcfg.slo_ask_degraded_budget,
+                short_windows=tcfg.slo_short_windows,
+                long_windows=tcfg.slo_long_windows,
+                burn_threshold=tcfg.slo_burn_threshold,
+            )
+            if self.retrieval_obs is not None:
+                # the recall objective burns exactly like a latency
+                # burn: fires, flags the window's /ask traces anomalous
+                slos += obs.default_retrieval_slos(
+                    recall_target=rq.recall_target,
+                    short_windows=rq.slo_short_windows,
+                    long_windows=rq.slo_long_windows,
+                    burn_threshold=rq.slo_burn_threshold,
+                    min_events=rq.slo_min_events,
+                )
             self.slo = obs.BurnRateEvaluator(
                 self.telemetry,
-                obs.default_ask_slos(
-                    p95_objective_ms=tcfg.slo_ask_p95_ms,
-                    availability=tcfg.slo_ask_availability,
-                    degraded_budget=tcfg.slo_ask_degraded_budget,
-                    short_windows=tcfg.slo_short_windows,
-                    long_windows=tcfg.slo_long_windows,
-                    burn_threshold=tcfg.slo_burn_threshold,
-                ),
+                slos,
                 registry=DEFAULT_REGISTRY,
                 recorder=obs.DEFAULT_RECORDER,
             )
@@ -539,12 +577,17 @@ class DocQARuntime:
                 # dispatch_* series: spine queue depth / lane occupancy
                 # gauges + per-stage device-time counters
                 spine=self.spine,
+                # retrieve_recall_* series: the shadow estimator's live
+                # recall/CI gauges (counters ride the registry scrape)
+                retrieval=self.retrieval_obs,
                 sample_every_s=tcfg.sample_every_s,
                 hbm_refresh_s=tcfg.hbm_refresh_s,
             )
 
     def start(self) -> "DocQARuntime":
         self.pipeline.start()
+        if self.retrieval_obs is not None:
+            self.retrieval_obs.start()
         if self.sampler is not None:
             self.sampler.start()
         self._warmup_thread = None
@@ -671,6 +714,16 @@ class DocQARuntime:
         # probe is fenced, but a clean join beats relying on fences)
         if self.sampler is not None:
             self.sampler.stop()
+        # retrieval observatory next: its worker submits spine work
+        # against the store/tier — join it before the index plane (and
+        # before the spine can close at interpreter exit).  Uninstall
+        # the process hook only if it is still OURS (tests boot several
+        # runtimes; a later runtime's observatory must survive an
+        # earlier one's stop)
+        if self.retrieval_obs is not None:
+            self.retrieval_obs.stop()
+            if obs.get_retrieval_observatory() is self.retrieval_obs:
+                obs.set_retrieval_observatory(None)
         self.pipeline.stop()
         if self.batcher is not None:
             self.batcher.stop()
@@ -849,6 +902,30 @@ def make_app(rt: DocQARuntime):
         return web.json_response(
             obs.telemetry_json(rt.telemetry, req.query.get("name"))
         )
+
+    async def api_retrieval(_req):
+        """Retrieval-quality observatory (docqa-recallscope): live
+        recall estimate + Wilson CI per (tier, nprobe), drift digests,
+        the measured nprobe recall/latency frontier, and the
+        recommended nprobe for the configured target — the evidence
+        surface docs/OPERATIONS.md's recall-regression runbook reads."""
+        if rt.retrieval_obs is None:
+            return json_error(
+                404,
+                "retrieval observatory disabled (retrieval_quality.enabled)",
+            )
+        payload = rt.retrieval_obs.status()
+        payload["serving"] = {
+            "serving_index": rt.cfg.store.serving_index,
+            "rows": rt.store.count,
+            "nprobe": getattr(rt.search_index, "nprobe", None),
+            "covered": getattr(rt.search_index, "covered", None),
+            "tail_rows": getattr(rt.search_index, "tail_rows", None),
+            "offmesh_fallbacks": DEFAULT_REGISTRY.counter(
+                "retrieve_offmesh_fallback"
+            ).value,
+        }
+        return web.json_response(payload)
 
     # ---- decode-engine pool (docs/OPERATIONS.md "Replica pool") -------------
 
@@ -1385,6 +1462,7 @@ def make_app(rt: DocQARuntime):
             web.get("/metrics", metrics),
             web.get("/api/metrics", api_metrics),
             web.get("/api/telemetry", api_telemetry),
+            web.get("/api/retrieval", api_retrieval),
             web.get("/api/traces", api_traces),
             web.get("/api/witness", api_witness),
             web.get("/api/trace/{trace_id}", api_trace_one),

@@ -55,7 +55,9 @@ TODO_MARK = "TODO"
 # ---------------------------------------------------------------------------
 
 
-def measure(telemetry_out: str | None = None) -> dict:
+def measure(
+    telemetry_out: str | None = None, retrieval_out: str | None = None
+) -> dict:
     """Deterministic CPU serving smoke; returns a bench-details-shaped
     dict (``degraded`` stamp + flat ``metrics``)."""
     import numpy as np
@@ -202,6 +204,55 @@ def measure(telemetry_out: str | None = None) -> dict:
         vs.search(probes, k=10)
         times.append((time.perf_counter() - t0) * 1e3)
     metrics["retrieve_p50_ms"] = round(float(np.median(times)), 2)
+
+    # retrieval-quality smoke (docqa-recallscope): a deterministic
+    # clustered corpus served tiered with the shadow estimator on every
+    # query.  The build (seeded k-center + Lloyd), the queries, and the
+    # greedy comparisons are all deterministic, so the recall estimate
+    # is a STRUCTURAL floor, not a timing: an IVF placement or probe
+    # regression shows up as this number collapsing.
+    from docqa_tpu.index.tiered import TieredIndex
+    from docqa_tpu.obs.retrieval_observatory import (
+        RetrievalObservatory,
+        set_retrieval_observatory,
+    )
+
+    rng_rq = np.random.default_rng(11)
+    sup = rng_rq.standard_normal((60, 32)).astype(np.float32)
+    sup /= np.linalg.norm(sup, axis=1, keepdims=True)
+    assign = rng_rq.integers(0, len(sup), 6000)
+    noise = rng_rq.standard_normal((6000, 32)).astype(np.float32)
+    noise /= np.linalg.norm(noise, axis=1, keepdims=True)
+    cvecs = sup[assign] + 0.5 * noise
+    cvecs /= np.linalg.norm(cvecs, axis=1, keepdims=True)
+    vs_rq = VectorStore(StoreConfig(dim=32, shard_capacity=8192))
+    vs_rq.add(cvecs, [{"doc_id": f"q{i}"} for i in range(len(cvecs))])
+    tiered = TieredIndex(
+        vs_rq, nprobe=8, min_rows=1000, rebuild_tail_rows=10**6,
+        n_clusters=64, seed=0,
+    )
+    tiered.rebuild()
+    robs = RetrievalObservatory(
+        sample_every=1, seed=0, frontier_every=4, min_frontier_n=1,
+        registry=DEFAULT_REGISTRY,
+    ).start()
+    set_retrieval_observatory(robs)
+    try:
+        qidx = np.arange(0, 6000, 150)  # 40 deterministic probes
+        q = cvecs[qidx] + 0.05 * sup[assign[qidx]]
+        for start in range(0, len(q), 8):
+            tiered.search(q[start : start + 8], k=10)
+        robs.drain(60)
+        rq_status = robs.status()
+    finally:
+        set_retrieval_observatory(None)
+        robs.stop()
+    est = rq_status.get("estimate") or {}
+    metrics["retrieve_recall_smoke"] = est.get("recall")
+    if retrieval_out:
+        with open(retrieval_out, "w", encoding="utf-8") as f:
+            json.dump(rq_status, f, indent=1)
+        print(f"retrieval-quality snapshot -> {retrieval_out}")
 
     result = {
         "degraded": False,
@@ -361,6 +412,11 @@ def write_baseline(
         # only move when the KV layout or the compile matrix changes
         "kv_bytes_per_token": ("lower", 10),
         "serve_compiled_programs": ("lower", 10),
+        # structural recall floor (docqa-recallscope): the smoke's
+        # shadow estimate over a fully deterministic clustered corpus —
+        # an IVF placement/probe regression, not machine jitter, is the
+        # only thing that moves it
+        "retrieve_recall_smoke": ("higher", 10),
         # structural prefix-cache gates (docqa-prefix): the smoke's
         # warm phase is deterministic, so a silent cache regression
         # (hit rate or avoided-token collapse) is a red build
@@ -435,6 +491,9 @@ def main() -> int:
     ap.add_argument("--report", help="write the gate report JSON here")
     ap.add_argument("--telemetry-out",
                     help="write the measure-mode telemetry snapshot here")
+    ap.add_argument("--retrieval-out",
+                    help="write the measure-mode retrieval-quality "
+                         "snapshot (recall estimate + frontier) here")
     args = ap.parse_args()
 
     if args.bench:
@@ -443,7 +502,10 @@ def main() -> int:
         print(f"gating bench result {args.bench}")
     else:
         print("measuring CPU serving smoke ...")
-        result = measure(telemetry_out=args.telemetry_out)
+        result = measure(
+            telemetry_out=args.telemetry_out,
+            retrieval_out=args.retrieval_out,
+        )
         print(f"measured: {json.dumps(result['metrics'], indent=1)}")
 
     if args.measure_only:
