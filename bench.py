@@ -2053,14 +2053,239 @@ def main() -> None:
             f"{rows['enabled']['warm_prefix_hit_rate']}"
         )
 
+    def sec_cost_attribution():
+        """Mixed-class serving window (docqa-costscope): interactive
+        /ask-shaped shorts + batch summarize-shaped longs + background
+        refresh driven CONCURRENTLY through one batcher whose KV pool is
+        deliberately overcommitted.  Reports per-class device-ms, KV
+        block-seconds, and shed counts; the per-class device-time sums
+        are cross-checked against the spine's measured
+        serve_prefill_fetch + serve_decode_chunk window (the share_sum
+        column — acceptance wants ~1.0), and the induced
+        BlockPoolExhausted shed's forensics snapshot must name the class
+        holding the majority of blocks."""
+        import threading as _threading
+
+        from docqa_tpu import obs as _obs
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        gen1 = S["gen1"]
+        cache_len = 1024 if not small else 256
+        ctx_len = 512 if not small else 128
+        n_interactive = 12 if not small else 4
+        n_batch = 4 if not small else 2
+        n_background = 2
+        n_slots = 6 if not small else 3
+        # overcommit: the pool holds ~2 batch longs + margin, so the
+        # concurrent mix must contend — the induced BlockPoolExhausted
+        # shed (at submit or mid-decode growth) is the point
+        pool_tokens = int(2.2 * (ctx_len + 96))
+        ledger = _obs.DEFAULT_COST_LEDGER
+        b = ContinuousBatcher(
+            gen1, n_slots=n_slots, chunk=8, cache_len=cache_len,
+            kv_pool_tokens=pool_tokens, max_queue=n_interactive // 2,
+        )
+        old_probe = ledger._pressure_probe
+        try:
+            ledger.set_pressure_probe(b.pressure_by_class)
+            b.warmup(buckets=b.gen.prefill_buckets[:1])
+            b.annotate_costs()
+            b.submit_ids([5, 9, 11], max_new_tokens=4).result()
+            rng = np.random.default_rng(3)
+            before = ledger.class_totals()
+            # the forensics ring is bounded and process-global: window
+            # membership is by timestamp, never by index (an earlier
+            # section may already have wrapped it)
+            t_window0 = time.time()
+            dispatch_fin = dispatch_window()
+            errors: dict = {}
+            lock = _threading.Lock()
+            waiters = []
+            t0 = time.perf_counter()
+
+            def drive(handle_fn, idx, cls):
+                try:
+                    handle_fn().result(timeout=300)
+                except Exception as e:
+                    with lock:
+                        errors.setdefault(cls, []).append(repr(e)[:80])
+
+            # batch longs FIRST: they seize the pool's blocks, so the
+            # interactive flood contends against batch-held HBM (the
+            # "who caused the shed" scenario the forensics must answer)
+            for i in range(n_batch):
+                ctx = rng.integers(3, 120, size=ctx_len).astype(int).tolist()
+                h = lambda p=ctx, i=i: b.submit_ids(
+                    p, max_new_tokens=64, req_class="batch",
+                    prefix_key=f"cost-batch-{i}",
+                )
+                w = _threading.Thread(target=drive, args=(h, i, "batch"))
+                w.start()
+                waiters.append(w)
+            for i in range(n_background):
+                h = lambda i=i: b.submit_ids(
+                    [3 + i, 5, 9], max_new_tokens=4, req_class="background",
+                )
+                w = _threading.Thread(
+                    target=drive, args=(h, i, "background")
+                )
+                w.start()
+                waiters.append(w)
+            for i in range(n_interactive):
+                h = lambda i=i: b.submit_ids(
+                    [7 + i % 13, 5, 9, 11, 3 + i % 7],
+                    max_new_tokens=16, req_class="interactive",
+                )
+                w = _threading.Thread(
+                    target=drive, args=(h, i, "interactive")
+                )
+                w.start()
+                waiters.append(w)
+            for w in waiters:
+                w.join()
+            wall = time.perf_counter() - t0
+            dispatch = dispatch_fin(wall)
+            bs = b.block_seconds()
+        finally:
+            ledger.set_pressure_probe(old_probe)
+            b.stop()
+            residual_after_stop = b.block_seconds()["residual"]
+            del b
+            gc.collect()
+        after = ledger.class_totals()
+        per_class = {}
+        attributed_ms = 0.0
+        for cls in ("interactive", "batch", "background"):
+            a, bf = after.get(cls, {}), before.get(cls, {})
+
+            def d(key):
+                return a.get(key, 0.0) - bf.get(key, 0.0)
+
+            dev = sum(
+                d(k) for k in (
+                    "prefill_device_ms_cold", "prefill_device_ms_warm",
+                    "decode_device_ms",
+                )
+            )
+            attributed_ms += dev
+            per_class[cls] = {
+                "requests": int(d("requests")),
+                "device_ms": round(dev, 2),
+                "kv_block_seconds": round(d("kv_block_seconds"), 4),
+                "decode_tokens": int(d("decode_tokens")),
+                "queue_wait_ms": round(d("queue_wait_ms"), 2),
+            }
+        spine_ms = sum(
+            row["device_ms"]
+            for name, row in dispatch["stages"].items()
+            if name in ("serve_prefill_fetch", "serve_decode_chunk")
+        )
+        share_sum = attributed_ms / spine_ms if spine_ms else None
+        new_sheds = [
+            s for s in ledger.sheds() if s["t_unix"] >= t_window0
+        ]
+        block_sheds = [
+            s for s in new_sheds if s["kind"] == "block_pool_exhausted"
+        ]
+        forensic = block_sheds[-1] if block_sheds else (
+            new_sheds[-1] if new_sheds else None
+        )
+        DETAILS["cost_attribution"] = {
+            "arrival": "concurrent mixed-class burst",
+            "pool_tokens": pool_tokens,
+            "per_class": per_class,
+            "errors": {k: len(v) for k, v in errors.items()},
+            "attributed_device_ms": round(attributed_ms, 2),
+            "spine_serve_device_ms": round(spine_ms, 2),
+            # acceptance: ~1.0 — the ledger partitions exactly the
+            # measured fetch values, so any gap is untraced traffic
+            # (canaries/warmup), not double counting.  `is not None`:
+            # an exactly-0.0 sum is a broken-attribution signal that
+            # must PRINT as 0.0, never masquerade as no-window
+            "share_sum": (
+                round(share_sum, 4) if share_sum is not None else None
+            ),
+            "kv_block_seconds_window": round(bs["billed"], 4),
+            "kv_residual_after_stop": round(residual_after_stop, 6),
+            "sheds_in_window": len(new_sheds),
+            "block_pool_sheds": len(block_sheds),
+            "forensics_example": forensic,
+            "majority_block_class": (
+                (forensic or {}).get("majority_block_class")
+            ),
+        }
+        log(
+            f"cost_attribution: per-class {per_class}; share_sum="
+            f"{DETAILS['cost_attribution']['share_sum']} "
+            f"(attributed {attributed_ms:.0f}ms of {spine_ms:.0f}ms "
+            f"spine serve); {len(block_sheds)} BlockPoolExhausted "
+            f"shed(s), majority holder "
+            f"{DETAILS['cost_attribution']['majority_block_class']}; "
+            f"kv residual {residual_after_stop:.2e}"
+        )
+
+    def sec_cost_overhead():
+        """Cost-ledger overhead A/B on the qa_e2e path, protocol
+        identical to sec_dispatch_overhead (acceptance: <= 2% on p50).
+        OFF = ledger disabled (open() returns None, every accounting
+        site short-circuits on the None guard); ON = the serving
+        default.  The delta isolates what per-request cost attribution
+        costs a served request."""
+        from docqa_tpu import obs as _obs
+
+        if S["gen1"] is None:
+            S["gen1"] = GenerateEngine(
+                dataclasses.replace(dec_cfg, quantize_weights=True), mesh=mesh
+            )
+        ask = make_ask(S["gen1"])
+        for q in q_texts[:2]:  # compile at the measured shapes
+            ask(q)
+        n_ab = max(n_e2e, 8)
+        queries = [q_texts[2 + i % n_queries] for i in range(n_ab)]
+
+        def run_p50() -> float:
+            lats = []
+            for q in queries:
+                t0 = time.perf_counter()
+                ask(q)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            return float(np.percentile(lats, 50))
+
+        ledger = _obs.DEFAULT_COST_LEDGER
+        try:
+            ledger.set_enabled(False)
+            p50_off = run_p50()
+        finally:
+            ledger.set_enabled(True)
+        p50_on = run_p50()
+        overhead = (p50_on - p50_off) / p50_off * 100.0 if p50_off else 0.0
+        DETAILS["cost_overhead"] = {
+            "qa_e2e_p50_off_ms": round(p50_off, 2),
+            "qa_e2e_p50_on_ms": round(p50_on, 2),
+            "overhead_pct": round(overhead, 2),
+            "samples": n_ab,
+            "budget_pct": 2.0,
+            "within_budget": overhead <= 2.0,
+        }
+        log(
+            f"cost-ledger overhead: p50 {p50_off:.1f}ms off -> "
+            f"{p50_on:.1f}ms on ({overhead:+.2f}%, budget 2%)"
+        )
+
     run_section("e2e_1b", sec_1b, 240)
     run_section("load_1b", sec_load_1b, 200)
     run_section("pool_scaling", sec_pool_scaling, 150)
     run_section("kv_paging", sec_kv_paging, 180)
     run_section("prefix_reuse", sec_prefix_reuse, 150)
+    run_section("cost_attribution", sec_cost_attribution, 150)
     run_section("trace_overhead", sec_trace_overhead, 90)
     run_section("telemetry_overhead", sec_telemetry_overhead, 90)
     run_section("dispatch_overhead", sec_dispatch_overhead, 60)
+    run_section("cost_overhead", sec_cost_overhead, 60)
 
     # ---- config 4: summarizer, 5 retrieved chunks ---------------------------
     docs = [

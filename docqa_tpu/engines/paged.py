@@ -36,7 +36,8 @@ from __future__ import annotations
 import collections
 import math
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from time import monotonic as _mono
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -75,13 +76,24 @@ class BlockTable:
     private blocks; ``release`` decrements instead of freeing blocks
     other tables still reference."""
 
-    __slots__ = ("blocks", "n_shared", "released", "_alloc")
+    __slots__ = (
+        "blocks", "n_shared", "released", "_alloc", "acc_base",
+        "billed_block_seconds",
+    )
 
     def __init__(self, alloc: "BlockAllocator") -> None:
         self.blocks: List[int] = []
         self.n_shared = 0
         self.released = False
         self._alloc = alloc
+        # block-second accounting (docqa-costscope): acc_base[i] is
+        # block blocks[i]'s unit-accrual reading at acquisition; the
+        # table's bill at release is the sum of deltas — ∫ dt/refcount
+        # over the holding interval per block, so prefix-SHARED blocks
+        # bill each holder fractionally and the sum over holders equals
+        # the block's total in-use time (exactness under sharing).
+        self.acc_base: List[float] = []
+        self.billed_block_seconds = 0.0
 
     @property
     def capacity(self) -> int:
@@ -116,7 +128,12 @@ class BlockAllocator:
     so shared-release-is-not-a-free is directly observable.
     """
 
-    def __init__(self, n_blocks: int, block_size: int) -> None:
+    def __init__(
+        self,
+        n_blocks: int,
+        block_size: int,
+        now_fn: Optional[Callable[[], float]] = None,
+    ) -> None:
         if n_blocks <= 0 or block_size <= 0:
             raise ValueError("n_blocks and block_size must be positive")
         self.n_blocks = int(n_blocks)
@@ -126,6 +143,34 @@ class BlockAllocator:
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
         self._refs = [0] * self.n_blocks
         self._in_use = 0
+        # ---- block-second ledger (docqa-costscope) ----
+        # Event-driven exact integrals on an injectable clock (tests
+        # step time explicitly).  Per block, _unit_acc accrues
+        # ∫ dt / refcount while the block is live — settled at every
+        # refcount change — so a holder's bill over [t0, t1] is the
+        # _unit_acc delta, and Σ over all holders of a block equals its
+        # plain in-use time.  _pool_acc is ∫ blocks_in_use dt (the pool
+        # total); _billed sums every released table's bill, so
+        # residual = total - billed is exactly the accrual still held
+        # by live tables: ZERO once everything has released (the
+        # drain/stop/chaos assertion).
+        self._now = now_fn or _mono
+        self._unit_acc = [0.0] * self.n_blocks
+        self._last_evt = [0.0] * self.n_blocks
+        self._pool_acc = 0.0
+        self._pool_last = self._now()
+        self._billed = 0.0
+
+    # ---- block-second ledger internals (caller holds self._lock) ---------
+
+    def _touch_pool_locked(self, now: float) -> None:
+        self._pool_acc += (now - self._pool_last) * self._in_use
+        self._pool_last = now
+
+    def _settle_locked(self, b: int, now: float) -> None:
+        if self._refs[b] > 0:
+            self._unit_acc[b] += (now - self._last_evt[b]) / self._refs[b]
+        self._last_evt[b] = now
 
     # ---- table lifecycle -------------------------------------------------
 
@@ -148,10 +193,14 @@ class BlockAllocator:
                     f"need {need} block(s), {len(self._free)} free "
                     f"(pool {self.n_blocks} x {self.block_size} tokens)"
                 )
+            now = self._now()
+            self._touch_pool_locked(now)
             for _ in range(need):
                 b = self._free.pop()
                 self._refs[b] = 1
+                self._last_evt[b] = now  # accrual restarts at refcount 0->1
                 table.blocks.append(b)
+                table.acc_base.append(self._unit_acc[b])
             self._in_use += need
 
     def share(self, table: BlockTable, blocks: Sequence[int]) -> None:
@@ -177,10 +226,15 @@ class BlockAllocator:
                         "cache pinned a block the allocator no longer "
                         "considers live"
                     )
+            now = self._now()
             for b in blocks:
+                # settle at the OLD refcount first: the interval up to
+                # now belongs to the existing holders alone
+                self._settle_locked(b, now)
                 self._refs[b] += 1
             table.blocks = list(blocks)
             table.n_shared = len(blocks)
+            table.acc_base = [self._unit_acc[b] for b in blocks]
 
     def release(self, table: BlockTable) -> None:
         with self._lock:
@@ -204,15 +258,25 @@ class BlockAllocator:
                         f"double free detected: block {b} already at "
                         "refcount 0"
                     )
-            for b in table.blocks:
+            now = self._now()
+            self._touch_pool_locked(now)
+            earned = 0.0
+            bases = table.acc_base
+            for i, b in enumerate(table.blocks):
+                self._settle_locked(b, now)
+                if i < len(bases):
+                    earned += self._unit_acc[b] - bases[i]
                 self._refs[b] -= 1
                 if self._refs[b] == 0:
                     # a SHARED release is not a free: the block returns
                     # only when its last referencing table lets go
                     self._free.append(b)
                     self._in_use -= 1
+            table.billed_block_seconds = earned
+            self._billed += earned
             table.blocks = []
             table.n_shared = 0
+            table.acc_base = []
 
     # ---- sizing / stats --------------------------------------------------
 
@@ -236,6 +300,23 @@ class BlockAllocator:
     def refcount(self, block: int) -> int:
         with self._lock:
             return self._refs[int(block)]
+
+    def block_seconds(self) -> Dict[str, float]:
+        """The pool's block-second ledger (docqa-costscope): ``total``
+        is ∫ blocks_in_use dt since construction, ``billed`` the sum of
+        every released table's bill, ``residual`` the accrual still
+        held by live tables — exactly zero after a full drain/stop (the
+        chaos/test assertion; shared blocks bill each holder
+        1/refcount, so the identity holds under prefix sharing too)."""
+        with self._lock:
+            self._touch_pool_locked(self._now())
+            total = self._pool_acc
+            billed = self._billed
+        return {
+            "total": total,
+            "billed": billed,
+            "residual": total - billed,
+        }
 
 
 # ---------------------------------------------------------------------------

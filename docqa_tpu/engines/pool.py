@@ -76,6 +76,7 @@ from docqa_tpu.engines.serve import (
     _req_mark,
     make_request,
 )
+from docqa_tpu.obs.costs import DEFAULT_COST_LEDGER
 from docqa_tpu.resilience.breaker import OPEN, CircuitBreaker
 from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger
@@ -538,10 +539,12 @@ class EnginePool:
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
         prefix_key: Optional[str] = None,
+        req_class: Optional[str] = None,
     ) -> PoolHandle:
         max_new = max_new_tokens or self.gen.max_new_tokens
         req = make_request(
-            prompt_ids, max_new, deadline=deadline, prefix_key=prefix_key
+            prompt_ids, max_new, deadline=deadline, prefix_key=prefix_key,
+            req_class=req_class,
         )
         self._dispatch(req)
         return PoolHandle(self, req)
@@ -552,6 +555,7 @@ class EnginePool:
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
         prefix_key: Optional[str] = None,
+        req_class: Optional[str] = None,
     ) -> PoolHandle:
         # same template-aware truncation contract as the bare batcher:
         # pool answers match solo-engine answers token-for-token
@@ -560,6 +564,7 @@ class EnginePool:
             max_new_tokens,
             deadline=deadline,
             prefix_key=prefix_key,
+            req_class=req_class,
         )
 
     def _routable(self, exclude=()) -> List[_Replica]:
@@ -641,11 +646,28 @@ class EnginePool:
             return r, n_full, len(candidates)
         return None, n_full, len(candidates)
 
+    def _shed(self, req, kind: str, exc: QueueFull) -> QueueFull:
+        """Terminal pool-level shed: forensics snapshot + cost-record
+        retirement (the pool owns the decision — per-replica refusals
+        along the way were routing, not sheds)."""
+        cls = req.cost.cls if req.cost is not None else None
+        DEFAULT_COST_LEDGER.record_shed(
+            kind, cls=cls, stage="pool_dispatch",
+            n_queued=exc.n_queued, n_active=exc.n_active,
+        )
+        if req.cost is not None:
+            DEFAULT_COST_LEDGER.retire(req.cost, "shed_queue")
+        return exc
+
     def _dispatch(self, req, exclude=()) -> None:
         """Route to the least-queued healthy replica; park when nothing
         is routable but a replica is draining/rebuilding (rolling
         restarts must not drop); shed only when genuinely out of
         capacity everywhere."""
+        # replica-level refusals are routing decisions, not terminal
+        # sheds: the flag keeps a refusing batcher from retiring the
+        # cost record a later replica will keep accruing to
+        req.pool_managed = True
         placed, n_full, n_candidates = self._try_place(req, exclude)
         if placed is not None:
             placed.routed += 1
@@ -662,11 +684,11 @@ class EnginePool:
         if n_full and n_full == n_candidates:
             # every healthy replica is at queue capacity: aggregate 503
             DEFAULT_REGISTRY.counter("pool_shed").inc()
-            raise QueueFull(
+            raise self._shed(req, "queue_full", QueueFull(
                 f"all {n_candidates} healthy replica(s) at capacity",
                 n_queued=self.n_queued,
                 n_active=self.n_active,
-            )
+            ))
         # no routable replica at all: park if one is coming back,
         # otherwise this IS an outage — shed typed
         with self._lock:
@@ -679,20 +701,20 @@ class EnginePool:
             if not coming_back:
                 # count parked directly: the n_queued property takes
                 # self._lock, which this thread already holds
-                raise QueueFull(
+                raise self._shed(req, "no_routable_replica", QueueFull(
                     "no routable replica",
                     n_queued=len(self._pending) + sum(
                         r.batcher.n_queued for r in self._replicas
                     ),
                     n_active=self.n_active,
-                )
+                ))
             if len(self._pending) >= (self.max_queue or 256):
                 DEFAULT_REGISTRY.counter("pool_shed").inc()
-                raise QueueFull(
+                raise self._shed(req, "queue_full", QueueFull(
                     "pool pending queue at capacity",
                     n_queued=len(self._pending),
                     n_active=self.n_active,
-                )
+                ))
             self._pending.append(req)
             DEFAULT_REGISTRY.counter("pool_parked").inc()
         _req_mark(req, "pool_parked", anomalous=False)
@@ -775,6 +797,11 @@ class EnginePool:
             req.error = DeadlineExceeded("pool_requeue")
             DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
             _req_mark(req, "deadline_exceeded", stage="pool_requeue")
+            DEFAULT_COST_LEDGER.record_shed(
+                "deadline",
+                cls=req.cost.cls if req.cost is not None else None,
+                stage="pool_requeue",
+            )
             _finish(req)
             return True  # handled (typed), not silently lost
         if req.hops >= self.requeue_max_hops:
@@ -989,7 +1016,9 @@ class EnginePool:
             dl = Deadline.after(self.canary_timeout_s)
             try:
                 r.canary = r.batcher.submit_request(
-                    make_request([1, 2, 3], 2, deadline=dl)
+                    make_request(
+                        [1, 2, 3], 2, deadline=dl, req_class="background"
+                    )
                 )
                 r.canary_deadline = dl
             except Exception as e:
@@ -1011,6 +1040,11 @@ class EnginePool:
                 req.error = DeadlineExceeded("pool_pending")
                 DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
                 _req_mark(req, "deadline_exceeded", stage="pool_pending")
+                DEFAULT_COST_LEDGER.record_shed(
+                    "deadline",
+                    cls=req.cost.cls if req.cost is not None else None,
+                    stage="pool_pending",
+                )
                 _finish(req)
                 continue
             placed, _, _ = self._try_place(req)
@@ -1096,14 +1130,19 @@ class EnginePool:
                 targets,
                 key=lambda x: (x.batcher.n_queued, x.batcher.n_active),
             )
+            # the twin rides the SAME trace (the timeline shows both
+            # lanes racing) and the SAME cost record, passed into
+            # make_request so no orphan record is ever minted — the
+            # duplicated decode is real cost of the one logical
+            # request.  cost_shadow keeps the twin's _finish from
+            # retiring the shared record.
             twin = make_request(
                 list(req.prompt_ids), req.max_new, deadline=req.deadline,
-                prefix_key=req.prefix_key,
+                prefix_key=req.prefix_key, cost=req.cost,
             )
-            # the twin rides the SAME trace so the timeline shows both
-            # lanes racing
             twin.trace = req.trace
             twin.span_parent = req.span_parent
+            twin.cost_shadow = True
             try:
                 r.batcher.submit_request(twin)
             except Exception:
@@ -1229,6 +1268,51 @@ class EnginePool:
             out["prefix_hit_rate"] = round(
                 out["prefix_hits"] / lookups, 4
             )
+        return out
+
+    def block_seconds(self) -> Dict[str, float]:
+        """Pool-wide block-second ledger (sums over replicas — each
+        allocator's total/billed/residual; docqa-costscope)."""
+        out = {"total": 0.0, "billed": 0.0, "residual": 0.0}
+        for r in self._replicas:
+            bs = r.batcher.block_seconds()
+            for k in out:
+                out[k] += bs[k]
+        return out
+
+    def pressure_by_class(self) -> Dict[str, Any]:
+        """Pool-wide shed-forensics snapshot: per-class KV blocks /
+        lanes / queue slots summed over replicas plus the pool-level
+        pending queue.  LOCK-FREE like the batcher's (it can run on a
+        shedding thread that already holds this pool's lock)."""
+        by: Dict[str, Dict[str, int]] = {}
+        out: Dict[str, Any] = {
+            "by_class": by, "free_blocks": 0, "blocks_total": 0,
+        }
+        for r in self._replicas:
+            snap = r.batcher.pressure_by_class()
+            for cls, row in snap.get("by_class", {}).items():
+                dst = by.setdefault(
+                    cls, {"kv_blocks": 0, "lanes": 0, "queued": 0}
+                )
+                for k in ("kv_blocks", "lanes", "queued"):
+                    dst[k] += row.get(k, 0)
+            out["free_blocks"] += snap.get("free_blocks", 0)
+            out["blocks_total"] += snap.get("blocks_total", 0)
+            if "prefix_cache_blocks" in snap:
+                out["prefix_cache_blocks"] = (
+                    out.get("prefix_cache_blocks", 0)
+                    + snap["prefix_cache_blocks"]
+                )
+        try:
+            parked = list(self._pending)
+        except RuntimeError:  # deque mutated mid-iteration (lock-free)
+            parked = []
+        for req in parked:
+            cls = req.cost.cls if req.cost is not None else "other"
+            by.setdefault(
+                cls, {"kv_blocks": 0, "lanes": 0, "queued": 0}
+            )["queued"] += 1
         return out
 
     def status(self) -> Dict[str, Any]:

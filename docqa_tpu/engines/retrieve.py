@@ -23,6 +23,8 @@ therefore pays the same single host->device round-trip as one chip.
 from __future__ import annotations
 
 import functools
+import hashlib
+import secrets
 from time import perf_counter
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -58,6 +60,25 @@ QUERY_BATCH_BUCKETS = (1, 4, 16)
 # (one warning per process names the condition, a log line per request
 # would be noise exactly when a mesh serves sustained traffic)
 _OFFMESH_WARNED = False
+
+# per-process random salt for shadow-job query hashes: same query ->
+# same label within a process (dedup), unlinkable to content across
+# processes or dumps (PHI policy — the hash is of the query EMBEDDING,
+# so no reversible text derivative exists anywhere in the shadow queue)
+_SHADOW_HASH_SALT = secrets.token_bytes(16)
+
+
+def salted_query_hashes(emb) -> List[str]:
+    """Salted, process-local content labels for sampled shadow queries
+    (obs/retrieval_observatory.py job attrs): dedup/diagnostics without
+    holding any text."""
+    rows = np.asarray(emb, np.float32)
+    return [
+        hashlib.sha1(
+            _SHADOW_HASH_SALT + row.tobytes()
+        ).hexdigest()[:12]
+        for row in rows
+    ]
 
 
 def sharded_search(store_mesh, emb, buf, count, mask, k: int):
@@ -282,7 +303,11 @@ class FusedTieredRetriever:
                 else:  # empty tail: nothing to scan
                     tail_vals = jnp.zeros((q.shape[0], 0), jnp.float32)
                     tail_ids = jnp.zeros((q.shape[0], 0), jnp.int32)
-                return bulk_vals, bulk_ids, tail_vals, tail_ids
+                # the query embeddings ride out too (tiny [n, d] fetch):
+                # the shadow-sampling hook holds THEM — never the raw
+                # query texts — for its exact ground-truth scan and the
+                # frontier probes (PHI policy, obs/retrieval_observatory)
+                return bulk_vals, bulk_ids, tail_vals, tail_ids, emb
 
             fn = jax.jit(program)
             self._fns[key] = fn
@@ -403,7 +428,7 @@ class FusedTieredRetriever:
             # async like the exact path: the lane covers trace/compile +
             # enqueue; the np.asarray fetches below block on the caller
             # (an executor lane, not a dispatch stream) as before
-            bulk_vals, bulk_ids, tail_vals, tail_ids = spine_run(
+            bulk_vals, bulk_ids, tail_vals, tail_ids, emb_dev = spine_run(
                 "retrieve", _tiered_on_lane, deadline=deadline
             )
         bulk_vals = np.asarray(bulk_vals, np.float32)[:n]
@@ -450,13 +475,13 @@ class FusedTieredRetriever:
             (perf_counter() - t_merge) * 1e3
         )
         self._observe_quality(
-            texts, out, ivf, covered, covered + n_live, k, nprobe
+            emb_dev, out, ivf, covered, covered + n_live, k, nprobe
         )
         return out
 
     def _observe_quality(
         self,
-        texts: Sequence[str],
+        emb_dev,  # device array: materialized ONLY for sampled requests
         out: List[List[SearchResult]],
         ivf,
         covered: int,
@@ -465,40 +490,37 @@ class FusedTieredRetriever:
         nprobe: int,
     ) -> None:
         """Shadow-sampling hook for the fused path (docqa-recallscope).
-        Ground truth is the SAME fused exact program the pre-tier path
-        serves (encode + masked exact top-k in one dispatch), relabeled
-        onto the background ``probe`` stream under ``retrieve_shadow``;
-        its returned query embeddings feed the neighbor-nprobe frontier
-        probes so the shadow never re-encodes."""
+        Ground truth is the store's exact shadow scan over the SERVED
+        dispatch's own query embeddings (the fused program returns them
+        — no re-encode, and crucially **no raw query text** is ever
+        held by the pending shadow closure: only the embeddings plus a
+        salted content hash for dedup/labels, closing the PHI caveat
+        docs/OBSERVABILITY.md used to carry).  Runs on the background
+        ``probe`` stream under ``retrieve_shadow``; the embeddings also
+        feed the neighbor-nprobe frontier probes."""
         robs = get_retrieval_observatory()
         if robs is None or not robs.sample():
+            # unsampled (or observatory off): the device embeddings are
+            # never fetched — the hot path pays nothing beyond the
+            # extra program output riding the already-async dispatch
             return
         served = [[(r.row_id, r.score) for r in row] for row in out]
         margins = [
             row[0].score - row[-1].score for row in out if len(row) >= 2
         ]
-        texts_copy = list(texts)
-        exact = self._exact
+        q_copy = np.array(
+            np.asarray(emb_dev, np.float32)[: len(out)], copy=True
+        )
+        norms = [float(x) for x in np.linalg.norm(q_copy, axis=1)]
+        store = self.tiered.store
         count_cap = seen_count
 
         def shadow_fn():
-            rows, emb = exact.search_texts(
-                texts_copy, k=k, stage="retrieve_shadow", stream="probe",
-                return_emb=True,
+            rows = store.shadow_search(q_copy, k, count_cap=count_cap)
+            return (
+                [[(r.row_id, r.score) for r in row] for row in rows],
+                q_copy,
             )
-            # the fused program scans the CURRENT count; clamp hits to
-            # the rows the served query could have seen (ids beyond the
-            # serving snapshot are a concurrent-ingest artifact, not a
-            # tier miss)
-            rows = [
-                [
-                    (r.row_id, r.score)
-                    for r in row
-                    if r.row_id < count_cap
-                ]
-                for row in rows
-            ]
-            return rows, emb
 
         robs.submit(
             ShadowJob(
@@ -512,7 +534,9 @@ class FusedTieredRetriever:
                 frontier_fn=lambda qn, p: ivf.timed_probe(qn, k=k, nprobe=p),
                 covered=covered,
                 n_clusters=ivf.n_clusters,
+                query_norms=norms,
                 served_margins=margins,
+                attrs={"query_hashes": salted_query_hashes(q_copy)},
             )
         )
 

@@ -86,6 +86,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from docqa_tpu import obs
+from docqa_tpu.obs.costs import DEFAULT_COST_LEDGER, cost_record_of
+from docqa_tpu.obs.observatory import DEFAULT_OBSERVATORY
 from docqa_tpu.engines.paged import (
     BlockAllocator,
     OutOfBlocks,
@@ -97,7 +99,7 @@ from docqa_tpu.engines.paged import (
     share_alignment,
 )
 from docqa_tpu.engines.generate import accept_drafts, draft_tokens
-from docqa_tpu.engines.spine import spine_run
+from docqa_tpu.engines.spine import spine_run, spine_submit
 from docqa_tpu.models.decoder import (
     init_decoder_params,  # noqa: F401  (re-export convenience for tests)
 )
@@ -131,6 +133,12 @@ class _Request:
     trace: Optional[obs.Trace] = None
     span_parent: Optional[str] = None
     t_submit: float = 0.0
+    # when the request last ENTERED a queue (reset on every requeue /
+    # block-pool bounce): the cost ledger's queue-wait field sums
+    # disjoint per-entry intervals, so a bounced request never counts
+    # the same wait twice.  t_submit stays the original submission time
+    # (the trace span's anchor).
+    t_queue: float = 0.0
     # pool failover budget (engines/pool.py): how many replica hops this
     # request has already made.  A request is requeued at most
     # ``requeue_max_hops`` times — unbounded hopping would let one poison
@@ -148,6 +156,17 @@ class _Request:
     # the session-affinity routing key in engines/pool.py.  None =
     # always-cold (canaries, bulk tools, foreign prompts).
     prefix_key: Optional[str] = None
+    # per-class cost attribution (docqa-costscope; obs/costs.py): the
+    # request's CostRecord — queue wait, prefill/decode device-ms, KV
+    # block-seconds all land here; retired exactly once at _finish.
+    # None = unaccounted (ledger disabled).
+    cost: Optional[Any] = None
+    # a hedge twin SHARES its primary's record (the duplicated decode is
+    # real cost of the one logical request) but must not retire it
+    cost_shadow: bool = False
+    # pool-managed requests are shed/retired by the POOL's terminal
+    # decision, not by one replica's refusal (which routing may retry)
+    pool_managed: bool = False
 
 
 def make_request(
@@ -155,9 +174,16 @@ def make_request(
     max_new: int,
     deadline: Optional[Deadline] = None,
     prefix_key: Optional[str] = None,
+    req_class: Optional[str] = None,
+    cost: Optional[Any] = None,
 ) -> _Request:
     """Build a :class:`_Request`, capturing the SUBMITTER's trace position
     (the worker thread records every later stage on it explicitly).
+
+    ``req_class`` stamps the request's cost class (docqa-costscope) when
+    no class-stamped record is already attached to the submitter's
+    trace — the HTTP layer attaches one per endpoint; canaries, warmups
+    and bulk tools pass their class explicitly.
 
     Module-level so :class:`~docqa_tpu.engines.pool.EnginePool` can mint a
     request before it knows which replica will run it — the same request
@@ -175,8 +201,46 @@ def make_request(
     if ctx is not None:
         req.trace = ctx.trace
         req.span_parent = ctx.span_id
+    # record resolution order: an explicitly shared record (the pool's
+    # hedge twin rides its primary's), else the trace's endpoint-stamped
+    # one, else a fresh open — never two records for one request
+    req.cost = cost if cost is not None else cost_record_of(req.trace)
+    if req.cost is None:
+        req.cost = DEFAULT_COST_LEDGER.open(
+            req_class or "interactive", session=prefix_key
+        )
+    else:
+        req.cost.set_session(prefix_key)
     req.t_submit = _now()
+    req.t_queue = req.t_submit
     return req
+
+
+def _cost_add(req: _Request, field: str, value: float) -> None:
+    if req.cost is not None and value:
+        req.cost.add(field, value)
+
+
+def _cost_outcome(req: _Request) -> str:
+    """Map a finished request's typed error to its ledger outcome."""
+    from docqa_tpu.engines.spine import SpineSaturated
+
+    e = req.error
+    if e is None:
+        return "ok"
+    if isinstance(e, DeadlineExceeded):
+        return "shed_deadline"
+    if isinstance(e, BlockPoolExhausted):
+        return "shed_block_pool"
+    if isinstance(e, SpineSaturated):
+        return "shed_spine"
+    if isinstance(e, QueueFull):
+        return "shed_queue"
+    if isinstance(e, RequestCancelled):
+        return "cancelled"
+    if isinstance(e, WorkerDied):
+        return "failed_replica"
+    return "error"
 
 
 def _req_span(req: _Request, name: str, t0: float, t1: float, **attrs) -> None:
@@ -207,7 +271,12 @@ DEFAULT_RESULT_TIMEOUT = 600.0
 def _finish(req: _Request) -> None:
     """Mark a request terminal and wake streamers — the ONE completion
     path (done without a cv notify would leave ``iter_tokens`` blocked
-    until its wait timeout)."""
+    until its wait timeout).  Also the one cost-retirement point: the
+    record folds into the per-class ledger with a TYPED outcome
+    (exactly once — the ledger guards; a hedge twin never retires its
+    shared record)."""
+    if req.cost is not None and not req.cost_shadow:
+        DEFAULT_COST_LEDGER.retire(req.cost, _cost_outcome(req))
     req.done.set()
     with req.cv:
         req.cv.notify_all()
@@ -1125,12 +1194,13 @@ class ContinuousBatcher:
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
         prefix_key: Optional[str] = None,
+        req_class: Optional[str] = None,
     ) -> Handle:
         max_new = max_new_tokens or self.gen.max_new_tokens
         return self.submit_request(
             make_request(
                 prompt_ids, max_new, deadline=deadline,
-                prefix_key=prefix_key,
+                prefix_key=prefix_key, req_class=req_class,
             )
         )
 
@@ -1169,6 +1239,10 @@ class ContinuousBatcher:
                         req, "block_pool_exhausted",
                         n_queued=len(self._queue),
                     )
+                    self._record_shed(
+                        req, "block_pool_exhausted", stage="serve_submit",
+                        n_queued=len(self._queue), n_active=n_active,
+                    )
                     raise BlockPoolExhausted(
                         "KV block pool exhausted and generation queue at "
                         f"capacity ({self.max_queue})",
@@ -1178,11 +1252,17 @@ class ContinuousBatcher:
                 _req_mark(
                     req, "queue_full", n_queued=len(self._queue)
                 )
+                self._record_shed(
+                    req, "queue_full", stage="serve_submit",
+                    n_queued=len(self._queue), n_active=n_active,
+                )
                 raise QueueFull(
                     f"generation queue at capacity ({self.max_queue})",
                     n_queued=len(self._queue),
                     n_active=n_active,
                 )
+            req.t_queue = _now()  # (re-)entering this queue: the cost
+            # ledger's queue-wait interval restarts (requeue-safe)
             self._queue.append(req)
             n_queued = len(self._queue)
             self._cv.notify_all()
@@ -1199,6 +1279,7 @@ class ContinuousBatcher:
         max_new_tokens: Optional[int] = None,
         deadline: Optional[Deadline] = None,
         prefix_key: Optional[str] = None,
+        req_class: Optional[str] = None,
     ) -> Handle:
         # same text entry contract as GenerateEngine.generate_texts: the
         # configured chat template wraps here too (template-aware
@@ -1210,6 +1291,7 @@ class ContinuousBatcher:
             max_new_tokens,
             deadline=deadline,
             prefix_key=prefix_key,
+            req_class=req_class,
         )
 
     def generate_texts(
@@ -1233,7 +1315,10 @@ class ContinuousBatcher:
             while True:
                 try:
                     handles.append(
-                        self.submit_text(p, max_new_tokens, deadline=deadline)
+                        self.submit_text(
+                            p, max_new_tokens, deadline=deadline,
+                            req_class="batch",
+                        )
                     )
                     break
                 except DeadlineExceeded as e:
@@ -1492,6 +1577,82 @@ class ContinuousBatcher:
             out["prefix_tokens_avoided"] = pstats["tokens_avoided"]
         return out
 
+    def block_seconds(self) -> Dict[str, float]:
+        """The paged pool's block-second ledger (docqa-costscope):
+        total/billed/residual — residual must read ~0 after drain/stop
+        (tests + chaos assert it)."""
+        return self._alloc.block_seconds()
+
+    def pressure_by_class(self) -> Dict[str, Any]:
+        """Per-class holdings snapshot for shed forensics
+        (obs/costs.py): which classes hold how many KV blocks, decode
+        lanes, and queue slots RIGHT NOW.  Deliberately LOCK-FREE — it
+        runs on the shedding thread, possibly under this batcher's own
+        ``_cv`` (submit-path sheds) or from another replica's context,
+        and a probe that took locks could order them against every
+        replica's.  A snapshot racing a transition miscounting one lane
+        is fine for forensics."""
+
+        def _cls(req) -> str:
+            return req.cost.cls if req.cost is not None else "other"
+
+        by: Dict[str, Dict[str, int]] = {}
+
+        def row(cls: str) -> Dict[str, int]:
+            return by.setdefault(
+                cls, {"kv_blocks": 0, "lanes": 0, "queued": 0}
+            )
+
+        for slot in range(self.n_slots):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            r = row(_cls(req))
+            r["lanes"] += 1
+            table = self._slot_table[slot]
+            if table is not None:
+                r["kv_blocks"] += len(table.blocks)
+        try:
+            queued = list(self._queue)
+        except RuntimeError:  # deque mutated mid-iteration (lock-free)
+            queued = []
+        for req in queued:
+            row(_cls(req))["queued"] += 1
+        out: Dict[str, Any] = {
+            "by_class": by,
+            "free_blocks": self._alloc.n_free,
+            "blocks_total": self.n_blocks,
+        }
+        if self._prefix_cache is not None:
+            out["prefix_cache_blocks"] = int(
+                self._prefix_cache.stats()["pinned_blocks"]
+            )
+        return out
+
+    def _record_shed(self, req: "_Request", kind: str, **attrs) -> None:
+        """Shed forensics + terminal cost retirement for a request this
+        batcher refuses at submit.  POOL-MANAGED requests skip BOTH: a
+        single replica's refusal is a routing decision the pool may
+        still resolve on another replica — only the pool's terminal
+        ``_shed`` records forensics (once, not once per refusing
+        replica) and retires the record.  Safe under ``self._cv``: the
+        pressure probe is lock-free by design."""
+        if req.pool_managed or req.cost_shadow:
+            # routing refusals, not sheds: the pool may place a managed
+            # request elsewhere, and a refused HEDGE TWIN leaves its
+            # primary running — retiring the twin's SHARED record here
+            # would mark a request that goes on to answer OK as shed
+            return
+        cls = req.cost.cls if req.cost is not None else None
+        DEFAULT_COST_LEDGER.record_shed(kind, cls=cls, **attrs)
+        if req.cost is not None:
+            DEFAULT_COST_LEDGER.retire(
+                req.cost,
+                "shed_block_pool"
+                if kind == "block_pool_exhausted"
+                else "shed_queue",
+            )
+
     # ---- worker loop ---------------------------------------------------------
 
     def _admit_round(self, pairs: List[Tuple[int, "_Request"]]):
@@ -1533,6 +1694,11 @@ class ContinuousBatcher:
                 )
                 DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
                 _req_mark(req, "deadline_exceeded", stage="serve_admit")
+                DEFAULT_COST_LEDGER.record_shed(
+                    "deadline",
+                    cls=req.cost.cls if req.cost is not None else None,
+                    stage="serve_admit",
+                )
                 _finish(req)
                 continue
             try:
@@ -1560,8 +1726,11 @@ class ContinuousBatcher:
                 # check and here (same thread, so only by THIS round's
                 # earlier allocations) — requeue at the head, keep
                 # order.  Release FIRST: a partial share would otherwise
-                # strand refcounts on a table nobody owns.
+                # strand refcounts on a table nobody owns.  The moment
+                # of holding still bills (exact accounting: the bounce
+                # held real blocks, however briefly).
                 table.release()
+                _cost_add(req, "kv_block_seconds", table.billed_block_seconds)
                 DEFAULT_REGISTRY.counter("serve_block_pool_wait").inc()
                 _req_mark(
                     req, "block_pool_exhausted", queued=True,
@@ -1600,6 +1769,7 @@ class ContinuousBatcher:
             sent = {id(r) for r in send_back}
             with self._cv:
                 for req in reversed(send_back):
+                    req.t_queue = _now()  # fresh queue-wait interval
                     self._queue.appendleft(req)
                 # queue-resident again: drop them from the admission
                 # window NOW, not at the round's end — a worker death in
@@ -1785,7 +1955,10 @@ class ContinuousBatcher:
                     prompt_tokens=len(ids), blocks=len(table.blocks),
                     shared_tokens=shared,
                 )
-        meta = [(slot, req, len(ids)) for slot, req, ids, _t, _s in ordered]
+        meta = [
+            (slot, req, len(ids), shared)
+            for slot, req, ids, _t, shared in ordered
+        ]
         # the groups' token budgets ride along as the admission fetch's
         # cost keys (observatory MFU accounting; warm groups accrue
         # under their own ("warm", T) cost models)
@@ -1813,23 +1986,54 @@ class ContinuousBatcher:
         try:
             # ONE device fetch, on a spine lane: its duration is the
             # round's device time at the one-fetch boundary, and the
-            # group token budgets are the cost keys MFU accrues under
-            firsts = spine_run(
+            # group token budgets are the cost keys MFU accrues under.
+            # Submitted (not run) so the ticket's measured
+            # queue-wait/device split survives for cost attribution.
+            ticket = spine_submit(
                 "serve_prefill_fetch",
                 lambda: np.asarray(round_toks),
                 cost_key=cost_keys,
-            )[: len(meta)]
+            )
+            firsts = ticket.result()[: len(meta)]
         except Exception as e:
             log.exception("admission fetch failed; resetting")
             self._fail_active(e)
             return False
-        for (slot, req, _n_ids), first in zip(meta, firsts):
+        # ---- per-request cost attribution (docqa-costscope): split the
+        # round's measured device time across its requests proportional
+        # to the NOVEL (suffix) tokens each one packed — warm lanes bill
+        # under the warm field with their avoided tokens recorded, so
+        # the per-class sums reconcile against the serve_prefill_fetch
+        # dispatch series exactly (same measured value, partitioned).
+        sfx = [max(n_ids - shared, 1) for _s, _r, n_ids, shared in meta]
+        total_sfx = float(sum(sfx)) or 1.0
+        flops_total = 0.0
+        for key in cost_keys:
+            c = DEFAULT_OBSERVATORY.cost_of("serve_prefill_fetch", key)
+            if c is not None:
+                flops_total += c["flops"]
+        dev_ms = ticket.device_s * 1e3
+        qw_ms = ticket.queue_wait_s * 1e3
+        for (slot, req, n_ids, shared), n_sfx in zip(meta, sfx):
+            share = n_sfx / total_sfx
+            field = (
+                "prefill_device_ms_warm" if shared
+                else "prefill_device_ms_cold"
+            )
+            _cost_add(req, field, dev_ms * share)
+            _cost_add(req, "spine_queue_wait_ms", qw_ms * share)
+            _cost_add(req, "prefill_tokens", n_ids)
+            _cost_add(req, "prefill_tokens_avoided", shared)
+            if flops_total:
+                _cost_add(req, "flops_est", flops_total * share)
+        for (slot, req, _n_ids, _shared), first in zip(meta, firsts):
             first = int(first)
             budget = self._slot_budget[slot]
             if first == self.gen.eos_id or budget <= 0:
                 self._retire(slot)
             else:
                 req.tokens.append(first)
+                _cost_add(req, "decode_tokens", 1)
                 _req_mark(req, "first_token", anomalous=False, slot=slot)
                 with req.cv:  # the first streamed token
                     req.cv.notify_all()
@@ -1847,29 +2051,49 @@ class ContinuousBatcher:
             self._active = self._active.at[idx].set(False)
             self._deact_pending = []
 
-    def _release_slot_blocks(self, slot: int) -> None:
+    def _release_slot_blocks(
+        self, slot: int, req: Optional[_Request] = None
+    ) -> None:
         """Return a slot's KV blocks to the pool (idempotent via the
         allocator) and sentinel its device-table row so in-flight
-        programs drop any further write through it."""
+        programs drop any further write through it.
+
+        The release is also where the slot's KV **block-seconds** bill
+        lands (docqa-costscope): the allocator computes the exact
+        refcount-aware integral at release, and it is credited to the
+        occupant's cost record — including POST-retirement (late-add),
+        so a teardown sweep that releases after the typed failure still
+        bills exactly once (the ``was_released`` guard: only the call
+        that performed the release credits)."""
+        if req is None:
+            req = self._slot_req[slot]
         table = self._slot_table[slot]
         self._slot_table[slot] = None
         self._block_rows[slot, :] = self.n_blocks
         self._caps_np[slot] = 0
         self._tables_dirty = True
         if table is not None:
+            was_released = table.released
             table.release()
+            if not was_released and req is not None:
+                _cost_add(
+                    req, "kv_block_seconds", table.billed_block_seconds
+                )
 
     def _fail_active(self, err: BaseException) -> None:
         """Fail all in-flight requests, free their blocks, and rebuild
         clean device state."""
         for slot in range(self.n_slots):
             req = self._slot_req[slot]
+            self._slot_req[slot] = None
+            # release (and bill KV block-seconds) BEFORE _finish retires
+            # the cost record, so the victim's trace summary carries
+            # what it held — same order as _retire
+            self._release_slot_blocks(slot, req=req)
             if req is not None:
                 req.error = RuntimeError(f"decode failed: {err!r}")
                 _req_mark(req, "decode_failed", slot=slot)
                 _finish(req)
-                self._slot_req[slot] = None
-            self._release_slot_blocks(slot)
         # the reset below replaces the device pools: every cached prefix
         # row is garbage from here — invalidate the whole cache (pins
         # release; warm admissions start over against the fresh pools)
@@ -1892,8 +2116,10 @@ class ContinuousBatcher:
         self._slot_req[slot] = None
         # eviction returns blocks IMMEDIATELY: the freed HBM admits the
         # next queued request this same worker iteration — the whole
-        # point of paging over per-slot worst-case reservation
-        self._release_slot_blocks(slot)
+        # point of paging over per-slot worst-case reservation.  The
+        # occupant rides along so its KV bill lands BEFORE _finish
+        # retires the cost record.
+        self._release_slot_blocks(slot, req=req)
         if req is not None:
             _finish(req)
             # serve_completed counts SUCCESSES: a lane retired carrying
@@ -1930,11 +2156,12 @@ class ContinuousBatcher:
             # duration is the chunk's device time at the one-fetch
             # boundary, accrued under the decode program's cost model.
             with span("serve_decode_chunk", DEFAULT_REGISTRY):
-                packed_h = spine_run(
+                ticket = spine_submit(
                     "serve_decode_chunk",
                     lambda: np.asarray(packed_dev),
                     cost_key="decode",
                 )
+                packed_h = ticket.result()
         except Exception as e:
             # the cache was donated into a failed dispatch — fail every
             # in-flight request, reset device state, and keep serving
@@ -1952,6 +2179,28 @@ class ContinuousBatcher:
         # device → fetch path just worked); the pool skips synthetic
         # canaries while this stays fresh
         self._last_progress = time_monotonic()
+        # ---- per-request cost attribution (docqa-costscope): the
+        # chunk's measured device time splits EQUALLY across the lanes
+        # live at dispatch (every live lane advanced the same number of
+        # in-program steps) — a retired-in-flight occupant still owns
+        # its share (late-add).  Partitioning the same measured value
+        # keeps per-class sums reconcilable against the
+        # serve_decode_chunk dispatch series.
+        charged = [r for r in snap if r is not None]
+        if charged:
+            dev_ms = ticket.device_s * 1e3 / len(charged)
+            qw_ms = ticket.queue_wait_s * 1e3 / len(charged)
+            cost_model = DEFAULT_OBSERVATORY.cost_of(
+                "serve_decode_chunk", "decode"
+            )
+            fl = (
+                cost_model["flops"] / len(charged) if cost_model else 0.0
+            )
+            for req in charged:
+                _cost_add(req, "decode_device_ms", dev_ms)
+                _cost_add(req, "spine_queue_wait_ms", qw_ms)
+                if fl:
+                    _cost_add(req, "flops_est", fl)
         if self.spec_k:
             width = self.chunk + 2 * self.spec_k
             out_h = packed_h[:, :width]
@@ -1987,6 +2236,7 @@ class ContinuousBatcher:
                 req, "serve_decode_chunk", t_fetch0, t_fetch1,
                 slot=slot, tokens=len(req.tokens) - before,
             )
+            _cost_add(req, "decode_tokens", len(req.tokens) - before)
             if len(req.tokens) > before:  # wake streamers per chunk
                 with req.cv:
                     req.cv.notify_all()
@@ -2011,6 +2261,11 @@ class ContinuousBatcher:
                 )
                 DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
                 _req_mark(req, "deadline_exceeded", stage="serve_decode")
+                DEFAULT_COST_LEDGER.record_shed(
+                    "deadline",
+                    cls=req.cost.cls if req.cost is not None else None,
+                    stage="serve_decode",
+                )
             # hedged-dispatch loser retires at this chunk boundary: the
             # winning replica already owns the answer, so the lane frees
             # for queued work instead of decoding a duplicate to the end
@@ -2134,6 +2389,13 @@ class ContinuousBatcher:
                 # queue-wait is over either way (admitted or shed) —
                 # the stage BENCH_r05 could not see
                 _req_span(req, "serve_queue_wait", req.t_submit, _now())
+                # cost wait = THIS queue entry's interval only (t_queue
+                # resets on every requeue, so bounced/rescued requests
+                # sum disjoint intervals instead of re-counting)
+                _cost_add(
+                    req, "queue_wait_ms",
+                    (_now() - (req.t_queue or req.t_submit)) * 1e3,
+                )
                 if req.cancelled:
                     # hedged-dispatch loser (or abandoned client) still
                     # queued: drop before it costs a prefill lane
@@ -2154,6 +2416,11 @@ class ContinuousBatcher:
                     DEFAULT_REGISTRY.counter("serve_deadline_shed").inc()
                     _req_mark(
                         req, "deadline_exceeded", stage="serve_queue"
+                    )
+                    DEFAULT_COST_LEDGER.record_shed(
+                        "deadline",
+                        cls=req.cost.cls if req.cost is not None else None,
+                        stage="serve_queue",
                     )
                     _finish(req)
                     continue
@@ -2241,7 +2508,7 @@ class ContinuousBatcher:
         for slot in range(self.n_slots):
             req = self._slot_req[slot]
             self._slot_req[slot] = None
-            self._release_slot_blocks(slot)
+            self._release_slot_blocks(slot, req=req)
             if req is not None and not req.done.is_set():
                 req.error = err
                 _req_mark(req, "worker_died", slot=slot)
@@ -2363,6 +2630,15 @@ class ContinuousBatcher:
                     )
                     DEFAULT_REGISTRY.counter("serve_block_shed").inc()
                     _req_mark(req, "block_pool_exhausted", slot=slot)
+                    # forensics BEFORE the retire frees its blocks: the
+                    # snapshot must show the holdings that caused the
+                    # shed, including the victim's own
+                    DEFAULT_COST_LEDGER.record_shed(
+                        "block_pool_exhausted",
+                        cls=req.cost.cls if req.cost is not None else None,
+                        stage="serve_decode_grow",
+                        lane_tokens=est,
+                    )
                     self._retire(slot)
                     shed_slots.append(slot)
             if shed_slots:

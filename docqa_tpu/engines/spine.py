@@ -97,7 +97,7 @@ class _Item:
     __slots__ = (
         "stage", "stream", "fn", "args", "kwargs", "cost_key", "sync",
         "deadline", "trace", "span_parent", "t_submit", "done", "result",
-        "error", "cancelled", "started",
+        "error", "cancelled", "started", "queue_wait_s", "device_s",
     )
 
     def __init__(self, stage, stream, fn, args, kwargs, cost_key, sync,
@@ -118,6 +118,11 @@ class _Item:
         self.error: Optional[BaseException] = None
         self.cancelled = False
         self.started = False
+        # filled by _account; SpineTicket exposes them so call sites
+        # (the batcher's cost attribution) can read an item's measured
+        # split without re-deriving it from wall clocks
+        self.queue_wait_s = 0.0
+        self.device_s = 0.0
 
 
 class SpineTicket:
@@ -164,6 +169,20 @@ class SpineTicket:
     @property
     def done(self) -> bool:
         return self._item.done.is_set()
+
+    @property
+    def queue_wait_s(self) -> float:
+        """Measured submit→lane wait (valid once done)."""
+        return self._item.queue_wait_s
+
+    @property
+    def device_s(self) -> float:
+        """Measured lane-entry→completion time — the item's device time
+        at the one-fetch-per-dispatch boundary (valid once done).  The
+        batcher's per-request cost attribution splits exactly this
+        value across the requests a fetch covered, so attributed cost
+        and the ``dispatch_*`` series can cross-check to ~1.0."""
+        return self._item.device_s
 
 
 class DispatchSpine:
@@ -338,6 +357,8 @@ class DispatchSpine:
     def _account(self, item: _Item, t_start: float, t_end: float) -> None:
         queue_wait = max(t_start - item.t_submit, 0.0)
         device_s = max(t_end - t_start, 0.0)
+        item.queue_wait_s = queue_wait
+        item.device_s = device_s
         with self._stats_lock:
             row = self._stage_stats.setdefault(
                 item.stage,
@@ -376,6 +397,21 @@ class DispatchSpine:
                 )
             except Exception:  # a finished trace must never fail a dispatch
                 pass
+            # per-class cost attribution (docqa-costscope): a submitter
+            # -side item under a traced request accrues its measured
+            # split to the request's CostRecord (retrieval, store
+            # search, solo generate).  Worker-side serve items carry no
+            # trace and are attributed explicitly by the batcher — no
+            # stage is ever counted twice.
+            if item.error is None:
+                rec = getattr(item.trace, "cost_record", None)
+                if rec is not None:
+                    try:
+                        rec.account_dispatch(
+                            item.stage, queue_wait, device_s
+                        )
+                    except Exception:
+                        pass
 
     # ---- public API ----------------------------------------------------------
 
@@ -430,6 +466,22 @@ class DispatchSpine:
                 )
                 if depth >= self.max_depth:
                     self._submitted -= 1
+                    # shed forensics (docqa-costscope): who held the
+                    # machine when the spine refused work — lazy import
+                    # (obs.costs is stdlib-only; never a cycle) and
+                    # fenced (accounting must not fail the shed path)
+                    try:
+                        from docqa_tpu.obs.costs import DEFAULT_COST_LEDGER
+
+                        rec = getattr(trace, "cost_record", None)
+                        DEFAULT_COST_LEDGER.record_shed(
+                            "spine_saturated",
+                            cls=rec.cls if rec is not None else None,
+                            stage=stage,
+                            depth=depth,
+                        )
+                    except Exception:
+                        pass
                     raise SpineSaturated(
                         f"spine queue at capacity for {stage!r}", depth=depth
                     )

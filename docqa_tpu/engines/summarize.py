@@ -115,6 +115,31 @@ class SummarizeEngine:
             return prompt[-self.fake_max_chars :]
         max_tokens = max_tokens or self.cfg.max_summary_tokens
         if self.batcher is not None:
+            # batch-class decode (docqa-costscope): summaries/syntheses
+            # are throughput work, never interactive spend.  The kwarg
+            # support is probed from the SIGNATURE once (stand-ins
+            # without it stay compatible) — never by catching TypeError
+            # around the live call, which would retry a submission whose
+            # failure came from inside a compatible batcher.
+            takes_class = getattr(self, "_batcher_takes_class", None)
+            if takes_class is None:
+                import inspect
+
+                try:
+                    params = inspect.signature(
+                        self.batcher.submit_text
+                    ).parameters
+                    takes_class = "req_class" in params or any(
+                        p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in params.values()
+                    )
+                except (TypeError, ValueError):
+                    takes_class = False
+                self._batcher_takes_class = takes_class
+            if takes_class:
+                return self.batcher.submit_text(
+                    prompt, max_tokens, req_class="batch"
+                )
             return self.batcher.submit_text(prompt, max_tokens)
         with span("summarize", DEFAULT_REGISTRY):
             return self.generator.generate_texts(

@@ -18,6 +18,10 @@ for the rest of the framework:
   plane), :class:`BurnRateEvaluator` + :func:`default_ask_slos` (SLO
   burn-rate alerting), :func:`prometheus_text` / :func:`telemetry_json`
   / :func:`lint_prometheus_text` (exposition);
+* cost attribution (docqa-costscope): :class:`RequestCostLedger` /
+  :class:`CostRecord` / :data:`DEFAULT_COST_LEDGER` / :func:`cost_open`
+  (per-class request cost vectors, KV block-second accounting, shed
+  forensics — ``GET /api/costs``);
 * retrieval quality (ISSUE 13): :class:`RetrievalObservatory` +
   :func:`get_retrieval_observatory` / :func:`set_retrieval_observatory`
   (shadow-sampling online recall estimation, the measured nprobe
@@ -40,6 +44,14 @@ from docqa_tpu.obs.context import (  # noqa: F401
     headers_of,
     next_trace_id,
     reset_ids,
+)
+from docqa_tpu.obs.costs import (  # noqa: F401
+    DEFAULT_COST_LEDGER,
+    REQUEST_CLASSES,
+    CostRecord,
+    RequestCostLedger,
+    cost_open,
+    cost_record_of,
 )
 from docqa_tpu.obs.export import (  # noqa: F401
     coverage,

@@ -74,7 +74,7 @@ def coverage(trace: Trace) -> float:
 
 def timeline_dict(trace: Trace) -> Dict[str, Any]:
     spans = trace.snapshot_spans()
-    return {
+    out = {
         "trace_id": trace.trace_id,
         "name": trace.name,
         "status": trace.status,
@@ -84,6 +84,13 @@ def timeline_dict(trace: Trace) -> Dict[str, Any]:
         "coverage": round(coverage(trace), 4),
         "spans": [_span_dict(trace, sp) for sp in spans],
     }
+    # per-request cost summary (docqa-costscope): attached by the
+    # ledger at retirement — class, outcome, device-ms split, KV
+    # block-seconds.  Absent on unaccounted traces.
+    cost = getattr(trace, "cost_summary", None)
+    if cost is not None:
+        out["cost"] = cost
+    return out
 
 
 def to_chrome_trace(traces: Iterable[Trace]) -> Dict[str, Any]:
@@ -102,6 +109,21 @@ def to_chrome_trace(traces: Iterable[Trace]) -> Dict[str, Any]:
                 "args": {"name": f"{trace.name} {trace.trace_id}"},
             }
         )
+        cost = getattr(trace, "cost_summary", None)
+        if cost is not None:
+            # the cost vector as an instant event at trace start: shows
+            # up in Perfetto's args pane without inventing counter rows
+            events.append(
+                {
+                    "ph": "i",
+                    "s": "t",
+                    "name": "cost_summary",
+                    "pid": 1,
+                    "tid": tid,
+                    "ts": round((trace.t0 - base) * 1e6, 1),
+                    "args": dict(cost),
+                }
+            )
         for sp in trace.snapshot_spans():
             end = sp.t_end if sp.t_end is not None else sp.t_start
             events.append(

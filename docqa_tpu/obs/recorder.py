@@ -134,6 +134,22 @@ class FlightRecorder:
         status or a dead-letter callback)."""
         if trace is None:
             return
+        # cost-record fallback retirement (docqa-costscope): a request
+        # whose typed path never retired its record — a 503 the batcher
+        # never saw, an exception escaping the HTTP handler — retires
+        # here when its trace completes, so no traced request can leak
+        # an open record.  Exactly-once: the ledger guards, so the
+        # normal typed retirement always wins.
+        rec = getattr(trace, "cost_record", None)
+        if rec is not None:
+            try:
+                from docqa_tpu.obs.costs import DEFAULT_COST_LEDGER
+
+                DEFAULT_COST_LEDGER.retire(
+                    rec, "ok" if status == "ok" else "error"
+                )
+            except Exception:
+                pass
         if not trace.finish(status):
             with self._lock:
                 self._open.pop(trace.trace_id, None)

@@ -44,13 +44,13 @@ where each internal dispatch rides the spine like any other submitter's.
 PHI policy: everything the observatory *stores, exports, or logs* —
 comparison windows, frontier evidence, counters, ``/api/retrieval`` —
 carries row ids, scores, latencies, and norms only, never query or
-document text.  One caveat stated honestly: the fused path's shadow
-closure holds the raw query texts in-process until the job runs (the
-fused exact program re-encodes from text; see
-``FusedTieredRetriever._observe_quality``) — they live only inside the
-pending closure and are never read by this module, but a diagnostic
-that serialized the pending queue itself would see them
-(``docs/OBSERVABILITY.md``).
+document text.  That now includes the pending queue itself: a queued
+:class:`ShadowJob` holds query EMBEDDINGS (the served dispatch returns
+them, so the shadow never re-encodes) plus a salted content hash for
+dedup/labels — no raw query text is reachable from a queued job, so a
+diagnostic that serialized the queue could not leak one (the fused
+path's former raw-text closure is gone; regression-tested in
+``tests/test_retrieval_obs.py``).
 """
 
 from __future__ import annotations

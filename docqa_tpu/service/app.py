@@ -519,6 +519,15 @@ class DocQARuntime:
             )
             obs.set_retrieval_observatory(self.retrieval_obs)
 
+        # ---- request cost attribution (docqa-costscope,
+        # docs/OBSERVABILITY.md "Cost attribution"): the process ledger
+        # gets its pressure probe — the closure shed forensics snapshots
+        # (which classes hold KV blocks / lanes / queue slots) — wired
+        # over whatever batcher surface this runtime built, plus the
+        # spine's queue depth.  /api/costs + /api/costs/sheds serve it.
+        self.costs = obs.DEFAULT_COST_LEDGER
+        self.costs.set_pressure_probe(self._cost_pressure)
+
         # ---- telemetry: time-series rollups + SLO burn-rate alerting
         # (docqa-telemetry, docs/OBSERVABILITY.md).  Built last so the
         # sampler scrapes fully-constructed components; started in
@@ -582,7 +591,25 @@ class DocQARuntime:
                 retrieval=self.retrieval_obs,
                 sample_every_s=tcfg.sample_every_s,
                 hbm_refresh_s=tcfg.hbm_refresh_s,
+                # cost_* gauges (bounded); the per-class cost counters
+                # ride the registry scrape like every other counter
+                extra_probes=(self.costs.telemetry_gauges,),
             )
+
+    def _cost_pressure(self):
+        """Shed-forensics pressure snapshot (obs/costs.py): per-class
+        holdings from the batcher/pool plus the spine's live depth.
+        Lock-free end to end — it runs on shedding threads."""
+        out = {}
+        b = self.batcher
+        probe = getattr(b, "pressure_by_class", None)
+        if probe is not None:
+            out = probe() or {}
+        try:
+            out["spine_queue_depth"] = self.spine.queue_depth
+        except Exception:
+            pass
+        return out
 
     def start(self) -> "DocQARuntime":
         self.pipeline.start()
@@ -627,9 +654,10 @@ class DocQARuntime:
                 self.batcher.warmup(buckets=buckets)
             # then one real request end to end: exercises admission,
             # sampling, retirement and the result path on top of the
-            # warmed programs
+            # warmed programs (background class: warmups must never
+            # read as interactive spend on /api/costs)
             self.batcher.submit_ids(
-                [1, 2, 3], max_new_tokens=2
+                [1, 2, 3], max_new_tokens=2, req_class="background"
             ).result(timeout=600)
             # register the warmed programs' cost_analysis() FLOPs with
             # the observatory (background probe items): /api/status and
@@ -903,6 +931,41 @@ def make_app(rt: DocQARuntime):
             obs.telemetry_json(rt.telemetry, req.query.get("name"))
         )
 
+    async def api_costs(_req):
+        """Per-class cost attribution (docqa-costscope): class
+        breakdown, top session spenders, share of measured device time
+        (vs the spine total) and of KV pool block-seconds —
+        docs/OPERATIONS.md "Answer 'who caused the shed'" reads this."""
+        spine_dev = sum(
+            row.get("device_s", 0.0)
+            for row in rt.spine.stats()["stages"].values()
+        )
+        bs = getattr(rt.batcher, "block_seconds", None)
+        pool_bs = None
+        if bs is not None:
+            try:
+                pool_bs = bs()["total"]
+            except Exception:
+                pool_bs = None
+        return web.json_response(
+            rt.costs.snapshot(
+                spine_device_s=spine_dev, pool_block_seconds=pool_bs
+            )
+        )
+
+    async def api_costs_sheds(req):
+        """Shed forensics ring: every QueueFull / BlockPoolExhausted /
+        SpineSaturated / deadline shed's pressure snapshot — which
+        classes held the blocks, lanes, and queue slots at that
+        instant."""
+        try:
+            limit = int(req.query.get("limit", "64"))
+        except ValueError:
+            return json_error(422, "limit must be an integer")
+        if limit < 0:
+            return json_error(422, "limit must be >= 0")
+        return web.json_response(rt.costs.sheds(limit))
+
     async def api_retrieval(_req):
         """Retrieval-quality observatory (docqa-recallscope): live
         recall estimate + Wilson CI per (tier, nprobe), drift digests,
@@ -1116,6 +1179,7 @@ def make_app(rt: DocQARuntime):
         # the response may return while deid/index hops are still
         # appending to the same timeline
         ctx = obs.new_trace("ingest")
+        obs.cost_open(ctx, "background")
         try:
             record = await on_host(
                 obs.call_in,
@@ -1224,6 +1288,7 @@ def make_app(rt: DocQARuntime):
         # lane so N concurrent /ask share batcher slots (≈ solo latency)
         t0 = time.perf_counter()
         ctx = obs.new_trace("ask")
+        obs.cost_open(ctx, "interactive")
         try:
             pending, err = await _ask_preamble(req, ctx)
             if err is not None:
@@ -1260,6 +1325,7 @@ def make_app(rt: DocQARuntime):
 
         t0 = time.perf_counter()
         ctx = obs.new_trace("ask_stream")
+        obs.cost_open(ctx, "interactive")
         pending, err = await _ask_preamble(req, ctx)
         if err is not None:
             obs.finish(ctx, status="error")
@@ -1356,6 +1422,7 @@ def make_app(rt: DocQARuntime):
             return json_error(422, str(e))
         t0 = time.perf_counter()
         ctx = obs.new_trace("summarize")
+        obs.cost_open(ctx, "batch")
         try:
             pending = await on_device(
                 obs.call_in, ctx, rt.summarizer.submit_prompt,
@@ -1390,6 +1457,7 @@ def make_app(rt: DocQARuntime):
             return json_error(422, str(e))
         # retrieval/packing on the device lane; decode wait on the gen lane
         ctx = obs.new_trace("synthese_patient")
+        obs.cost_open(ctx, "batch")
         try:
             finish = await on_device(
                 obs.call_in,
@@ -1422,6 +1490,7 @@ def make_app(rt: DocQARuntime):
         except Exception as e:
             return json_error(422, str(e))
         ctx = obs.new_trace("synthese_comparaison")
+        obs.cost_open(ctx, "batch")
         try:
             finish = await on_device(
                 obs.call_in,
@@ -1462,6 +1531,8 @@ def make_app(rt: DocQARuntime):
             web.get("/metrics", metrics),
             web.get("/api/metrics", api_metrics),
             web.get("/api/telemetry", api_telemetry),
+            web.get("/api/costs", api_costs),
+            web.get("/api/costs/sheds", api_costs_sheds),
             web.get("/api/retrieval", api_retrieval),
             web.get("/api/traces", api_traces),
             web.get("/api/witness", api_witness),

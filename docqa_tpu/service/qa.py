@@ -262,6 +262,7 @@ class QAService:
         question: str,
         k: Optional[int] = None,
         deadline: Optional[Deadline] = None,
+        req_class: str = "interactive",
     ) -> PendingAnswer:
         """Retrieval + prompt assembly + generation *submission*.
 
@@ -279,6 +280,12 @@ class QAService:
         control (503 + retry), not an outage."""
         if deadline is not None:
             deadline.check("qa_admission")
+        # per-class cost attribution (docqa-costscope): stamp a record
+        # on the request's trace BEFORE retrieval, so the retrieve
+        # dispatch's device time lands on it via the spine's accounting
+        # hook.  The HTTP layer usually attached one already (with its
+        # endpoint's class); cost_open reuses it.
+        cost = obs.cost_open(obs.current(), req_class)
         with span("qa_retrieve", DEFAULT_REGISTRY):
             hits = self._retrieve(question, k=k or self.k, deadline=deadline)
         chunks = [
@@ -317,6 +324,10 @@ class QAService:
                 kw = {} if deadline is None else {"deadline": deadline}
                 if getattr(self.batcher, "prefix_cache_enabled", False):
                     kw["prefix_key"] = prefix_key_for(chunks)
+                    if cost is not None:
+                        # session = prefix key: the ledger's top-spender
+                        # table groups a patient session's questions
+                        cost.set_session(kw["prefix_key"])
                 return PendingAnswer(
                     sources=sources,
                     handle=self.batcher.submit_text(prompt, **kw),
@@ -334,9 +345,13 @@ class QAService:
         except QueueFull:
             # overload ≠ outage: the 503 + client retry is correct.  The
             # shed never reached the decoder — hand back any half-open
-            # probe slot allow() reserved, or the breaker wedges
+            # probe slot allow() reserved, or the breaker wedges.  The
+            # cost record retires typed here (idempotent — the batcher/
+            # pool shed path usually retired it already): a 503'd
+            # request must not leak an open record
             if breaker is not None:
                 breaker.release_probe()
+            obs.DEFAULT_COST_LEDGER.retire(cost, "shed_queue")
             raise
         except DeadlineExceeded:
             if breaker is not None:

@@ -167,6 +167,10 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
     # every batcher generation the pool ever runs (rebuilds swap fresh
     # ones in): the exact-accounting sweep below must balance them ALL
     seen_batchers = []
+    # every _Request that got a handle: the cost-ledger sweep asserts
+    # each one retired EXACTLY ONE ledger row (docqa-costscope) —
+    # crash/wedge/drain failover must never lose or double-count one
+    tracked_reqs = []
 
     def _track_batchers():
         for r in pool._replicas:
@@ -199,6 +203,8 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
                 with lock:
                     outcomes.append((tag, i, "typed_at_submit", repr(e)))
                 continue
+            with lock:
+                tracked_reqs.append(h._req)
 
             def wait_one(idx=i, handle=h):
                 t0 = time.monotonic()
@@ -294,6 +300,51 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
         f"hit(s), {int(prefix_stats['tokens_avoided'])} prefill tokens "
         f"avoided; {len(seen_batchers)} batcher generation(s) balanced "
         "to zero live blocks"
+    )
+
+    # ---- cost-attribution exactness (docqa-costscope) ----
+    # 1. zero lost cost records: every request that got a handle must
+    #    have retired EXACTLY ONE ledger row — completed, shed, or
+    #    failed typed, across requeue/rescue/kill.
+    unretired = [
+        i for i, r in enumerate(tracked_reqs)
+        if r.cost is not None and not r.cost.retired
+    ]
+    if unretired:
+        print(
+            f"LOST COST RECORDS: {len(unretired)} request(s) finished "
+            f"without a ledger row (indices {unretired[:8]}...)",
+            file=sys.stderr,
+        )
+        return 1
+    # 2. exact block-second totals: per batcher generation, every
+    #    block-second the pool accrued must be billed to SOME holder
+    #    (request tables + prefix-cache pins) — residual zero after
+    #    stop, including under refcounted prefix sharing and kills.
+    bs_bad = {}
+    billed_total = 0.0
+    for i, b in enumerate(seen_batchers):
+        bs = b._alloc.block_seconds()
+        billed_total += bs["billed"]
+        if abs(bs["residual"]) > max(1e-6, 1e-9 * bs["total"]):
+            bs_bad[i] = bs
+    if bs_bad:
+        print(
+            f"BLOCK-SECOND ACCOUNTING RESIDUAL: {bs_bad} "
+            "(batcher index -> ledger; held time never billed)",
+            file=sys.stderr,
+        )
+        return 1
+    shed_billed = [
+        r.cost.snapshot_fields().get("kv_block_seconds", 0.0)
+        for r in tracked_reqs
+        if r.cost is not None and (r.cost.outcome or "").startswith("shed")
+    ]
+    print(
+        f"cost ledger exact: {len(tracked_reqs)} tracked request(s) all "
+        f"retired exactly once; {billed_total:.3f} block-seconds billed, "
+        f"zero residual across {len(seen_batchers)} generation(s); "
+        f"{len(shed_billed)} shed request(s) billed what they held"
     )
 
     hung = [o for o in outcomes if o[2] == "HUNG"]

@@ -56,7 +56,9 @@ TODO_MARK = "TODO"
 
 
 def measure(
-    telemetry_out: str | None = None, retrieval_out: str | None = None
+    telemetry_out: str | None = None,
+    retrieval_out: str | None = None,
+    costs_out: str | None = None,
 ) -> dict:
     """Deterministic CPU serving smoke; returns a bench-details-shaped
     dict (``degraded`` stamp + flat ``metrics``)."""
@@ -260,6 +262,25 @@ def measure(
         "wall_s": round(time.perf_counter() - t_all, 1),
         "metrics": metrics,
     }
+    if costs_out:
+        # cost-attribution trend artifact (docqa-costscope): the smoke's
+        # per-class ledger snapshot, cross-checked against the spine's
+        # measured device time — CI uploads it next to the telemetry
+        # snapshot so per-class spend trends are inspectable per build
+        from docqa_tpu.engines.spine import get_spine
+        from docqa_tpu.obs.costs import DEFAULT_COST_LEDGER
+
+        spine_dev = sum(
+            row.get("device_s", 0.0)
+            for row in get_spine().stats()["stages"].values()
+        )
+        with open(costs_out, "w", encoding="utf-8") as f:
+            json.dump(
+                DEFAULT_COST_LEDGER.snapshot(spine_device_s=spine_dev),
+                f,
+                indent=1,
+            )
+        print(f"cost-attribution snapshot -> {costs_out}")
     if telemetry_out:
         with open(telemetry_out, "w", encoding="utf-8") as f:
             json.dump(store.snapshot(), f, indent=1)
@@ -494,6 +515,10 @@ def main() -> int:
     ap.add_argument("--retrieval-out",
                     help="write the measure-mode retrieval-quality "
                          "snapshot (recall estimate + frontier) here")
+    ap.add_argument("--costs-out",
+                    help="write the measure-mode cost-attribution "
+                         "snapshot (per-class ledger; docqa-costscope) "
+                         "here")
     args = ap.parse_args()
 
     if args.bench:
@@ -505,6 +530,7 @@ def main() -> int:
         result = measure(
             telemetry_out=args.telemetry_out,
             retrieval_out=args.retrieval_out,
+            costs_out=args.costs_out,
         )
         print(f"measured: {json.dumps(result['metrics'], indent=1)}")
 

@@ -1,0 +1,450 @@
+"""docqa-costscope: per-class request cost attribution.
+
+Covers the three layers independently and end to end:
+
+* the allocator's block-second ledger on a fake clock — fractional
+  billing under refcounted prefix sharing, exactness (zero residual)
+  after release, including share/release interleavings;
+* the :class:`RequestCostLedger` — exactly-once retirement, late-add
+  folding, bounded session table, shed forensics with a pressure probe;
+* the batcher end to end — request classes threaded through submit,
+  per-class device-time attribution that reconciles against the spine's
+  measured ``serve_prefill_fetch`` / ``serve_decode_chunk`` stages, KV
+  block-seconds billed to the right class, zero residual after stop,
+  and the cost summary landing on the request's trace timeline.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from docqa_tpu import obs
+from docqa_tpu.config import DecoderConfig, GenerateConfig
+from docqa_tpu.engines.paged import BlockAllocator
+from docqa_tpu.obs.costs import (
+    DEFAULT_COST_LEDGER,
+    RequestCostLedger,
+)
+
+
+# ---------------------------------------------------------------------------
+# allocator block-second ledger (fake clock)
+# ---------------------------------------------------------------------------
+
+
+class TestBlockSeconds:
+    def test_private_hold_bills_exactly(self):
+        t = [0.0]
+        alloc = BlockAllocator(8, 4, now_fn=lambda: t[0])
+        table = alloc.new_table()
+        table.ensure(8)  # 2 blocks
+        t[0] = 3.0
+        table.release()
+        assert table.billed_block_seconds == pytest.approx(6.0)
+        bs = alloc.block_seconds()
+        assert bs["total"] == pytest.approx(6.0)
+        assert bs["billed"] == pytest.approx(6.0)
+        assert bs["residual"] == pytest.approx(0.0)
+
+    def test_shared_blocks_bill_fractionally_and_exactly(self):
+        """A block at refcount r bills each holder 1/r per second —
+        the sum over holders equals the block's plain in-use time."""
+        t = [0.0]
+        alloc = BlockAllocator(8, 4, now_fn=lambda: t[0])
+        t1 = alloc.new_table()
+        t1.ensure(8)  # 2 blocks, refcount 1
+        t[0] = 1.0
+        t2 = alloc.new_table()
+        alloc.share(t2, t1.blocks)  # refcount 2 on both
+        t[0] = 3.0
+        t2.release()  # t2 held [1, 3) at 1/2: 2 blocks * 2s * 0.5 = 2
+        assert t2.billed_block_seconds == pytest.approx(2.0)
+        t[0] = 5.0
+        t1.release()  # 2*1 + 2*2*0.5 + 2*2 = 8
+        assert t1.billed_block_seconds == pytest.approx(8.0)
+        bs = alloc.block_seconds()
+        # pool: 2 blocks in use for 5 s — bills partition it exactly
+        assert bs["total"] == pytest.approx(10.0)
+        assert bs["billed"] == pytest.approx(10.0)
+        assert bs["residual"] == pytest.approx(0.0)
+
+    def test_residual_tracks_live_holdings(self):
+        t = [0.0]
+        alloc = BlockAllocator(4, 4, now_fn=lambda: t[0])
+        table = alloc.new_table()
+        table.ensure(4)  # 1 block
+        t[0] = 2.0
+        bs = alloc.block_seconds()
+        assert bs["total"] == pytest.approx(2.0)
+        assert bs["billed"] == pytest.approx(0.0)
+        assert bs["residual"] == pytest.approx(2.0)  # still held
+        table.release()
+        assert alloc.block_seconds()["residual"] == pytest.approx(0.0)
+
+    def test_reused_block_does_not_inherit_history(self):
+        """Free-then-realloc must not bill the new holder for the old
+        holder's interval (the unit accrual is delta-based)."""
+        t = [0.0]
+        alloc = BlockAllocator(1, 4, now_fn=lambda: t[0])
+        t1 = alloc.new_table()
+        t1.ensure(4)
+        t[0] = 5.0
+        t1.release()
+        t[0] = 7.0  # the block sits FREE for 2 s: nobody bills it
+        t2 = alloc.new_table()
+        t2.ensure(4)
+        t[0] = 8.0
+        t2.release()
+        assert t1.billed_block_seconds == pytest.approx(5.0)
+        assert t2.billed_block_seconds == pytest.approx(1.0)
+        bs = alloc.block_seconds()
+        assert bs["total"] == pytest.approx(6.0)  # free time not in use
+        assert bs["residual"] == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# ledger semantics
+# ---------------------------------------------------------------------------
+
+
+class _FakeCounter:
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class _FakeRegistry:
+    def __init__(self):
+        self.counters = {}
+
+    def counter(self, name):
+        return self.counters.setdefault(name, _FakeCounter())
+
+
+class TestLedger:
+    def test_retire_exactly_once_and_late_add(self):
+        ledger = RequestCostLedger(registry=_FakeRegistry())
+        rec = ledger.open("interactive", session="s1")
+        rec.add("decode_device_ms", 10.0)
+        assert ledger.retire(rec, "ok") is True
+        assert ledger.retire(rec, "error") is False  # first wins
+        totals = ledger.class_totals()["interactive"]
+        assert totals["requests"] == 1
+        assert totals["decode_device_ms"] == pytest.approx(10.0)
+        # late add (post-retirement KV bill) folds WITHOUT a second row
+        rec.add("kv_block_seconds", 2.5)
+        totals = ledger.class_totals()["interactive"]
+        assert totals["requests"] == 1
+        assert totals["kv_block_seconds"] == pytest.approx(2.5)
+
+    def test_unknown_class_folds_into_other(self):
+        ledger = RequestCostLedger(registry=_FakeRegistry())
+        rec = ledger.open("bogus-class")
+        ledger.retire(rec, "ok")
+        assert "other" in ledger.class_totals()
+        assert "bogus-class" not in ledger.class_totals()
+
+    def test_disabled_ledger_opens_none(self):
+        ledger = RequestCostLedger(registry=_FakeRegistry())
+        ledger.set_enabled(False)
+        assert ledger.open("interactive") is None
+        assert ledger.record_shed("queue_full") is None
+        ledger.set_enabled(True)
+        assert ledger.open("interactive") is not None
+
+    def test_session_table_is_bounded(self):
+        ledger = RequestCostLedger(
+            registry=_FakeRegistry(), max_sessions=4
+        )
+        for i in range(10):
+            rec = ledger.open("interactive", session=f"s{i}")
+            rec.add("decode_device_ms", float(i))
+            ledger.retire(rec, "ok")
+        tops = ledger.top_sessions(10)
+        assert len(tops) <= 4
+        # biggest spenders survive the eviction
+        assert tops[0]["session"] == "s9"
+
+    def test_shed_forensics_names_majority_holder(self):
+        ledger = RequestCostLedger(registry=_FakeRegistry())
+        ledger.set_pressure_probe(
+            lambda: {
+                "by_class": {
+                    "batch": {"kv_blocks": 40, "lanes": 2, "queued": 0},
+                    "interactive": {
+                        "kv_blocks": 4, "lanes": 1, "queued": 3
+                    },
+                },
+                "free_blocks": 0,
+            }
+        )
+        snap = ledger.record_shed(
+            "block_pool_exhausted", cls="interactive", stage="test"
+        )
+        assert snap["majority_block_class"] == "batch"
+        assert snap["class"] == "interactive"
+        ring = ledger.sheds()
+        assert ring[-1]["kind"] == "block_pool_exhausted"
+        # counters: the shed request's class sheds, counted at retire
+        rec = ledger.open("interactive")
+        ledger.retire(rec, "shed_block_pool")
+        reg = ledger.registry()
+        assert reg.counters["cost_sheds_interactive"].value == 1
+
+    def test_snapshot_shares(self):
+        ledger = RequestCostLedger(registry=_FakeRegistry())
+        for cls, dev in (("interactive", 30.0), ("batch", 70.0)):
+            rec = ledger.open(cls)
+            rec.add("decode_device_ms", dev)
+            rec.add("kv_block_seconds", dev / 10)
+            ledger.retire(rec, "ok")
+        snap = ledger.snapshot(spine_device_s=0.1)  # 100 ms total
+        cl = snap["classes"]
+        assert cl["batch"]["share_of_attributed_device"] == pytest.approx(
+            0.7
+        )
+        assert snap["attributed_device_coverage"] == pytest.approx(1.0)
+        assert cl["batch"]["share_of_kv_block_seconds"] == pytest.approx(
+            0.7
+        )
+        assert snap["top_sessions"] == []
+
+
+# ---------------------------------------------------------------------------
+# batcher end to end: classes, attribution, exactness
+# ---------------------------------------------------------------------------
+
+
+TINY = DecoderConfig(
+    vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=256,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from docqa_tpu.engines.generate import GenerateEngine
+
+    return GenerateEngine(
+        TINY,
+        GenerateConfig(temperature=0.0, prefill_buckets=(16,), eos_id=2),
+        seed=7,
+    )
+
+
+class TestBatcherCostAttribution:
+    def test_mixed_classes_attribute_and_balance(self, engine):
+        from docqa_tpu.engines.serve import ContinuousBatcher
+        from docqa_tpu.engines.spine import get_spine
+
+        before = DEFAULT_COST_LEDGER.class_totals()
+        spine0 = get_spine().stats()["stages"]
+        b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=128)
+        try:
+            handles = [
+                b.submit_ids(
+                    [5, 9, 11, 3], max_new_tokens=4,
+                    req_class="interactive",
+                ),
+                b.submit_ids(
+                    [7, 9, 11, 5, 3], max_new_tokens=6, req_class="batch",
+                ),
+                b.submit_ids(
+                    [3, 5], max_new_tokens=2, req_class="background",
+                ),
+            ]
+            outs = [h.result(timeout=120) for h in handles]
+        finally:
+            b.stop()
+        assert all(len(o) >= 1 for o in outs)
+        # exactness: every block-second the pool accrued was billed
+        bs = b.block_seconds()
+        assert bs["residual"] == pytest.approx(0.0, abs=1e-6)
+        assert bs["billed"] > 0
+        after = DEFAULT_COST_LEDGER.class_totals()
+
+        def delta(cls, key):
+            return after.get(cls, {}).get(key, 0.0) - before.get(
+                cls, {}
+            ).get(key, 0.0)
+
+        for cls in ("interactive", "batch", "background"):
+            assert delta(cls, "requests") == 1, cls
+            assert delta(cls, "kv_block_seconds") > 0, cls
+            assert delta(cls, "decode_tokens") >= 1, cls
+        # per-class KV bills sum to the pool's billed total
+        kv_sum = sum(
+            delta(c, "kv_block_seconds")
+            for c in ("interactive", "batch", "background")
+        )
+        assert kv_sum == pytest.approx(bs["billed"], rel=1e-6)
+        # cross-check: attributed device time partitions the spine's
+        # measured fetch stages exactly (same values, split per request)
+        spine1 = get_spine().stats()["stages"]
+
+        def stage_delta(name):
+            a = spine1.get(name, {}).get("device_s", 0.0)
+            z = spine0.get(name, {}).get("device_s", 0.0)
+            return (a - z) * 1e3
+
+        spine_ms = stage_delta("serve_prefill_fetch") + stage_delta(
+            "serve_decode_chunk"
+        )
+        attributed_ms = sum(
+            delta(c, k)
+            for c in ("interactive", "batch", "background")
+            for k in (
+                "prefill_device_ms_cold", "prefill_device_ms_warm",
+                "decode_device_ms",
+            )
+        )
+        # abs tolerance: spine stats round device_s to 1e-6 s per stage
+        assert attributed_ms == pytest.approx(spine_ms, abs=5e-3)
+
+    def test_queue_shed_retires_typed_with_forensics(self, engine):
+        from docqa_tpu.engines.serve import ContinuousBatcher, QueueFull
+
+        b = ContinuousBatcher(
+            engine, n_slots=2, chunk=4, cache_len=128, max_queue=0
+        )
+        old_probe = DEFAULT_COST_LEDGER._pressure_probe
+        try:
+            DEFAULT_COST_LEDGER.set_pressure_probe(b.pressure_by_class)
+            sheds0 = len(DEFAULT_COST_LEDGER.sheds())
+            # max_queue=0: every submission is refused at the queue —
+            # the minimal deterministic queue-full shed
+            captured = {}
+            orig_submit = b.submit_request
+
+            def spy(req):
+                captured["req"] = req
+                return orig_submit(req)
+
+            b.submit_request = spy
+            with pytest.raises(QueueFull):
+                b.submit_ids(
+                    [5, 9], max_new_tokens=2, req_class="interactive"
+                )
+            req = captured["req"]
+            assert req.cost is not None
+            assert req.cost.retired
+            assert req.cost.outcome in ("shed_queue", "shed_block_pool")
+            assert len(DEFAULT_COST_LEDGER.sheds()) > sheds0
+            snap = DEFAULT_COST_LEDGER.sheds()[-1]
+            assert snap["kind"] in ("queue_full", "block_pool_exhausted")
+            assert "pressure" in snap
+        finally:
+            DEFAULT_COST_LEDGER.set_pressure_probe(old_probe)
+            b.stop()
+
+    def test_cost_summary_lands_on_trace(self, engine):
+        from docqa_tpu.engines.serve import ContinuousBatcher
+
+        b = ContinuousBatcher(engine, n_slots=2, chunk=4, cache_len=128)
+        try:
+            ctx = obs.new_trace("ask")
+            rec = obs.cost_open(ctx, "interactive")
+            h = obs.call_in(ctx, b.submit_ids, [5, 9, 11], 4)
+            h.result(timeout=120)
+        finally:
+            b.stop()
+        obs.finish(ctx)
+        assert rec.retired
+        timeline = obs.timeline_dict(ctx.trace)
+        assert timeline["cost"]["class"] == "interactive"
+        assert timeline["cost"]["outcome"] == "ok"
+        assert timeline["cost"]["kv_block_seconds"] > 0
+        # the Chrome export carries it too
+        chrome = obs.to_chrome_trace([ctx.trace])
+        names = [e.get("name") for e in chrome["traceEvents"]]
+        assert "cost_summary" in names
+
+    def test_trace_finish_fallback_retires(self):
+        """A traced request whose typed path never retired its record
+        (e.g. an exception escaping the handler) retires at trace
+        completion — no leaked-open records."""
+        ctx = obs.new_trace("ask")
+        rec = obs.cost_open(ctx, "interactive")
+        rec.add("retrieve_device_ms", 5.0)
+        obs.finish(ctx, status="error")
+        assert rec.retired
+        assert rec.outcome == "error"
+
+
+class TestPoolCostSurface:
+    def test_pool_pressure_and_block_seconds_aggregate(self, engine):
+        from docqa_tpu.engines.pool import EnginePool
+
+        pool = EnginePool(
+            engine, replicas=1, n_slots=2, chunk=4, cache_len=128,
+            canary_interval_s=600.0,
+        )
+        try:
+            h = pool.submit_ids(
+                [5, 9, 11], max_new_tokens=4, req_class="batch"
+            )
+            h.result(timeout=120)
+            bs = pool.block_seconds()
+            assert bs["billed"] > 0
+            snap = pool.pressure_by_class()
+            assert "by_class" in snap and "free_blocks" in snap
+        finally:
+            pool.stop()
+        assert pool.block_seconds()["residual"] == pytest.approx(
+            0.0, abs=1e-6
+        )
+
+
+class TestQAClassThreading:
+    def test_ask_submit_stamps_interactive_and_session(self):
+        """The qa layer opens an interactive record on the trace before
+        retrieval and stamps the prefix key as the session."""
+        from docqa_tpu.service.qa import QAService
+
+        class _Hit:
+            def __init__(self, i):
+                self.metadata = {
+                    "text_content": f"chunk {i}", "source": f"d{i}"
+                }
+
+        class _Store:
+            count = 3
+
+            def search(self, emb, k=3, filters=None):
+                return [[_Hit(i) for i in range(2)]]
+
+        class _Enc:
+            def encode_texts(self, texts):
+                return np.zeros((len(texts), 4), np.float32)
+
+        class _Handle:
+            def text(self, tok, timeout=None):
+                return "answer"
+
+        class _Batcher:
+            prefix_cache_enabled = True
+
+            class engine:
+                tokenizer = None
+
+            def submit_text(self, prompt, **kw):
+                _Batcher.last_kw = kw
+                return _Handle()
+
+        qa = QAService(
+            _Enc(), _Store(), None, None, use_fake_llm=False,
+            batcher=_Batcher(),
+        )
+        ctx = obs.new_trace("ask")
+        pending = obs.call_in(ctx, qa.ask_submit, "question?")
+        rec = obs.cost_record_of(ctx.trace)
+        assert rec is not None
+        assert rec.cls == "interactive"
+        assert rec.session == _Batcher.last_kw["prefix_key"]
+        assert pending.resolve()["answer"] == "answer"
+        obs.finish(ctx)
+        assert rec.retired

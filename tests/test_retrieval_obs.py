@@ -443,6 +443,91 @@ class TestFusedTieredShadow:
             > 0
         )
 
+    def test_queued_shadow_job_holds_no_raw_text(self, fused_setup):
+        """PHI regression (docqa-costscope satellite): the fused path's
+        pending shadow closure used to hold the sampled request's raw
+        query texts until the job ran.  It now holds the served
+        dispatch's query EMBEDDINGS plus a salted content hash — no
+        string reachable from a queued ShadowJob may contain the query
+        text, so a diagnostic that serialized the pending queue could
+        not leak one."""
+        from docqa_tpu.obs.retrieval_observatory import (
+            RetrievalObservatory,
+            set_retrieval_observatory,
+        )
+
+        _enc, _store, _tiered, retr = fused_setup
+
+        class _Capture(RetrievalObservatory):
+            def __init__(self):
+                super().__init__(sample_every=1)
+                self.jobs = []
+
+            @property
+            def running(self):  # sample() gates on a live worker
+                return True
+
+            def submit(self, job):
+                self.jobs.append(job)
+                return True
+
+        cap = _Capture()
+        prev = set_retrieval_observatory(cap)
+        query = "drug-3 for condition-3 PHI-SENTINEL-TEXT"
+        try:
+            retr.search_texts([query], k=5)
+        finally:
+            set_retrieval_observatory(prev)
+        assert cap.jobs, "shadow job was not sampled"
+        job = cap.jobs[0]
+
+        # walk everything reachable from the job — dataclass fields,
+        # closure cells, containers — and collect every string
+        strings, seen = [], set()
+
+        def walk(o, depth=0):
+            if depth > 6 or id(o) in seen:
+                return
+            seen.add(id(o))
+            if isinstance(o, str):
+                strings.append(o)
+                return
+            if isinstance(o, (bytes, np.ndarray, int, float, bool)):
+                return
+            if isinstance(o, dict):
+                for k, v in o.items():
+                    walk(k, depth + 1)
+                    walk(v, depth + 1)
+                return
+            if isinstance(o, (list, tuple, set, frozenset)):
+                for v in o:
+                    walk(v, depth + 1)
+                return
+            if callable(o):
+                for cell in getattr(o, "__closure__", None) or ():
+                    walk(cell.cell_contents, depth + 1)
+                walk(getattr(o, "__defaults__", None), depth + 1)
+                return
+            slots = getattr(type(o), "__slots__", None)
+            if slots:
+                for name in slots:
+                    walk(getattr(o, name, None), depth + 1)
+            d = getattr(o, "__dict__", None)
+            if d:
+                walk(d, depth + 1)
+
+        walk(job)
+        leaked = [
+            s for s in strings
+            if "PHI-SENTINEL" in s or query in s
+        ]
+        assert not leaked, f"raw query text reachable from job: {leaked}"
+        # the dedup/diagnostic label rides along instead
+        assert job.attrs.get("query_hashes"), "salted hash missing"
+        assert all(
+            "PHI-SENTINEL" not in h for h in job.attrs["query_hashes"]
+        )
+
     def test_offmesh_fallback_is_loud(self, fused_setup, caplog):
         """ROADMAP item 2 named this fallback silent: it must count,
         warn once per process, and flag the request's trace."""
