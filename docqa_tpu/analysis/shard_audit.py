@@ -68,6 +68,7 @@ AUDIT_PROGRAMS = (
     "ring_attention",
     "ulysses_attention",
     "retrieve_fused",
+    "retrieve_ivf_sharded",
 )
 
 
@@ -455,6 +456,78 @@ def _audit_retrieve(mesh_name: str):
     return counts, {"row_shards": mesh.n_model if sharded else 1}
 
 
+def _audit_retrieve_ivf(mesh_name: str):
+    """Lower the mesh-native fused TIERED retrieve program
+    (``engines/retrieve.py:build_tiered_search_program`` — encoder
+    forward -> coarse probe over mesh-sharded int8 cell tiles -> exact
+    tail scan): the cell tiles/scales/ids shard rows over ``model``,
+    the coarse centroid score replicates, each shard scores its local
+    tiles, and the only collective content is the 2-gather top-k merge
+    (vals + ids) — the same budget the exact store's ``sharded_topk``
+    pays.  1x1 lowers the single-device kernel and must be
+    collective-free (docqa-meshindex; ROADMAP item 2)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from docqa_tpu.engines.retrieve import build_tiered_search_program
+    from docqa_tpu.index.ivf import ivf_cell_specs
+    from docqa_tpu.models.encoder import init_encoder_params
+
+    cfg = _audit_encoder_cfg()
+    mesh = _mesh(mesh_name)
+    params = jax.eval_shape(
+        functools.partial(init_encoder_params, cfg=cfg),
+        jax.random.PRNGKey(0),
+    )
+    batch = 4
+    n_cells, cap, n_spill, tail_rows = 16, 8, 4, 32  # cells divisible by 8
+    ids = jax.ShapeDtypeStruct((batch, cfg.max_seq_len), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    cells = jax.ShapeDtypeStruct((n_cells, cap, cfg.embed_dim), jnp.int8)
+    scale = jax.ShapeDtypeStruct((n_cells, cap), jnp.float32)
+    cell_ids = jax.ShapeDtypeStruct((n_cells, cap), jnp.int32)
+    centroids = jax.ShapeDtypeStruct((n_cells, cfg.embed_dim), jnp.float32)
+    spill = jax.ShapeDtypeStruct((n_spill, cfg.embed_dim), jnp.float32)
+    spill_ids = jax.ShapeDtypeStruct((n_spill,), jnp.int32)
+    tail = jax.ShapeDtypeStruct((tail_rows, cfg.embed_dim), jnp.float32)
+    n_live = jax.ShapeDtypeStruct((), jnp.int32)
+
+    sharded = mesh.n_model > 1
+    program = build_tiered_search_program(
+        cfg, mesh if sharded else None,
+        nprobe=4, fetch=8, k_tail=4, n_real_cells=n_cells,
+    )
+    replicated = NamedSharding(mesh.mesh, P())
+    cell_specs = ivf_cell_specs(mesh.model_axis)
+    in_shardings = (
+        jax.tree_util.tree_map(lambda _: replicated, params),
+        replicated,  # ids
+        replicated,  # lengths
+        NamedSharding(mesh.mesh, cell_specs[0] if sharded else P()),
+        NamedSharding(mesh.mesh, cell_specs[1] if sharded else P()),
+        NamedSharding(mesh.mesh, cell_specs[2] if sharded else P()),
+        replicated,  # centroids
+        replicated,  # spill
+        replicated,  # spill_ids
+        replicated,  # tail
+        replicated,  # n_live
+    )
+    compiled = (
+        jax.jit(program, in_shardings=in_shardings)
+        .lower(
+            params, ids, lengths, cells, scale, cell_ids, centroids,
+            spill, spill_ids, tail, n_live,
+        )
+        .compile()
+    )
+    counts = count_hlo_collectives(compiled.as_text())
+    return counts, {
+        "row_shards": mesh.n_model if sharded else 1,
+        "storage": "int8",
+    }
+
+
 _AUDITS: Dict[str, Callable[[str], Tuple[Dict[str, int], Dict[str, Any]]]] = {
     "decoder_decode": functools.partial(_audit_decoder, prefill=False),
     "decoder_prefill": functools.partial(_audit_decoder, prefill=True),
@@ -463,6 +536,7 @@ _AUDITS: Dict[str, Callable[[str], Tuple[Dict[str, int], Dict[str, Any]]]] = {
     "ring_attention": _audit_ring,
     "ulysses_attention": _audit_ulysses,
     "retrieve_fused": _audit_retrieve,
+    "retrieve_ivf_sharded": _audit_retrieve_ivf,
 }
 
 
@@ -608,20 +682,27 @@ def semantic_violations(report: Dict[str, Any]) -> List[str]:
                         f"{counts[op]}"
                     )
 
-    prog = progs.get("retrieve_fused")
-    if prog:
+    # both retrieve programs owe the SAME collective story: the exact
+    # path's sharded_topk merge and the tiered path's sharded-cell merge
+    # are each exactly one (vals, ids) all-gather pair, nothing else —
+    # the corpus scan itself never leaves the shard, and 1x1 lowers the
+    # single-device kernel collective-free
+    for rname in ("retrieve_fused", "retrieve_ivf_sharded"):
+        prog = progs.get(rname)
+        if not prog:
+            continue
         for mesh_name, counts in prog["per_mesh"].items():
             want_ag = 2 if _model_dim(mesh_name) > 1 else 0
             if counts.get("all-gather") != want_ag:
                 out.append(
-                    f"retrieve_fused/{mesh_name}: {counts.get('all-gather')} "
+                    f"{rname}/{mesh_name}: {counts.get('all-gather')} "
                     f"all-gather(s) — the path owes exactly the top-k "
                     f"merge pair (vals + ids; expected {want_ag})"
                 )
             for op in ("all-reduce", "collective-permute", "all-to-all"):
                 if counts.get(op, 0):
                     out.append(
-                        f"retrieve_fused/{mesh_name}: unexpected {op} x"
+                        f"{rname}/{mesh_name}: unexpected {op} x"
                         f"{counts[op]} on the retrieve path"
                     )
     return out

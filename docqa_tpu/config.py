@@ -286,9 +286,25 @@ class StoreConfig:
     # "tiered" (IVF over the compacted bulk + exact over the append tail,
     # index/tiered.py — the beyond-1M path).
     serving_index: str = "exact"
-    ivf_nprobe: int = 48  # with n_assign=2 cells: recall@10 ≈ 0.96 measured
+    # Serving nprobe: frontier-tuned against the measured recall target
+    # (>= 0.95, not 1.0) — the decision trail (per-scale frontier
+    # snapshot + rationale) lives in bench_details.json["shard_scale"]
+    # ["nprobe_decision"]: recall CI lower bound >= 0.961 at nprobe=8
+    # from 1M to 10M chunks on the int8 sharded tier, and PR 13's
+    # online frontier on the d=384 bench corpus recommended the same 8.
+    # The old blind 48 probed ~6x the cells the target needs.  Re-tune
+    # live via /api/retrieval's measured frontier +
+    # TieredIndex.set_nprobe.
+    ivf_nprobe: int = 8
     ivf_min_rows: int = 50_000  # below this the IVF tier stays off
     ivf_rebuild_tail: int = 100_000  # rebuild when the tail outgrows this
+    # Bulk-tier cell storage: "int8" (per-row-scaled tiles — ~4x fewer
+    # index bytes per chunk than the f32 build buffer, mesh-shardable,
+    # the 10M-chunk HBM-resident layout) or "float" (store dtype cells,
+    # exact scores, single-device only).  Quantization recall cost is
+    # MEASURED, not assumed: the recallscope shadow scans the
+    # full-precision store (obs/retrieval_observatory.py).
+    ivf_storage: str = "int8"
     # auto-compaction: once this fraction of live+dead rows is tombstoned,
     # deletions trigger a compaction (tombstones cost a mask upload per
     # search and dilute IVF cells); 0 disables

@@ -35,7 +35,6 @@ import numpy as np
 from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
-from docqa_tpu import obs
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.engines.spine import spine_run
@@ -55,11 +54,6 @@ from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY, get_logger, span
 log = get_logger("docqa.retrieve")
 
 QUERY_BATCH_BUCKETS = (1, 4, 16)
-
-# the first off-mesh fallback warns; later ones only count + trace-flag
-# (one warning per process names the condition, a log line per request
-# would be noise exactly when a mesh serves sustained traffic)
-_OFFMESH_WARNED = False
 
 # per-process random salt for shadow-job query hashes: same query ->
 # same label within a process (dedup), unlinkable to content across
@@ -248,6 +242,85 @@ class FusedRetriever:
         return results
 
 
+def build_tiered_search_program(
+    enc_cfg,
+    mesh,
+    *,
+    nprobe: int,
+    fetch: int,
+    k_tail: int,
+    n_real_cells: Optional[int] = None,
+):
+    """The single-dispatch tiered retrieve program: encoder forward ->
+    L2 normalize -> coarse probe over the (int8, mesh-sharded) IVF cell
+    tiles -> exact tail scan -> per-tier top-k.  Mesh-native: with
+    ``mesh.n_model > 1`` the probe enters the ``shard_map`` merge kernel
+    (``index/ivf.py:_probe_kernel_sharded``) — the coarse centroid score
+    replicates, each shard scores its local tiles, and the merge is
+    exactly the 2-gather top-k of the exact store's path.  Returns the
+    un-jitted callable with arity (enc_params, ids, lengths, cells,
+    cell_scale, cell_ids, centroids, spill, spill_ids, tail, n_live) so
+    both :class:`FusedTieredRetriever` (which jits it per cache key) and
+    the sharding audit (``analysis/shard_audit.py`` program
+    ``retrieve_ivf_sharded``, which lowers it on virtual meshes to count
+    its collectives against ``shard_budget.json``) build the exact same
+    program."""
+    from docqa_tpu.index.ivf import (
+        _probe_kernel,
+        _probe_kernel_sharded,
+        ivf_cell_specs,
+    )
+    from docqa_tpu.index.tiered import _tail_kernel
+
+    sharded = mesh is not None and mesh.n_model > 1
+
+    def program(
+        enc_params, ids, lengths, cells, cell_scale, cell_ids,
+        centroids, spill, spill_ids, tail, n_live,
+    ):
+        emb = encode_batch(enc_params, enc_cfg, ids, lengths)
+        emb = emb / jnp.maximum(
+            jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+        )
+        q = emb.astype(centroids.dtype)
+        if sharded:
+            kernel = functools.partial(
+                _probe_kernel_sharded,
+                nprobe=nprobe, k=fetch,
+                n_real_cells=n_real_cells or cells.shape[0],
+                axis=mesh.model_axis,
+            )
+
+            def tiered_probe_body(bcells, bscale, bids, bcent, bsp, bsp_ids, bq):
+                return kernel(bcells, bscale, bids, bcent, bsp, bsp_ids, bq)
+
+            bulk_vals, bulk_ids = shard_map(
+                tiered_probe_body,
+                mesh=mesh.mesh,
+                in_specs=ivf_cell_specs(mesh.model_axis),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(cells, cell_scale, cell_ids, centroids, spill, spill_ids, q)
+        else:
+            bulk_vals, bulk_ids = _probe_kernel(
+                cells, cell_scale, cell_ids, centroids, spill,
+                spill_ids, q, nprobe=nprobe, k=fetch,
+                n_real_cells=n_real_cells,
+            )
+        if k_tail:
+            tail_vals, tail_ids = _tail_kernel(tail, q, n_live, k_tail)
+        else:  # empty tail: nothing to scan
+            tail_vals = jnp.zeros((q.shape[0], 0), jnp.float32)
+            tail_ids = jnp.zeros((q.shape[0], 0), jnp.int32)
+        # the query embeddings ride out too (tiny [n, d] fetch): the
+        # shadow-sampling hook holds THEM — never the raw query texts —
+        # for its exact ground-truth scan and the frontier probes (PHI
+        # policy, obs/retrieval_observatory)
+        return bulk_vals, bulk_ids, tail_vals, tail_ids, emb
+
+    return program
+
+
 class FusedTieredRetriever:
     """Text-in, ranked-rows-out over a :class:`TieredIndex` in ONE dispatch.
 
@@ -261,10 +334,12 @@ class FusedTieredRetriever:
     fallback) is shared with ``TieredIndex.search`` via ``_merge``.
 
     Falls back to the fused-exact path (``FusedRetriever``) whenever the
-    tiered index itself would: no IVF tier yet, or filtered queries.  On a
-    multi-device mesh it serves through the three-dispatch tiered path
-    (the tier's cell tensors are replicated; only the exact fused path is
-    mesh-fused today).
+    tiered index itself would: no IVF tier yet, or filtered queries.
+    MESH-NATIVE (docqa-meshindex): on a multi-device mesh the probe
+    enters the sharded merge kernel inside the SAME single dispatch —
+    the former three-dispatch off-mesh fallback (and its loud
+    ``retrieve_offmesh_fallback_total`` counter) is structurally gone;
+    the perf gate holds that counter to zero on the multi-device path.
     """
 
     def __init__(self, encoder, tiered):
@@ -274,42 +349,20 @@ class FusedTieredRetriever:
         self._fns: Dict[Any, Any] = {}
         self._tier_token: Any = None  # evicts _fns when the tier swaps
 
-    def _get_fn(self, fetch: int, nprobe: int, k_tail: int):
+    def _get_fn(self, fetch: int, nprobe: int, k_tail: int, ivf):
         key = (fetch, nprobe, k_tail)
         fn = self._fns.get(key)
         if fn is None:
-            from docqa_tpu.index.ivf import _probe_kernel
-            from docqa_tpu.index.tiered import _tail_kernel
-
-            enc_cfg = self.encoder.cfg
-
-            def program(
-                enc_params, ids, lengths, cells, cell_ids, centroids,
-                spill, spill_ids, tail, n_live,
-            ):
-                emb = encode_batch(enc_params, enc_cfg, ids, lengths)
-                emb = emb / jnp.maximum(
-                    jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+            fn = jax.jit(
+                build_tiered_search_program(
+                    self.encoder.cfg,
+                    self.tiered.store.mesh,
+                    nprobe=nprobe,
+                    fetch=fetch,
+                    k_tail=k_tail,
+                    n_real_cells=ivf.n_real_cells,
                 )
-                q = emb.astype(cells.dtype)
-                bulk_vals, bulk_ids = _probe_kernel(
-                    cells, cell_ids, centroids, spill, spill_ids, q,
-                    nprobe=nprobe, k=fetch,
-                )
-                if k_tail:
-                    tail_vals, tail_ids = _tail_kernel(
-                        tail, q, n_live, k_tail
-                    )
-                else:  # empty tail: nothing to scan
-                    tail_vals = jnp.zeros((q.shape[0], 0), jnp.float32)
-                    tail_ids = jnp.zeros((q.shape[0], 0), jnp.int32)
-                # the query embeddings ride out too (tiny [n, d] fetch):
-                # the shadow-sampling hook holds THEM — never the raw
-                # query texts — for its exact ground-truth scan and the
-                # frontier probes (PHI policy, obs/retrieval_observatory)
-                return bulk_vals, bulk_ids, tail_vals, tail_ids, emb
-
-            fn = jax.jit(program)
+            )
             self._fns[key] = fn
         return fn
 
@@ -336,42 +389,6 @@ class FusedTieredRetriever:
             return self._exact.search_texts(
                 texts, k=k, filters=filters, deadline=deadline
             )
-        mesh = store.mesh
-        if mesh is not None and (mesh.n_model > 1 or mesh.n_data > 1):
-            # multi-device mesh: the IVF tier's cell tensors are built
-            # replicated, so the three-dispatch tiered path serves — the
-            # TIER must still serve (an exact fallback here would silently
-            # full-scan the store the operator configured tiered serving
-            # to avoid).  The exact fused path composes with the mesh
-            # (sharded_search); fusing the probe kernel is future work.
-            # LOUD (ROADMAP item 2 named this fallback silent): the
-            # request pays two extra host<->device round-trips, so it is
-            # counted, trace-flagged, and warned once per process.
-            global _OFFMESH_WARNED
-            DEFAULT_REGISTRY.counter("retrieve_offmesh_fallback").inc()
-            obs.flag("offmesh_fallback")
-            obs.event(
-                "offmesh_fallback",
-                n_model=mesh.n_model,
-                n_data=mesh.n_data,
-            )
-            if not _OFFMESH_WARNED:
-                _OFFMESH_WARNED = True
-                log.warning(
-                    "fused tiered probe falling back OFF-mesh (mesh "
-                    "n_model=%d n_data=%d): serving the three-dispatch "
-                    "tiered path — each such request pays two extra "
-                    "host<->device round-trips until the probe kernel is "
-                    "mesh-native (ROADMAP item 2); counted as "
-                    "retrieve_offmesh_fallback_total",
-                    mesh.n_model, mesh.n_data,
-                )
-            if deadline is not None:  # shed before three paid dispatches
-                deadline.check("retrieve_dispatch")
-            emb = np.asarray(
-                self.encoder.encode_texts(texts), np.float32
-            )
-            return tiered.search(emb, k=k)
         ivf, covered = tier
 
         n = len(texts)
@@ -406,7 +423,7 @@ class FusedTieredRetriever:
         # encoder included — on every append while the tail is small).
         # The padded bucket size bounds top_k's k.
         k_tail = min(max(k_bulk, k), int(tail_dev.shape[0]))
-        fn = self._get_fn(fetch, nprobe, k_tail)
+        fn = self._get_fn(fetch, nprobe, k_tail, ivf)
         if deadline is not None:  # marshal/rebuild may have eaten the budget
             deadline.check("retrieve_dispatch")
         def _tiered_on_lane():
@@ -415,6 +432,7 @@ class FusedTieredRetriever:
                 jnp.asarray(ids_p),
                 jnp.asarray(len_p),
                 ivf._cells,
+                ivf._cell_scale,
                 ivf._cell_ids,
                 ivf._centroids,
                 ivf._spill,
@@ -449,6 +467,8 @@ class FusedTieredRetriever:
         t_merge = perf_counter()
         bulk_rows = []
         for qi in range(n):
+            # full candidate pool (no cut at k_bulk): the exact re-rank
+            # below recovers rows the int8 ranking pushed past the cut
             row = []
             seen = set()
             for score, rid in zip(bulk_vals[qi], bulk_ids[qi]):
@@ -456,9 +476,18 @@ class FusedTieredRetriever:
                     continue
                 seen.add(int(rid))
                 row.append((float(score), int(rid), ivf._meta[int(rid)]))
-                if len(row) >= k_bulk:
-                    break
             bulk_rows.append(row)
+        if tiered._rerank_active(ivf):
+            # exact f32 re-rank against the store's host master copy —
+            # quantization error is confined to candidate selection
+            # (TieredIndex._rerank_bulk; the program's normalized query
+            # embeddings ride out of the dispatch either way).  Inactive
+            # for float tiers and across a compaction window (stale row
+            # ids must not index the renumbered host copy).
+            emb_np = np.asarray(emb_dev, np.float32)[:n]
+            bulk_rows = tiered._rerank_bulk(emb_np, bulk_rows, ivf, k_bulk)
+        else:
+            bulk_rows = [row[:k_bulk] for row in bulk_rows]
 
         # queries only matter to _merge for the under-fill exact fallback;
         # hand it the raw embeddings-equivalent texts' encodings lazily is
@@ -531,7 +560,9 @@ class FusedTieredRetriever:
                 k=k,
                 served=served,
                 shadow_fn=shadow_fn,
-                frontier_fn=lambda qn, p: ivf.timed_probe(qn, k=k, nprobe=p),
+                frontier_fn=lambda qn, p: self.tiered._frontier_probe(
+                    ivf, qn, k, p
+                ),
                 covered=covered,
                 n_clusters=ivf.n_clusters,
                 query_norms=norms,

@@ -170,6 +170,12 @@ class VectorStore:
         # masked out of every search; ``compact_deleted`` erases for real
         self._deleted = np.zeros((0,), bool)
         self._n_deleted = 0
+        # compaction generation: the ONLY operation that renumbers rows.
+        # Derived indexes that cached row ids (the tiered tier's exact
+        # re-rank) compare this against the value they captured at build
+        # time — a mismatch means their ids no longer address these rows
+        # (see TieredIndex._rerank_active).
+        self._n_compactions = 0
         # Token sidecar (cfg.token_width > 0): per-row generator-token ids
         # + true lengths, row-aligned with the vector buffer through every
         # add/grow/compact/snapshot — the device-side prompt source for
@@ -289,6 +295,15 @@ class VectorStore:
         """Tombstoned rows still occupying buffer slots (0 after
         ``compact_deleted``)."""
         return self._n_deleted
+
+    @property
+    def compactions(self) -> int:
+        """How many times rows have been renumbered (``compact_deleted``
+        erasures).  Captured at tier build and re-checked before any
+        host-row re-rank: stale row ids must never index the compacted
+        buffer."""
+        with self._lock:
+            return self._n_compactions
 
     @property
     def version(self) -> int:
@@ -587,6 +602,7 @@ class VectorStore:
             spine_run("store_add", _reupload_on_lane)
             if self._count == 0:  # keep a 1-row pad so slicing stays valid
                 self._host = np.zeros((1, self.cfg.dim), np.float32)
+            self._n_compactions += 1
             self._version += 1
             log.info("compacted %d deleted rows; %d remain", removed, self._count)
             return removed
@@ -755,6 +771,17 @@ class VectorStore:
         device round-trip."""
         with self._lock:
             return list(self._meta[: self._count])
+
+    def host_rows(self, ids: np.ndarray) -> np.ndarray:
+        """L2-normalized f32 vectors for the given row ids, from the host
+        master copy — the full-precision view the tiered index's exact
+        re-rank scores against (``index/tiered.py:_rerank_bulk``; the
+        int8 tier's quantization error is confined to candidate
+        selection this way).  Lock-free by the same append-only argument
+        as ``assemble_results``: rows the caller already holds ids for
+        are immutable, and ``_host`` reallocation publishes a whole new
+        array reference (atomic under the GIL), never a torn row."""
+        return self._host[np.asarray(ids, np.int64)]  # docqa-lint: disable=guarded-state
 
     def vectors_snapshot(
         self, start: int = 0

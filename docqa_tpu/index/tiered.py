@@ -26,7 +26,7 @@ from __future__ import annotations
 import functools
 import threading
 from time import perf_counter
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,11 +64,12 @@ class TieredIndex:
     def __init__(
         self,
         store: VectorStore,
-        nprobe: int = 32,
+        nprobe: int = 8,
         min_rows: int = 50_000,
         rebuild_tail_rows: int = 100_000,
         n_clusters: Optional[int] = None,
         seed: int = 0,
+        storage: str = "int8",
     ) -> None:
         self.store = store
         self.nprobe = nprobe
@@ -76,6 +77,10 @@ class TieredIndex:
         self.rebuild_tail_rows = rebuild_tail_rows
         self.n_clusters = n_clusters
         self.seed = seed
+        # bulk-tier cell format: "int8" (per-row-scaled tiles, the
+        # mesh-shardable HBM-resident layout) or "float" (store dtype,
+        # exact scores, 2x bytes, single-device only)
+        self.storage = storage
         # the active tier is published as ONE tuple (ivf, covered) — readers
         # take a single reference so they can never pair an old IVF with a
         # new watermark (rows in between would vanish from results)
@@ -114,10 +119,17 @@ class TieredIndex:
         whether an IVF tier is now active (False below ``min_rows`` — exact
         search is already optimal there)."""
         gen = self._gen
+        # captured BEFORE the snapshot: a compaction landing between the
+        # two reads makes the re-rank guard trip conservatively (skip
+        # the exact re-rank) instead of ever matching stale ids
+        comp_gen = self.store.compactions
         vectors, meta = self.store.vectors_snapshot()
         if len(vectors) < self.min_rows:
             return self._tier is not None
         with span("tiered_rebuild", DEFAULT_REGISTRY):
+            # the tier shards where the store shards: cell tiles ride
+            # the same model axis as the exact buffer's row shards, so
+            # a mesh serving 10M chunks holds 1/n of the tier per chip
             ivf = IVFIndex(
                 vectors,
                 meta,
@@ -125,7 +137,12 @@ class TieredIndex:
                 nprobe=self.nprobe,
                 seed=self.seed,
                 dtype=str(self.store.cfg.dtype),
+                mesh=self.store.mesh,
+                storage=self.storage,
             )
+        # the store generation this tier's row ids address (the exact
+        # re-rank refuses to index a renumbered host copy)
+        ivf._store_compactions = comp_gen
         with self._rebuild_lock:
             if gen != self._gen:
                 log.info("discarding rebuild begun before reset()")
@@ -191,6 +208,67 @@ class TieredIndex:
         if deleted_frac <= 0.25:
             return min(covered, 2 * k)
         return min(covered, 4 * k)
+
+    def _rerank_active(self, ivf: IVFIndex) -> bool:
+        """Whether the exact host re-rank applies to this tier: int8
+        storage (float tiers already score exactly) AND the store's
+        host copy is still the one the tier's row ids address — a
+        ``compact_deleted`` erasure renumbers rows, and between the
+        compaction and the operator's ``reset()`` a stale tier must
+        fall back to its own (internally consistent) quantized scores
+        rather than index the shrunk/renumbered buffer."""
+        return (
+            ivf.storage == "int8"
+            and getattr(ivf, "_store_compactions", None)
+            == self.store.compactions
+        )
+
+    def _rerank_order(
+        self, qn_row: np.ndarray, ids: np.ndarray, k: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The ONE exact-re-rank core both the serving path and the
+        frontier instrument use (they must never drift): true f32
+        cosines of ``ids`` against one normalized query from the
+        store's host master copy, plus the descending order cut to
+        ``k``.  ``store.host_rows`` is lock-free by its append-only
+        argument, and ``add()`` stores rows L2-normalized, so one
+        [m, d] @ [d] is the true cosine."""
+        scores = self.store.host_rows(ids) @ qn_row
+        return np.argsort(-scores)[:k], scores
+
+    def _rerank_bulk(
+        self,
+        queries_n: np.ndarray,
+        bulk: List[List[tuple]],
+        ivf: IVFIndex,
+        k_bulk: int,
+    ) -> List[List[tuple]]:
+        """Exact f32 re-rank of the int8 tier's candidate pool against
+        the store's host master copy, cut back to ``k_bulk``.
+
+        The int8 tiles decide WHICH candidates surface; this confines
+        their quantization error to candidate selection — the served
+        scores and ranking are full precision, so recall loss only
+        occurs when a true top-k row misses the (widened, ``dedup_full``)
+        candidate pool entirely.  Skipped (quantized scores served, cut
+        to k) for float tiers and across a compaction window
+        (:meth:`_rerank_active`).  Host cost: ~``k*(n_assign+1)`` dot
+        products per query — noise next to the probe dispatch."""
+        if not self._rerank_active(ivf):
+            return [row[:k_bulk] for row in bulk]
+        out: List[List[tuple]] = []
+        for qi, row in enumerate(bulk):
+            if not row:
+                out.append(row)
+                continue
+            ids = np.fromiter(
+                (rid for _s, rid, _m in row), np.int64, len(row)
+            )
+            order, scores = self._rerank_order(queries_n[qi], ids, k_bulk)
+            out.append(
+                [(float(scores[j]), row[j][1], row[j][2]) for j in order]
+            )
+        return out
 
     def _merge(
         self,
@@ -270,7 +348,13 @@ class TieredIndex:
             # make _observe_quality label this comparison with a value
             # the probe above never used
             nprobe_now = self.nprobe
-            bulk = ivf.search(queries, k=k_bulk, nprobe=nprobe_now)
+            qn = queries / np.maximum(
+                np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+            )
+            bulk = ivf.search(
+                queries, k=k_bulk, nprobe=nprobe_now, dedup_full=True
+            )
+            bulk = self._rerank_bulk(qn, bulk, ivf, k_bulk)
             DEFAULT_REGISTRY.histogram("retrieve_tier_ms_bulk_ivf").observe(
                 (perf_counter() - t_stage) * 1e3
             )
@@ -283,9 +367,6 @@ class TieredIndex:
                 vals = np.empty((len(queries), 0), np.float32)
                 ids = np.empty((len(queries), 0), np.int32)
             else:
-                qn = queries / np.maximum(
-                    np.linalg.norm(queries, axis=1, keepdims=True), 1e-9
-                )
                 # tombstone headroom like the bulk fetch, but never below k
                 # (k_bulk is capped at `covered`), and NOT clamped to
                 # n_live: rows past n_live are NEG_INF-masked and dropped
@@ -365,13 +446,44 @@ class TieredIndex:
                 k=k,
                 served=served,
                 shadow_fn=shadow_fn,
-                frontier_fn=lambda qn, p: ivf.timed_probe(qn, k=k, nprobe=p),
+                frontier_fn=lambda qn, p: self._frontier_probe(
+                    ivf, qn, k, p
+                ),
                 covered=covered,
                 n_clusters=ivf.n_clusters,
                 query_norms=norms,
                 served_margins=margins,
             )
         )
+
+    def _frontier_probe(self, ivf: IVFIndex, queries, k: int, nprobe: int):
+        """Frontier probe with SERVING semantics (the recallscope
+        ``frontier_fn``): widened candidate pool + the int8 path's exact
+        f32 re-rank, so the observed recall/latency frontier measures
+        what ``search`` would deliver at that nprobe — the raw quantized
+        ranking would understate served recall and recommend a bigger
+        nprobe than the target needs.  ``seconds`` stays the device
+        probe (the host re-rank is ~µs of numpy)."""
+        rows, seconds, fresh = ivf.timed_probe(
+            queries, k=k, nprobe=nprobe, dedup_full=True
+        )
+        if not self._rerank_active(ivf):
+            return [r[:k] for r in rows], seconds, fresh
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None]
+        qn = q / np.maximum(
+            np.linalg.norm(q, axis=1, keepdims=True), 1e-9
+        )
+        out = []
+        for qi, row in enumerate(rows):
+            if not row:
+                out.append(row)
+                continue
+            ids = np.fromiter((rid for rid, _s in row), np.int64, len(row))
+            order, scores = self._rerank_order(qn[qi], ids, k)
+            out.append([(int(ids[j]), float(scores[j])) for j in order])
+        return out, seconds, fresh
 
     def set_nprobe(self, nprobe: int) -> int:
         """Apply a new serving nprobe live — the observatory's
@@ -438,6 +550,26 @@ class TieredIndex:
             if gen == self._gen:
                 self._tail_cache = cache
         return cache
+
+    def index_stats(self) -> dict:
+        """Tier layout + byte accounting for ``/api/retrieval`` and the
+        perf gate's ``index_bytes_per_chunk`` structural ceiling."""
+        with self._rebuild_lock:
+            tier = self._tier
+        if tier is None:
+            return {"active": False}
+        ivf, covered = tier
+        out = {
+            "active": True,
+            "covered": covered,
+            "n_clusters": ivf.n_clusters,
+            "nprobe": self.nprobe,
+            "n_assign": ivf.n_assign,
+            "cap": ivf.cap,
+            "spilled": ivf.n_spilled,
+        }
+        out.update(ivf.index_bytes())
+        return out
 
     # ---- store passthroughs (QAService drop-in) -----------------------------
 

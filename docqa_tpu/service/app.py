@@ -194,6 +194,7 @@ class DocQARuntime:
                 nprobe=self.cfg.store.ivf_nprobe,
                 min_rows=self.cfg.store.ivf_min_rows,
                 rebuild_tail_rows=self.cfg.store.ivf_rebuild_tail,
+                storage=self.cfg.store.ivf_storage,
             )
         else:
             self.search_index = self.store
@@ -978,12 +979,20 @@ def make_app(rt: DocQARuntime):
                 "retrieval observatory disabled (retrieval_quality.enabled)",
             )
         payload = rt.retrieval_obs.status()
+        stats_fn = getattr(rt.search_index, "index_stats", None)
         payload["serving"] = {
             "serving_index": rt.cfg.store.serving_index,
             "rows": rt.store.count,
             "nprobe": getattr(rt.search_index, "nprobe", None),
             "covered": getattr(rt.search_index, "covered", None),
             "tail_rows": getattr(rt.search_index, "tail_rows", None),
+            # tier layout + per-chunk/per-shard bytes (docqa-meshindex):
+            # the capacity surface the "scale past 1M chunks" runbook
+            # reads (storage dtype, shard count, bytes_per_chunk)
+            "index": stats_fn() if stats_fn is not None else None,
+            # structurally zero since the probe went mesh-native — kept
+            # on the surface (and perf-gate-pinned to 0) so any future
+            # fallback reappearing is loud
             "offmesh_fallbacks": DEFAULT_REGISTRY.counter(
                 "retrieve_offmesh_fallback"
             ).value,

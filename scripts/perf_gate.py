@@ -41,6 +41,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: the sharded-tier structural section (index_bytes_per_chunk /
+# retrieve_offmesh_fallback_total) needs an 8-virtual-device CPU mesh,
+# but forcing the device-count flag on THIS process would flip the
+# dispatch spine into strict mode (auto-on for the multi-device CPU
+# client) and serialize the single-device load smoke the timing
+# baselines were measured on — so that section runs in a SUBPROCESS
+# (--sharded-only) with its own XLA_FLAGS.
 
 BASELINE_DEFAULT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -251,6 +258,45 @@ def measure(
         robs.stop()
     est = rq_status.get("estimate") or {}
     metrics["retrieve_recall_smoke"] = est.get("recall")
+
+    # mesh-sharded int8 tier (docqa-meshindex): structural ceilings, not
+    # timings — measured in a SUBPROCESS on an 8-virtual-device mesh
+    # (see the module-top note on why this process must stay
+    # single-device).  A failed subprocess leaves the metrics missing,
+    # which the gate reports loudly instead of passing silently.
+    import subprocess
+
+    try:
+        sub = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sharded-only"],
+            capture_output=True, text=True, timeout=600,
+            env={
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=8"
+                ).strip(),
+            },
+        )
+        if sub.returncode == 0 and sub.stdout.strip():
+            metrics.update(json.loads(sub.stdout.strip().splitlines()[-1]))
+        else:
+            print(
+                "sharded-tier structural section FAILED "
+                f"(rc={sub.returncode}):\n{sub.stderr[-2000:]}",
+                file=sys.stderr,
+            )
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        # a hung/killed subprocess (or garbage on its stdout) must not
+        # abort the WHOLE measure run: every other baseline would be
+        # lost — the gate then fails on exactly the two missing
+        # sharded metrics, which is the loud report we want
+        print(
+            f"sharded-tier structural section FAILED: {e!r}",
+            file=sys.stderr,
+        )
+
     if retrieval_out:
         with open(retrieval_out, "w", encoding="utf-8") as f:
             json.dump(rq_status, f, indent=1)
@@ -286,6 +332,67 @@ def measure(
             json.dump(store.snapshot(), f, indent=1)
         print(f"telemetry snapshot -> {telemetry_out}")
     return result
+
+
+def measure_sharded_structural() -> dict:
+    """Subprocess body (``--sharded-only``; requires the 8-device
+    XLA flag in this process's env): deterministic clustered corpus on
+    the 1x8 CPU mesh, served through the mesh-native fused tiered
+    program.
+
+    - ``index_bytes_per_chunk``: the int8 tier's per-chunk device bytes
+      — a regression back to float cells (or a layout that balloons
+      per-row overhead) moves this far beyond its band;
+    - ``retrieve_offmesh_fallback_total``: MUST stay 0 — the
+      multi-device fused tiered path serves in one mesh-native
+      dispatch; any fallback reappearing is a red build."""
+    import numpy as np
+
+    from docqa_tpu.config import EncoderConfig, StoreConfig
+    from docqa_tpu.engines.encoder import EncoderEngine
+    from docqa_tpu.engines.retrieve import FusedTieredRetriever
+    from docqa_tpu.index.store import VectorStore
+    from docqa_tpu.index.tiered import TieredIndex
+    from docqa_tpu.runtime.mesh import host_cpu_mesh
+    from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY
+
+    rng = np.random.default_rng(11)
+    sup = rng.standard_normal((60, 32)).astype(np.float32)
+    sup /= np.linalg.norm(sup, axis=1, keepdims=True)
+    assign = rng.integers(0, len(sup), 6000)
+    noise = rng.standard_normal((6000, 32)).astype(np.float32)
+    noise /= np.linalg.norm(noise, axis=1, keepdims=True)
+    cvecs = sup[assign] + 0.5 * noise
+    cvecs /= np.linalg.norm(cvecs, axis=1, keepdims=True)
+
+    mesh8 = host_cpu_mesh(8, data=1)
+    enc = EncoderEngine(
+        EncoderConfig(
+            vocab_size=128, hidden_dim=32, num_layers=1, num_heads=4,
+            mlp_dim=64, max_seq_len=16, embed_dim=32, dtype="float32",
+        )
+    )
+    vs_sh = VectorStore(
+        StoreConfig(dim=32, shard_capacity=8192, dtype="float32"),
+        mesh=mesh8,
+    )
+    vs_sh.add(cvecs, [{"doc_id": f"m{i}"} for i in range(len(cvecs))])
+    tiered_sh = TieredIndex(
+        vs_sh, nprobe=8, min_rows=1000, rebuild_tail_rows=10**6,
+        n_clusters=64, seed=0,
+    )
+    tiered_sh.rebuild()
+    stats = tiered_sh.index_stats()
+    assert stats["shards"] == 8 and stats["storage"] == "int8"
+    ft = FusedTieredRetriever(enc, tiered_sh)
+    for _ in range(2):
+        ft.search_texts(["lab panel for patient q7"], k=5)
+    return {
+        "index_bytes_per_chunk": stats["bytes_per_chunk"],
+        "retrieve_offmesh_fallback_total": int(
+            DEFAULT_REGISTRY.counter("retrieve_offmesh_fallback").value
+        ),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -443,6 +550,13 @@ def write_baseline(
         # (hit rate or avoided-token collapse) is a red build
         "warm_prefix_hit_rate": ("higher", 10),
         "warm_prefill_tokens_avoided": ("higher", 10),
+        # structural sharded-tier ceilings (docqa-meshindex): per-chunk
+        # int8 index bytes only grow through the --write-baseline TODO
+        # workflow (same policy as the compile-audit HBM ceilings), and
+        # the off-mesh fallback counter is pinned to exactly zero on
+        # the multi-device measure path
+        "index_bytes_per_chunk": ("lower", 10),
+        "retrieve_offmesh_fallback_total": ("lower", 0),
     }
     # context-only outputs (exact token counts, sample sizes) are for
     # humans reading the report, not latency budgets
@@ -519,7 +633,15 @@ def main() -> int:
                     help="write the measure-mode cost-attribution "
                          "snapshot (per-class ledger; docqa-costscope) "
                          "here")
+    ap.add_argument("--sharded-only", action="store_true",
+                    help=argparse.SUPPRESS)  # internal subprocess mode
     args = ap.parse_args()
+
+    if args.sharded_only:
+        # subprocess mode: the parent set the 8-device XLA flag; print
+        # ONLY the structural metrics JSON on the last stdout line
+        print(json.dumps(measure_sharded_structural()))
+        return 0
 
     if args.bench:
         with open(args.bench, encoding="utf-8") as f:

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Standalone driver for the mesh-sharded tiered retrieval sweep
+(``bench.py:run_shard_scale`` — docqa-meshindex, ROADMAP item 2).
+
+Runs the 1M→10M synthetic clustered sweep on the 8-virtual-device CPU
+mesh (or the real mesh under a TPU backend) and MERGES the resulting
+``shard_scale`` section into ``bench_details.json`` without touching the
+other sections — the full ``bench.py`` run produces the same section
+in-line; this script exists so the slow sweep can be (re)measured
+without re-running the whole matrix::
+
+    python scripts/shard_scale_bench.py                      # full sweep
+    python scripts/shard_scale_bench.py --scales 1000000     # quick look
+    python scripts/shard_scale_bench.py --out -              # stdout only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--scales", default="1000000,2000000,5000000,10000000",
+        help="comma-separated corpus sizes",
+    )
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument(
+        "--nprobes", default="4,8,16,32,64",
+        help="comma-separated frontier nprobe grid",
+    )
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="wall budget; later scales skip when exhausted")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_details.json",
+        ),
+        help="bench_details.json to merge into ('-' = stdout only)",
+    )
+    args = ap.parse_args()
+
+    import bench  # noqa: E402  (path inserted above; sets nothing up)
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    result = bench.run_shard_scale(
+        scales=tuple(int(s) for s in args.scales.split(",")),
+        dim=args.dim,
+        nprobes=tuple(int(p) for p in args.nprobes.split(",")),
+        budget_s=args.budget_s,
+        on_tpu=on_tpu,
+    )
+    if args.out == "-":
+        json.dump(result, sys.stdout, indent=1)
+        print()
+        return 0
+    details = {}
+    if os.path.exists(args.out):
+        with open(args.out, encoding="utf-8") as f:
+            details = json.load(f)
+    details["shard_scale"] = result
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(details, f, indent=2)
+    print(f"shard_scale section merged -> {args.out}")
+    dec = result.get("nprobe_decision", {})
+    print(
+        f"nprobe decision: chosen={dec.get('chosen')} "
+        f"(target {dec.get('recall_target')}, qualified "
+        f"{dec.get('qualified_nprobes')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,479 @@
+"""docqa-meshindex: int8 tiles, the mesh-sharded IVF tier, and the
+recallscope instruments against it.
+
+Covers the ISSUE-15 test satellite: quantize→dequantize round-trip
+bounds, sharded-vs-single-device top-k ID equality on the 8-virtual-
+device CPU mesh (exact ties tolerated, the PR-13 comparison rule),
+zero-shadow-dispatch-while-disabled against the sharded tier, and the
+quantization-induced recall loss being *measured* (visible on
+/api/retrieval) rather than hidden.
+"""
+
+import numpy as np
+import pytest
+
+from docqa_tpu.config import StoreConfig
+from docqa_tpu.index.ivf import IVFIndex, quantize_rows_int8
+from docqa_tpu.index.store import VectorStore
+from docqa_tpu.index.tiered import TieredIndex
+from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY
+
+DIM = 64
+
+
+def _clustered(n=4000, d=DIM, n_centers=32, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 4
+    assign = rng.integers(0, n_centers, n)
+    x = centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
+    x = x.astype(np.float32)
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+
+def _collapse_corpus(n=600, d=DIM, seed=5):
+    """A corpus int8 CANNOT represent: one dominant shared component
+    plus tiny distinguishing components below the quantization step
+    (max|v|/127), so every row's tile collapses to the same int8
+    pattern while the exact ranking is driven entirely by the tiny
+    components.  The tier's candidate selection becomes arbitrary —
+    the recall loss is real and must be MEASURED, not hidden."""
+    rng = np.random.default_rng(seed)
+    base = np.zeros((d,), np.float32)
+    base[0] = 1.0
+    # per-component sigma 0.002 << the int8 step max|v|/127 ~ 0.0079:
+    # nearly every distinguishing component rounds to zero
+    perp = 0.002 * rng.standard_normal((n, d)).astype(np.float32)
+    perp[:, 0] = 0.0
+    v = base[None, :] + perp
+    return (v / np.linalg.norm(v, axis=1, keepdims=True)).astype(np.float32)
+
+
+def _ids_tie_tolerant_equal(row_a, row_b, eps=1e-4):
+    """PR-13 comparison rule: positions may swap ids only where the
+    scores tie (duplicate-score rows are interchangeable evidence)."""
+    assert len(row_a) == len(row_b)
+    for (sa, ia, _), (sb, ib, _) in zip(row_a, row_b):
+        if ia != ib:
+            assert abs(sa - sb) <= eps, (
+                f"id mismatch {ia} vs {ib} with non-tied scores "
+                f"{sa} vs {sb}"
+            )
+
+
+class TestInt8Tiles:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 48)).astype(np.float32)
+        x /= np.linalg.norm(x, axis=1, keepdims=True)
+        q, s = quantize_rows_int8(x)
+        assert q.dtype == np.int8 and s.shape == (64,)
+        assert np.abs(q).max() <= 127
+        err = np.abs(x - q.astype(np.float32) * s[:, None])
+        # documented bound: per-component error <= scale/2 = max|row|/254
+        bound = np.abs(x).max(axis=1) / 254.0
+        assert (err <= bound[:, None] + 1e-7).all()
+
+    def test_zero_rows_exact(self):
+        q, s = quantize_rows_int8(np.zeros((3, 8), np.float32))
+        assert (q == 0).all() and (s == 0).all()
+
+    def test_tile_shape_per_row_scales(self):
+        # [C, cap, d] tiles quantize with [C, cap] per-row scales
+        x = np.random.default_rng(1).standard_normal((4, 5, 16))
+        q, s = quantize_rows_int8(x)
+        assert q.shape == (4, 5, 16) and s.shape == (4, 5)
+
+    def test_int8_tier_bytes_well_below_float(self):
+        x = _clustered(2000)
+        meta = [{"row": i} for i in range(len(x))]
+        i8 = IVFIndex(x, meta, n_clusters=16, dtype="float32",
+                      storage="int8")
+        fl = IVFIndex(x, meta, n_clusters=16, dtype="float32",
+                      storage="float")
+        b8 = i8.index_bytes()
+        bf = fl.index_bytes()
+        assert b8["storage"] == "int8" and bf["storage"] == "float"
+        assert b8["total_bytes"] < 0.5 * bf["total_bytes"]
+        assert b8["bytes_per_chunk"] < 0.5 * bf["bytes_per_chunk"]
+
+
+class TestShardedTier:
+    def test_sharded_vs_single_device_topk_ids(self, mesh_tp8):
+        x = _clustered(4000)
+        meta = [{"row": i} for i in range(len(x))]
+        # C=30 does not divide 8: exercises the padded-cell masking too
+        sharded = IVFIndex(x, meta, n_clusters=30, nprobe=8,
+                           dtype="float32", mesh=mesh_tp8)
+        single = IVFIndex(x, meta, n_clusters=30, nprobe=8,
+                          dtype="float32")
+        assert sharded._sharded and not single._sharded
+        assert sharded.cells_per_shard * 8 >= sharded.n_real_cells
+        rng = np.random.default_rng(1)
+        q = x[:20] + 0.01 * rng.standard_normal((20, DIM)).astype(np.float32)
+        for np_ in (2, 8, 30):
+            rs = sharded.search(q, k=10, nprobe=np_)
+            r1 = single.search(q, k=10, nprobe=np_)
+            for a, b in zip(rs, r1):
+                _ids_tie_tolerant_equal(
+                    [(s, i, m) for s, i, m in a],
+                    [(s, i, m) for s, i, m in b],
+                )
+
+    def test_sharded_forces_int8(self, mesh_tp8):
+        x = _clustered(1000)
+        ivf = IVFIndex(x, [{}] * len(x), n_clusters=16, dtype="float32",
+                       mesh=mesh_tp8, storage="float")
+        assert ivf.storage == "int8"
+
+    def test_per_shard_bytes_split(self, mesh_tp8):
+        x = _clustered(4000)
+        ivf = IVFIndex(x, [{}] * len(x), n_clusters=32, dtype="float32",
+                       mesh=mesh_tp8)
+        b = ivf.index_bytes()
+        assert b["shards"] == 8
+        # a shard holds ~1/8 of the cell tensors plus the replicated
+        # centroids/spill — far below the whole tier
+        assert b["per_shard_bytes"] < 0.3 * b["total_bytes"]
+
+    def test_sharded_tiered_serves_and_self_queries(self, mesh_tp8):
+        x = _clustered(3000, seed=3)
+        store = VectorStore(
+            StoreConfig(dim=DIM, shard_capacity=4096, dtype="float32"),
+            mesh=mesh_tp8,
+        )
+        store.add(x, [{"doc_id": f"d{i}"} for i in range(len(x))])
+        tiered = TieredIndex(store, nprobe=8, min_rows=100,
+                             rebuild_tail_rows=10**6)
+        assert tiered.rebuild()
+        stats = tiered.index_stats()
+        assert stats["shards"] == 8 and stats["storage"] == "int8"
+        res = tiered.search(x[77], k=5)[0]
+        assert res[0].row_id == 77
+        # exact f32 re-rank: the served self-query score is full
+        # precision even though the tiles are int8
+        assert res[0].score == pytest.approx(1.0, abs=2e-3)
+        # fresh appends stay exact (tail tier) on the sharded build
+        fresh = _clustered(8, seed=99)
+        store.add(fresh, [{"doc_id": f"new{i}"} for i in range(8)])
+        got = tiered.search(fresh, k=1)
+        assert [r[0].metadata["doc_id"] for r in got] == [
+            f"new{i}" for i in range(8)
+        ]
+
+    def test_sharded_tiered_ids_match_single_device_tiered(self, mesh_tp8):
+        """The acceptance criterion verbatim: the full tiered serving
+        path on the 8-device mesh returns the same top-k ids
+        (tie-tolerant) as the single-device tiered path over the same
+        corpus and build seed."""
+        x = _clustered(3000, seed=21)
+        meta = [{"doc_id": f"d{i}"} for i in range(len(x))]
+
+        def build(mesh):
+            store = VectorStore(
+                StoreConfig(dim=DIM, shard_capacity=4096,
+                            dtype="float32"),
+                mesh=mesh,
+            )
+            store.add(x, meta)
+            t = TieredIndex(store, nprobe=6, min_rows=100,
+                            rebuild_tail_rows=10**6, n_clusters=30,
+                            seed=0)
+            assert t.rebuild()
+            return t
+        t_mesh = build(mesh_tp8)
+        t_solo = build(None)
+        rng = np.random.default_rng(2)
+        q = x[:24] + 0.01 * rng.standard_normal((24, DIM)).astype(np.float32)
+        for a, b in zip(t_mesh.search(q, k=10), t_solo.search(q, k=10)):
+            _ids_tie_tolerant_equal(
+                [(r.score, r.row_id, r.metadata) for r in a],
+                [(r.score, r.row_id, r.metadata) for r in b],
+            )
+
+    def test_zero_shadow_dispatch_while_disabled(self, mesh_tp8):
+        from docqa_tpu import obs
+        from docqa_tpu.engines.spine import get_spine
+
+        def shadow_count():
+            row = get_spine().stats()["stages"].get("retrieve_shadow")
+            return row["count"] if row else 0
+
+        x = _clustered(2000, seed=11)
+        store = VectorStore(
+            StoreConfig(dim=DIM, shard_capacity=2048, dtype="float32"),
+            mesh=mesh_tp8,
+        )
+        store.add(x, [{"doc_id": f"d{i}"} for i in range(len(x))])
+        tiered = TieredIndex(store, nprobe=4, min_rows=100,
+                             rebuild_tail_rows=10**6)
+        assert tiered.rebuild()
+        prev = obs.set_retrieval_observatory(None)
+        try:
+            before = shadow_count()
+            for _ in range(4):
+                tiered.search(x[:4], k=5)
+            assert shadow_count() == before, (
+                "sampling disabled must mean ZERO shadow dispatches "
+                "against the sharded tier"
+            )
+        finally:
+            obs.set_retrieval_observatory(prev)
+
+    def test_fused_mesh_native_matches_two_step(self, mesh_tp8):
+        from docqa_tpu.config import EncoderConfig
+        from docqa_tpu.engines.encoder import EncoderEngine
+        from docqa_tpu.engines.retrieve import FusedTieredRetriever
+
+        enc = EncoderEngine(
+            EncoderConfig(
+                vocab_size=128, hidden_dim=32, num_layers=1, num_heads=4,
+                mlp_dim=64, max_seq_len=16, embed_dim=DIM,
+                dtype="float32",
+            )
+        )
+        store = VectorStore(
+            StoreConfig(dim=DIM, shard_capacity=512, dtype="float32"),
+            mesh=mesh_tp8,
+        )
+        texts = [
+            f"note {i}: drug-{i % 13} for condition-{i % 7}"
+            for i in range(300)
+        ]
+        store.add(
+            enc.encode_texts(texts),
+            [{"doc_id": f"d{i}", "source": t} for i, t in enumerate(texts)],
+        )
+        tiered = TieredIndex(store, nprobe=4, min_rows=100,
+                             rebuild_tail_rows=10**6)
+        assert tiered.rebuild()
+        retr = FusedTieredRetriever(enc, tiered)
+        fallback0 = DEFAULT_REGISTRY.counter(
+            "retrieve_offmesh_fallback"
+        ).value
+        queries = ["drug-3 for condition-3", "drug-7 for condition-0"]
+        fused = retr.search_texts(queries, k=5)
+        emb = np.asarray(enc.encode_texts(queries), np.float32)
+        two_step = tiered.search(emb, k=5)
+        for a, b in zip(fused, two_step):
+            _ids_tie_tolerant_equal(
+                [(r.score, r.row_id, r.metadata) for r in a],
+                [(r.score, r.row_id, r.metadata) for r in b],
+            )
+        # mesh-native: ONE dispatch, no off-mesh fallback ever
+        assert (
+            DEFAULT_REGISTRY.counter("retrieve_offmesh_fallback").value
+            == fallback0
+        )
+
+
+class TestQuantizationMeasured:
+    """The int8 tier's recall cost must surface in the recallscope
+    estimate (ground truth = exact full-precision scan), never be
+    hidden by comparing quantized-to-quantized."""
+
+    def _estimate(self, storage, mesh, vecs, nprobe):
+        from docqa_tpu import obs
+
+        store = VectorStore(
+            StoreConfig(dim=DIM, shard_capacity=1024, dtype="float32"),
+            mesh=mesh,
+        )
+        store.add(vecs, [{"doc_id": f"d{i}"} for i in range(len(vecs))])
+        tiered = TieredIndex(store, nprobe=nprobe, min_rows=100,
+                             rebuild_tail_rows=10**6, storage=storage)
+        assert tiered.rebuild()
+        robs = obs.RetrievalObservatory(
+            sample_every=1, seed=0, frontier_every=0,
+            registry=DEFAULT_REGISTRY,
+        ).start()
+        prev = obs.set_retrieval_observatory(robs)
+        try:
+            rng = np.random.default_rng(9)
+            q = vecs[:24] + 1e-4 * rng.standard_normal(
+                (24, DIM)
+            ).astype(np.float32)
+            for start in range(0, 24, 8):
+                tiered.search(q[start : start + 8], k=10)
+            assert robs.drain(60)
+            est = robs.status()["estimate"]
+        finally:
+            obs.set_retrieval_observatory(prev)
+            robs.stop()
+        assert est is not None
+        return est
+
+    def test_collapse_corpus_loss_measured_int8_vs_float_control(
+        self, mesh_tp8
+    ):
+        vecs = _collapse_corpus()
+        # full probe (nprobe >= n_clusters): coarse misses impossible,
+        # what remains is pure quantization
+        est_q = self._estimate("int8", mesh_tp8, vecs, nprobe=64)
+        est_f = self._estimate("float", None, vecs, nprobe=64)
+        assert est_f["recall"] >= 0.999, est_f
+        assert est_q["recall"] < 0.9, (
+            f"collapse corpus must show measured quantization loss, "
+            f"got {est_q}"
+        )
+        assert est_q["ci_hi"] < est_f["ci_lo"]
+
+    def test_loss_visible_on_api_retrieval_e2e(self):
+        """Served e2e: a fake-mode runtime (tiered serving on the
+        8-virtual-device mesh the runtime builds itself) over the
+        collapse corpus — /api/retrieval must show the degraded recall
+        estimate, the int8/sharded tier layout, and zero off-mesh
+        fallbacks."""
+        import asyncio
+
+        from docqa_tpu.config import load_config
+        from docqa_tpu.service.app import DocQARuntime, make_app
+
+        cfg = load_config(env={}, overrides={
+            "flags.use_fake_llm": True,
+            "flags.use_fake_encoder": True,
+            "encoder.embed_dim": DIM,
+            "store.dim": DIM,
+            "store.shard_capacity": 1024,
+            "store.serving_index": "tiered",
+            # full probe: coarse misses impossible, the estimate
+            # isolates pure quantization loss
+            "store.ivf_nprobe": 64,
+            "store.ivf_min_rows": 100,
+            "ner.train_steps": 0,
+            "retrieval_quality.sample_every": 1,
+            "retrieval_quality.frontier_every": 0,
+        })
+        rt = DocQARuntime(cfg).start()
+        try:
+            vecs = _collapse_corpus()
+            rt.store.add(
+                vecs,
+                [
+                    {"doc_id": f"d{i}", "source": f"s{i}",
+                     "text_content": f"chunk {i}"}
+                    for i in range(len(vecs))
+                ],
+            )
+            assert rt.search_index.rebuild()
+
+            async def drive():
+                import aiohttp
+                from aiohttp import web
+
+                app = make_app(rt)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                port = site._server.sockets[0].getsockname()[1]
+                base = f"http://127.0.0.1:{port}"
+                loop = asyncio.get_running_loop()
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        for i in range(12):
+                            async with s.post(
+                                f"{base}/ask/",
+                                json={"question": f"chunk {i} dose?"},
+                            ) as r:
+                                assert r.status == 200, await r.text()
+                        assert await loop.run_in_executor(
+                            None, rt.retrieval_obs.drain, 30
+                        )
+                        async with s.get(f"{base}/api/retrieval") as r:
+                            assert r.status == 200
+                            return await r.json()
+                finally:
+                    await runner.cleanup()
+
+            payload = asyncio.run(drive())
+        finally:
+            rt.stop()
+        est = payload["estimate"]
+        assert est is not None and est["recall"] < 0.9, (
+            f"quantization-induced loss must be visible: {est}"
+        )
+        idx = payload["serving"]["index"]
+        assert idx["active"] and idx["storage"] == "int8"
+        assert idx["shards"] == 8
+        assert idx["bytes_per_chunk"] > 0
+        assert payload["serving"]["offmesh_fallbacks"] == 0
+
+    def test_rerank_suspended_across_compaction_window(self):
+        """A compact_deleted erasure renumbers rows; until the operator
+        resets+rebuilds, the stale tier must serve its own quantized
+        scores (the pre-meshindex behavior) — NOT index the
+        shrunk/renumbered host copy with stale ids (IndexError or
+        silently mis-scored rows)."""
+        x = _clustered(2000, seed=13)
+        store = VectorStore(
+            StoreConfig(dim=DIM, shard_capacity=2048, dtype="float32")
+        )
+        store.add(
+            x,
+            [{"doc_id": f"doc{i // 4}", "row": i} for i in range(len(x))],
+        )
+        tiered = TieredIndex(store, nprobe=8, min_rows=100,
+                             rebuild_tail_rows=10**6, n_clusters=16)
+        assert tiered.rebuild()
+        ivf = tiered._tier[0]
+        assert tiered._rerank_active(ivf)
+        # erase most of the corpus: the host copy shrinks and renumbers
+        store.delete_docs([f"doc{i}" for i in range(400)])
+        store.compact_deleted()
+        assert store.count < 2000
+        assert not tiered._rerank_active(ivf)
+        # the stale tier still serves without touching the compacted
+        # host copy (quantized scores, internally consistent ids)
+        res = tiered.search(x[:8], k=5)
+        assert all(len(row) <= 5 for row in res)
+        # frontier instrument likewise falls back cleanly
+        rows, _s, _f = tiered._frontier_probe(ivf, x[:2], 5, 8)
+        assert len(rows) == 2
+        # after the documented reset+rebuild the re-rank resumes
+        tiered.reset()
+        assert tiered.rebuild()
+        assert tiered._rerank_active(tiered._tier[0])
+
+    def test_rerank_confines_quantization_to_selection(self):
+        # moderately tight corpus: int8 flips in-pool rankings, the
+        # exact re-rank recovers them — served recall beats the raw
+        # quantized ranking
+        rng = np.random.default_rng(4)
+        center = rng.standard_normal((DIM,)).astype(np.float32)
+        vecs = center[None, :] + 0.15 * rng.standard_normal(
+            (800, DIM)
+        ).astype(np.float32)
+        vecs = (vecs / np.linalg.norm(vecs, axis=1, keepdims=True)).astype(
+            np.float32
+        )
+        store = VectorStore(
+            StoreConfig(dim=DIM, shard_capacity=1024, dtype="float32")
+        )
+        store.add(vecs, [{"doc_id": f"d{i}"} for i in range(len(vecs))])
+        tiered = TieredIndex(store, nprobe=32, min_rows=100,
+                             rebuild_tail_rows=10**6, n_clusters=8)
+        assert tiered.rebuild()
+        q = vecs[:16]
+        exact = store.search(q, k=10)
+        served = tiered.search(q, k=10)
+        ivf = tiered._tier[0]
+        raw = ivf.search(q, k=10, nprobe=8)
+        def recall(rows, attr=None):
+            hits = total = 0
+            for e_row, row in zip(exact, rows):
+                want = {r.row_id for r in e_row}
+                got = (
+                    {r.row_id for r in row}
+                    if attr is None
+                    else {rid for _s, rid, _m in row}
+                )
+                hits += len(want & got)
+                total += len(want)
+            return hits / total
+        served_recall = recall(served)
+        raw_recall = recall(raw, attr="tuples")
+        assert served_recall >= raw_recall
+        assert served_recall >= 0.95, (
+            f"served (re-ranked) recall {served_recall} vs raw "
+            f"quantized {raw_recall}"
+        )

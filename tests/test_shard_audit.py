@@ -65,6 +65,20 @@ class TestBudgetGate:
         assert counts["all-reduce"] == 0
         assert counts["all-to-all"] == 0
 
+    def test_sharded_ivf_tier_rides_the_same_merge_budget(self, report):
+        """docqa-meshindex: the mesh-native fused tiered program — int8
+        cell tiles row-sharded over model, coarse score replicated —
+        owes exactly the 2-gather top-k merge on every multi-device
+        mesh, nothing else (the probe never leaves the shard)."""
+        prog = report["programs"]["retrieve_ivf_sharded"]
+        for mesh_name, shards in (("2x4", 4), ("1x8", 8)):
+            counts = prog["per_mesh"][mesh_name]
+            assert counts["row_shards"] == shards
+            assert counts["all-gather"] == 2  # merged vals + ids
+            assert counts["all-reduce"] == 0
+            assert counts["all-to-all"] == 0
+            assert counts["collective-permute"] == 0
+
     def test_single_device_mesh_is_collective_free(self, report):
         for name, prog in report["programs"].items():
             counts = prog["per_mesh"]["1x1"]
@@ -133,3 +147,24 @@ class TestMutations:
         entry["ring_rounds"] = entry["ring_size"]  # the pre-fix n rounds
         violations = shard_audit.semantic_violations(broken)
         assert any("n-1" in v for v in violations)
+
+    def test_sharded_ivf_extra_collective_flips_red(self, report):
+        """A layout drift that adds a third gather (or smuggles in an
+        all-reduce) on the sharded IVF path is a semantic violation of
+        the measurement — --write-budget cannot launder it."""
+        broken = json.loads(json.dumps(report))
+        entry = broken["programs"]["retrieve_ivf_sharded"]["per_mesh"]["1x8"]
+        entry["all-gather"] = 3
+        violations = shard_audit.semantic_violations(broken)
+        assert any(
+            "retrieve_ivf_sharded/1x8" in v and "merge pair" in v
+            for v in violations
+        )
+        broken2 = json.loads(json.dumps(report))
+        entry2 = broken2["programs"]["retrieve_ivf_sharded"]["per_mesh"]["2x4"]
+        entry2["all-reduce"] = 1
+        violations2 = shard_audit.semantic_violations(broken2)
+        assert any(
+            "retrieve_ivf_sharded/2x4" in v and "all-reduce" in v
+            for v in violations2
+        )
