@@ -683,6 +683,45 @@ class GenerateConfig:
 
 
 @dataclass(frozen=True)
+class QoSConfig:
+    """Multi-tenant QoS (docqa-qos; docs/OPERATIONS.md "Protect
+    interactive traffic under overload"): weighted-fair admission by
+    request class, KV preemption under block-pool pressure, and
+    SLO-burn-driven batch deferral.  Policy state is served on
+    /api/status; per-class preemption/deferral counters reach
+    /api/telemetry and both /metrics dialects."""
+
+    # master switch: False reverts every batcher to plain FIFO admission
+    # with no preemption and no deferral (the pre-QoS behavior, bit for
+    # bit — the bench qos_overload section A/Bs exactly this flag)
+    enabled: bool = True
+    # admission weights: over a contended drain, classes are served in
+    # this ratio (deficit WFQ in engines/qos.ClassQueue).  Weights shape
+    # throughput SHARING; they are not the eviction ranks.
+    weight_interactive: float = 8.0
+    weight_batch: float = 2.0
+    weight_background: float = 1.0
+    # starvation-aging floor: a queue head older than this wins the next
+    # admission slot outright regardless of weight (bounded starvation
+    # for the 1-weight classes under an interactive burst); 0 disables
+    aging_floor_s: float = 5.0
+    # KV preemption under BlockPoolExhausted pressure: "off" never
+    # evicts, "advisory" computes and counts would-be victims (the
+    # preemption_candidates dry-run on /api/costs/sheds) without
+    # evicting, "on" evicts lower-ranked holders' KV blocks and
+    # requeues them (generated-so-far tokens preserved for re-prefill)
+    preemption: str = "off"
+    # a preemption victim whose deadline has less than this left cannot
+    # survive a second prefill: it degrades typed instead of requeueing
+    preempt_min_resume_s: float = 0.5
+    # self-protection: while the /ask p95 or availability SLO burns,
+    # defer batch-class admission (typed serve.DeferredByPolicy; relaxes
+    # as the burn clears).  Background is never deferred — it carries
+    # the pool's canaries.
+    defer_batch_on_burn: bool = True
+
+
+@dataclass(frozen=True)
 class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     encoder: EncoderConfig = field(default_factory=EncoderConfig)
@@ -705,6 +744,7 @@ class Config:
     retrieval_quality: RetrievalQualityConfig = field(
         default_factory=RetrievalQualityConfig
     )
+    qos: QoSConfig = field(default_factory=QoSConfig)
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

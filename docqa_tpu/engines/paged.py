@@ -301,6 +301,18 @@ class BlockAllocator:
         with self._lock:
             return self._refs[int(block)]
 
+    def reclaimable(self, table: BlockTable) -> int:
+        """Blocks releasing ``table`` would actually return to the free
+        list RIGHT NOW (refcount 1 — not also pinned by the prefix cache
+        or another sharer).  The QoS preemption policy (docqa-qos) ranks
+        victims by this, not by ``len(blocks)``: evicting a lane whose
+        blocks are mostly shared prefix frees almost nothing.  One lock
+        hold so the count is coherent against a concurrent release."""
+        with self._lock:
+            if table.released:
+                return 0
+            return sum(1 for b in table.blocks if self._refs[b] == 1)
+
     def block_seconds(self) -> Dict[str, float]:
         """The pool's block-second ledger (docqa-costscope): ``total``
         is ∫ blocks_in_use dt since construction, ``billed`` the sum of

@@ -63,9 +63,11 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from docqa_tpu.engines.qos import QoSPolicy, request_class
 from docqa_tpu.engines.serve import (
     DEFAULT_RESULT_TIMEOUT,
     ContinuousBatcher,
+    DeferredByPolicy,
     Draining,
     Handle,
     QueueFull,
@@ -306,6 +308,7 @@ class EnginePool:
         affinity_max_queue_delta: Optional[int] = None,
         breaker_failure_threshold: int = 3,
         breaker_reset_s: float = 10.0,
+        qos=None,  # config.QoSConfig | qos.QoSPolicy | None (FIFO pool)
     ) -> None:
         def pick(override, field, default):
             if override is not None:
@@ -377,6 +380,14 @@ class EnginePool:
             )
             for i in range(self.n_replicas)
         ]
+        # ---- multi-tenant QoS (docqa-qos) ----
+        # the raw config threads to every replica batcher (weighted-fair
+        # queues + preemption live there); the coerced policy drives the
+        # pool's OWN dispatch-time deferral check, so a deferral is one
+        # decision at the front door, not one per refusing replica
+        self._qos_cfg = qos
+        self.qos: Optional[QoSPolicy] = QoSPolicy.coerce(qos)
+        self._slo_probe = None
         # ONE compiled program set for the whole pool (see _build_replica)
         self._programs = None
         self._replicas: List[_Replica] = [
@@ -404,10 +415,21 @@ class EnginePool:
             # rebuilt replica must not replay its predecessor's keys
             seed=self._seed + 1009 * idx + 7 * generation,
             max_queue=self.max_queue,
+            qos=self._qos_cfg,
         )
         batcher.on_worker_death = (
             lambda b, queued, _i=idx: self._on_worker_death(_i, b, queued)
         )
+        # preemption victims ride the SAME requeue/rescue machinery as
+        # failover: deadline-aware, hop-bounded, parking as fallback —
+        # a victim may land on a replica with free blocks right now
+        batcher.on_preempt = (
+            lambda b, req, _i=idx: self._requeue(req, from_idx=_i)
+        )
+        if self._slo_probe is not None:
+            # rebuilds must re-inherit the burn probe (a fresh batcher
+            # defaults to None — deferral would silently die with it)
+            batcher.set_slo_probe(self._slo_probe)
         # Share ONE compiled program set across replicas AND rebuild
         # generations: every replica has identical (n_slots, chunk,
         # cache_len, spec_k) over the same engine, so the jit programs
@@ -668,6 +690,33 @@ class EnginePool:
         # sheds: the flag keeps a refusing batcher from retiring the
         # cost record a later replica will keep accruing to
         req.pool_managed = True
+        # SLO-aware self-protection: while the /ask burn-rate alert
+        # fires, batch-class work is deferred HERE — once, at pool
+        # dispatch (replicas skip the check for pool_managed requests
+        # so a deferral can't double-count as the request hops).
+        # Typed DeferredByPolicy (a QueueFull subclass: same 503
+        # surface) so callers can tell policy from genuine capacity.
+        if self.qos is not None and not getattr(req, "hops", 0):
+            cls = request_class(req)
+            firing = self._slo_firing()
+            if self.qos.should_defer(cls, firing):
+                DEFAULT_REGISTRY.counter("qos_deferred").inc()
+                DEFAULT_REGISTRY.counter(f"qos_deferred_{cls}").inc()
+                _req_mark(
+                    req, "qos_deferred", stage="pool_dispatch",
+                    firing=",".join(firing),
+                )
+                DEFAULT_COST_LEDGER.record_shed(
+                    "deferred_by_policy", cls=cls, stage="pool_dispatch",
+                    firing=",".join(firing),
+                )
+                if req.cost is not None:
+                    DEFAULT_COST_LEDGER.retire(req.cost, "shed_deferred")
+                raise DeferredByPolicy(
+                    f"{cls} deferred while SLO burn active: {firing}",
+                    n_queued=self.n_queued,
+                    n_active=self.n_active,
+                )
         placed, n_full, n_candidates = self._try_place(req, exclude)
         if placed is not None:
             placed.routed += 1
@@ -1315,10 +1364,71 @@ class EnginePool:
             )["queued"] += 1
         return out
 
+    def set_slo_probe(self, probe) -> None:
+        """Wire the SLO burn-rate probe (callable -> list of firing
+        alert names) into the pool and every current replica; rebuilds
+        re-inherit it via _build_replica."""
+        self._slo_probe = probe
+        for r in self._replicas:
+            try:
+                r.batcher.set_slo_probe(probe)
+            except Exception:
+                pass
+
+    def _slo_firing(self):
+        if self._slo_probe is None:
+            return []
+        try:
+            return list(self._slo_probe())
+        except Exception:
+            return []
+
+    def preemption_candidates(
+        self, pressure_cls: str = "interactive"
+    ) -> List[Dict[str, Any]]:
+        """Pool-wide dry-run victim list: what KV preemption WOULD
+        evict if a `pressure_cls` request hit block exhaustion right
+        now.  Works in every preemption mode (including off) so
+        operators can rehearse the policy before enabling it."""
+        out: List[Dict[str, Any]] = []
+        for r in self._replicas:
+            fn = getattr(r.batcher, "preemption_candidates", None)
+            if fn is None:
+                continue
+            try:
+                for row in fn(pressure_cls):
+                    out.append({"replica": r.idx, **row})
+            except Exception:
+                continue
+        return out
+
+    def qos_status(self) -> Dict[str, Any]:
+        """Aggregate QoS policy state: config + live burn/deferral
+        view plus per-replica queue depths by class."""
+        if self.qos is None:
+            return {"enabled": False}
+        firing = self._slo_firing()
+        out = self.qos.status()
+        out["slo_firing"] = firing
+        out["defer_active"] = self.qos.should_defer("batch", firing)
+        queued: Dict[str, int] = {}
+        for r in self._replicas:
+            st = getattr(r.batcher, "qos_status", None)
+            if st is None:
+                continue
+            try:
+                for cls, n in st().get("queued_by_class", {}).items():
+                    queued[cls] = queued.get(cls, 0) + n
+            except Exception:
+                continue
+        out["queued_by_class"] = queued
+        return out
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             parked = len(self._pending)
         return {
+            "qos": self.qos_status(),
             "replicas": [
                 {
                     "replica": r.idx,

@@ -99,6 +99,7 @@ from docqa_tpu.engines.paged import (
     share_alignment,
 )
 from docqa_tpu.engines.generate import accept_drafts, draft_tokens
+from docqa_tpu.engines.qos import QoSPolicy, request_class
 from docqa_tpu.engines.spine import spine_run, spine_submit
 from docqa_tpu.models.decoder import (
     init_decoder_params,  # noqa: F401  (re-export convenience for tests)
@@ -234,6 +235,11 @@ def _cost_outcome(req: _Request) -> str:
         return "shed_block_pool"
     if isinstance(e, SpineSaturated):
         return "shed_spine"
+    if isinstance(e, DeferredByPolicy):
+        # checked before the QueueFull catch-all it subclasses: a QoS
+        # deferral is a policy choice, not a capacity shed, and the
+        # per-class ledger must keep them distinguishable
+        return "shed_deferred"
     if isinstance(e, QueueFull):
         return "shed_queue"
     if isinstance(e, RequestCancelled):
@@ -465,6 +471,20 @@ class BlockPoolExhausted(QueueFull):
     ``block_pool_exhausted`` trace event marking why they waited."""
 
 
+class DeferredByPolicy(QueueFull):
+    """QoS self-protection (docqa-qos): batch-class admission deferred
+    because an interactive SLO is burning (obs/slo.py burn-rate
+    evaluator — the /ask p95 or availability burn; see
+    ``qos.DEFER_SLOS``).  A :class:`QueueFull` subclass so every
+    existing 503 mapping and retry policy holds — to a batch client a
+    deferral IS transient overload: retry after the burn clears.  Typed
+    distinctly because the operator story differs: the queue may be
+    nearly EMPTY when this is raised — the runtime is choosing to keep
+    it that way for interactive traffic, and relaxes automatically (the
+    SLO probe is consulted per submission, so no un-defer edge exists
+    to miss).  Ledger outcome ``shed_deferred``, never ``shed_queue``."""
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching over a ``GenerateEngine``'s model."""
 
@@ -479,6 +499,7 @@ class ContinuousBatcher:
         kv_block_size: Optional[int] = None,
         kv_pool_tokens: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
+        qos=None,  # config.QoSConfig | qos.QoSPolicy | None (FIFO)
     ) -> None:
         self.engine = engine
         self.cfg = engine.cfg
@@ -602,7 +623,25 @@ class ContinuousBatcher:
         # episode, not per worker poll (guarded by _cv like the queue)
         self._block_wait_marked: Optional[int] = None
 
-        self._queue: collections.deque = collections.deque()
+        # ---- multi-tenant QoS (docqa-qos; engines/qos.py) ----
+        # With a policy, the admission queue is per-class weighted-fair
+        # (same deque surface, so every sweep below is policy-blind);
+        # without one (qos=None) it is the plain FIFO deque — bit-for-
+        # bit the pre-QoS batcher, which the bench A/Bs against.
+        self._qos: Optional[QoSPolicy] = QoSPolicy.coerce(qos)
+        if self._qos is not None:
+            self._queue: Any = self._qos.make_queue(now_fn=_now)
+        else:
+            self._queue = collections.deque()
+        # burn-rate probe (obs/slo.BurnRateEvaluator.firing, wired by
+        # the service layer): () -> list of firing SLO names.  Consulted
+        # per submission — deferral relaxes the instant the burn clears.
+        self._slo_probe = None
+        # pool hook: called (from the worker thread, outside _cv) with
+        # (batcher, victim_request) when a preemption needs a requeue;
+        # returns True when the pool placed/parked/typed-failed it —
+        # False (or no hook) requeues locally at the victim's class head.
+        self.on_preempt = None
         self._cv = threading.Condition()
         self._stopped = False
         # requests popped from the queue but not yet slot-resident (the
@@ -1219,6 +1258,34 @@ class ContinuousBatcher:
                     n_queued=len(self._queue),
                     n_active=sum(1 for r in self._slot_req if r is not None),
                 )
+            if not req.pool_managed and self._qos is not None:
+                # SLO-aware self-protection (docqa-qos): while an
+                # interactive SLO burns, batch-class admission defers
+                # typed.  Pool-managed requests skip this — the pool
+                # already ran the same check once at dispatch, and a
+                # per-replica re-check would turn one deferral decision
+                # into N (inflating counters and double-retiring costs).
+                cls = request_class(req)
+                firing = self._slo_firing()
+                if self._qos.should_defer(cls, firing):
+                    DEFAULT_REGISTRY.counter("qos_deferred").inc()
+                    DEFAULT_REGISTRY.counter(f"qos_deferred_{cls}").inc()
+                    _req_mark(
+                        req, "qos_deferred", stage="serve_submit",
+                        firing=",".join(firing),
+                    )
+                    self._record_shed(
+                        req, "deferred_by_policy", outcome="shed_deferred",
+                        stage="serve_submit", firing=",".join(firing),
+                    )
+                    raise DeferredByPolicy(
+                        "batch admission deferred: interactive SLO "
+                        f"burning ({', '.join(firing)})",
+                        n_queued=len(self._queue),
+                        n_active=sum(
+                            1 for r in self._slot_req if r is not None
+                        ),
+                    )
             if (
                 self.max_queue is not None
                 and len(self._queue) >= self.max_queue
@@ -1629,7 +1696,221 @@ class ContinuousBatcher:
             )
         return out
 
-    def _record_shed(self, req: "_Request", kind: str, **attrs) -> None:
+    # ---- multi-tenant QoS (docqa-qos) ----------------------------------------
+
+    def set_slo_probe(self, probe) -> None:
+        """Wire the burn-rate probe (``BurnRateEvaluator.firing``) that
+        drives batch-class deferral.  Safe to call any time; None
+        disables deferral (preemption and weighted-fair are probe-free)."""
+        self._slo_probe = probe
+
+    def _slo_firing(self) -> List[str]:
+        probe = self._slo_probe
+        if probe is None:
+            return []
+        try:
+            return list(probe() or [])
+        except Exception:
+            # a broken probe must never take admission down with it
+            return []
+
+    def qos_status(self) -> Dict[str, Any]:
+        """Policy state for /api/status: mode, weights, live deferral,
+        and per-class queue depths.  Lock-free snapshot like
+        ``pressure_by_class``."""
+        if self._qos is None:
+            return {"enabled": False}
+        out: Dict[str, Any] = {"enabled": True}
+        out.update(self._qos.status())
+        firing = self._slo_firing()
+        out["slo_firing"] = firing
+        out["defer_active"] = self._qos.should_defer("batch", firing)
+        depths = getattr(self._queue, "depths", None)
+        if depths is not None:
+            out["queued_by_class"] = depths()
+        return out
+
+    def _holders_snapshot(
+        self, exclude_slot: Optional[int] = None
+    ) -> List[Tuple[int, str, int]]:
+        """(slot, class, reclaimable_blocks) for every live lane — the
+        victim-selection input.  Worker-thread accurate; merely
+        advisory from other threads (preemption_candidates)."""
+        out = []
+        for slot in range(self.n_slots):
+            if slot == exclude_slot:
+                continue
+            req = self._slot_req[slot]
+            table = self._slot_table[slot]
+            if req is None or table is None:
+                continue
+            out.append(
+                (slot, request_class(req), self._alloc.reclaimable(table))
+            )
+        return out
+
+    def preemption_candidates(
+        self, pressure_cls: str = "interactive"
+    ) -> List[Dict[str, Any]]:
+        """What the preemption policy WOULD evict for ``pressure_cls``
+        pressure, in eviction order — the operator dry-run surface
+        (rides the shed-forensics pressure snapshot onto
+        /api/costs/sheds).  Works in every mode including ``off``:
+        candidates are how an operator decides whether to turn the
+        policy on.  Lock-free by the pressure-probe contract."""
+        if self._qos is None:
+            return []
+        victims = QoSPolicy.order_victims(
+            self._holders_snapshot(), pressure_cls
+        )
+        return [
+            {"slot": s, "class": c, "reclaimable_blocks": r}
+            for s, c, r in victims
+        ]
+
+    def _preempt_slot(
+        self, slot: int, pressure_cls: str
+    ) -> Optional["_Request"]:
+        """Evict one victim lane's KV blocks (worker thread only; does
+        not touch ``_cv``).  Releases and BILLS the held block-seconds
+        exactly (the same late-add path a retirement uses), then bills
+        the identical amount to the ``preempted_block_seconds`` ledger
+        line — the wasted-work annotation; ``kv_block_seconds`` keeps
+        the accounting identity, the preempted line names the waste.
+
+        Returns the victim for the caller to requeue (its generated
+        tokens stay on the request for token-preserving re-prefill), or
+        None when the victim's deadline cannot survive a second prefill
+        — then it degrades typed here instead of bouncing to a
+        guaranteed deadline shed."""
+        req = self._slot_req[slot]
+        table = self._slot_table[slot]
+        self._slot_req[slot] = None
+        cls = request_class(req)
+        was_released = table.released if table is not None else True
+        self._release_slot_blocks(slot, req=req)
+        if table is not None and not was_released:
+            _cost_add(
+                req, "preempted_block_seconds", table.billed_block_seconds
+            )
+        # device-side lane deactivation rides the next device work item
+        # (the worker never issues device ops from its own thread)
+        self._deact_pending.append(slot)
+        DEFAULT_REGISTRY.counter("qos_preempted").inc()
+        DEFAULT_REGISTRY.counter(f"qos_preempted_{cls}").inc()
+        _req_mark(
+            req, "pool_preempted", slot=slot,
+            pressure_class=pressure_cls,
+            tokens_so_far=len(req.tokens),
+        )
+        if req.deadline is not None and (
+            req.deadline.expired
+            or req.deadline.remaining() < self._qos.preempt_min_resume_s
+        ):
+            req.error = BlockPoolExhausted(
+                f"preempted by {pressure_cls} pressure with too little "
+                "deadline budget left to re-prefill",
+                n_active=self.n_active,
+            )
+            DEFAULT_REGISTRY.counter("serve_block_shed").inc()
+            DEFAULT_COST_LEDGER.record_shed(
+                "preempted", cls=cls, stage="serve_preempt",
+                pressure_class=pressure_cls,
+            )
+            _finish(req)
+            return None
+        return req
+
+    def _requeue_preempted(self, victim: "_Request") -> None:
+        """Requeue a preemption victim: the pool's requeue/rescue
+        machinery first (it may place the victim on a replica with free
+        blocks RIGHT NOW, and it owns hop/park bookkeeping), local
+        class-head requeue as the fallback.  Called OUTSIDE ``_cv`` —
+        the pool hook takes the pool lock and other replicas' ``_cv``s,
+        and nesting those under ours would order locks across
+        batchers."""
+        cb = self.on_preempt
+        if cb is not None:
+            try:
+                if cb(self, victim):
+                    return
+            except Exception:
+                log.exception("on_preempt hook failed; requeueing locally")
+        with self._cv:
+            victim.t_queue = _now()
+            self._queue.appendleft(victim)
+            self._cv.notify_all()
+
+    def _admission_preempt(
+        self, head: "_Request", planned: int, need: int,
+        requeue_out: List["_Request"],
+    ) -> int:
+        """Admission-side preemption (caller holds ``_cv``): evict
+        lower-ranked lanes until ``planned + need`` blocks fit, after
+        the prefix-cache valve failed and before the head is left
+        block-starved.  Victims go into ``requeue_out`` — the caller
+        requeues them AFTER it pops the head, so the head peek the
+        block-planning was computed against stays the next pop.
+        Returns the head's re-estimated block need (eviction may have
+        freed the head's own cached prefix, staling the old peek
+        discount).  Advisory mode only counts; ``off`` was gated by the
+        caller."""
+        cls = request_class(head)
+        victims = QoSPolicy.order_victims(self._holders_snapshot(), cls)
+        if not victims:
+            return need
+        if self._qos.preemption == "advisory":
+            if self._block_wait_marked != id(head):
+                # once per starvation episode, like the wait mark below
+                DEFAULT_REGISTRY.counter("qos_preempt_advisory").inc()
+                _req_mark(
+                    head, "qos_preempt_advisory", anomalous=False,
+                    candidates=[s for s, _c, _r in victims],
+                )
+            return need
+        for slot, _vcls, _reclaim in victims:
+            if self._alloc.can_alloc(planned + need):
+                break
+            victim = self._preempt_slot(slot, cls)
+            if victim is not None:
+                requeue_out.append(victim)
+            need = self._blocks_for_admission(head)
+        return need
+
+    def _grow_preempt(self, slot: int, req: "_Request", table, target) -> bool:
+        """Mid-decode preemption (worker thread, outside ``_cv``): a
+        live lane that cannot grow evicts lower-ranked lanes before it
+        sheds itself.  Evicts one victim at a time, retrying the grow
+        after each — stale in-flight writes to the freed blocks are
+        safe by the same device-sequencing argument admission re-use
+        relies on (the chunk that still maps them was dispatched
+        earlier on the chained pool state, and the grown lane never
+        reads a row it has not yet written).  Returns True when the
+        grow succeeded."""
+        if self._qos is None or self._qos.preemption != "on":
+            return False
+        victims = QoSPolicy.order_victims(
+            self._holders_snapshot(exclude_slot=slot), request_class(req)
+        )
+        for vslot, _vcls, _reclaim in victims:
+            victim = self._preempt_slot(vslot, request_class(req))
+            if victim is not None:
+                self._requeue_preempted(victim)
+            try:
+                table.ensure(target)
+            except OutOfBlocks:
+                continue
+            row = self._block_rows[slot]
+            row[: len(table.blocks)] = table.blocks
+            self._caps_np[slot] = table.capacity
+            self._tables_dirty = True
+            return True
+        return False
+
+    def _record_shed(
+        self, req: "_Request", kind: str,
+        outcome: Optional[str] = None, **attrs,
+    ) -> None:
         """Shed forensics + terminal cost retirement for a request this
         batcher refuses at submit.  POOL-MANAGED requests skip BOTH: a
         single replica's refusal is a routing decision the pool may
@@ -1648,9 +1929,12 @@ class ContinuousBatcher:
         if req.cost is not None:
             DEFAULT_COST_LEDGER.retire(
                 req.cost,
-                "shed_block_pool"
-                if kind == "block_pool_exhausted"
-                else "shed_queue",
+                outcome
+                or (
+                    "shed_block_pool"
+                    if kind == "block_pool_exhausted"
+                    else "shed_queue"
+                ),
             )
 
     # ---- worker loop ---------------------------------------------------------
@@ -1702,9 +1986,16 @@ class ContinuousBatcher:
                 _finish(req)
                 continue
             try:
-                ids = [int(t) for t in req.prompt_ids][-usable:] or [
-                    self.gen.pad_id
-                ]
+                # token-preserving re-prefill (docqa-qos): a preemption
+                # victim re-admits with its generated-so-far tokens
+                # appended to the prompt, so the prefill's sampled
+                # "first" token is exactly the NEXT greedy continuation
+                # and the handle's token stream never rewinds.  Fresh
+                # requests have no tokens — this is the old expression.
+                ids = (
+                    [int(t) for t in req.prompt_ids]
+                    + [int(t) for t in req.tokens]
+                )[-usable:] or [self.gen.pad_id]
             except (TypeError, ValueError) as e:  # bad request; fail it alone
                 req.error = e
                 _finish(req)
@@ -1789,10 +2080,22 @@ class ContinuousBatcher:
         # tables along with everything else (exactly-once accounting).
         for slot, req, ids, table, _shared in good:
             n_ids = len(ids)
-            budget = min(req.max_new, self.cache_len - n_ids - 1 - self.spec_k)
+            # resumed (preempted) requests folded generated tokens into
+            # ids: the retire check compares len(req.tokens) — which
+            # still counts them — against this budget, so they must be
+            # added back or a resumed request retires short of its
+            # max_new (the capacity term already charges them via n_ids)
+            resumed = min(len(req.tokens), n_ids)
+            budget = resumed + min(
+                req.max_new - resumed,
+                self.cache_len - n_ids - 1 - self.spec_k,
+            )
             self._slot_req[slot] = req
             self._slot_budget[slot] = budget
-            self._slot_prompt[slot] = n_ids
+            # subtract resumed tokens so _slot_prompt + len(req.tokens)
+            # stays the lane's exact KV length (grow estimates and the
+            # occupancy gauges depend on that identity)
+            self._slot_prompt[slot] = n_ids - resumed
             self._slot_table[slot] = table
             row = self._block_rows[slot]
             row[:] = self.n_blocks
@@ -2297,13 +2600,20 @@ class ContinuousBatcher:
         admissions cost the pool only their novel suffix, which is what
         lets a repeat-heavy mix admit deeper into the same HBM)."""
         usable = self.cache_len - 2 - self.spec_k
-        n_ids = max(1, min(len(req.prompt_ids), usable))
+        # + generated-so-far: a preemption victim re-prefills its tokens
+        # too (token-preserving resume), so its block need grows with it
+        n_ids = max(
+            1, min(len(req.prompt_ids) + len(req.tokens), usable)
+        )
         total = self._alloc.blocks_for(
             min(n_ids + self._grow_margin, self.seq_capacity)
         )
         if self._prefix_cache is not None and req.prefix_key is not None:
             try:
-                ids = [int(t) for t in req.prompt_ids][-usable:]
+                ids = (
+                    [int(t) for t in req.prompt_ids]
+                    + [int(t) for t in req.tokens]
+                )[-usable:]
             except (TypeError, ValueError):
                 return total  # bad request: _admit_round fails it alone
             shared = self._prefix_cache.peek(req.prefix_key, ids)
@@ -2330,6 +2640,10 @@ class ContinuousBatcher:
         # for earlier picks in the same round)
         planned = sum(self._blocks_for_admission(r) for _, r in pairs)
         blocked = False
+        # preemption victims buffered for requeue AFTER the fill: they
+        # must not enter the queue while the head the block plan was
+        # computed against is still peeked (docqa-qos)
+        preempted_back: List[_Request] = []
         for slot in range(self.n_slots):
             if blocked or self._slot_req[slot] is not None or slot in taken:
                 continue
@@ -2356,6 +2670,20 @@ class ContinuousBatcher:
                     # OutOfBlocks in _admit_round.
                     if self._prefix_cache.evict_for(planned + need):
                         need = self._blocks_for_admission(head)
+                if (
+                    head_live
+                    and self._qos is not None
+                    and self._qos.preemption != "off"
+                    and not self._alloc.can_alloc(planned + need)
+                ):
+                    # KV preemption (docqa-qos): after the prefix-cache
+                    # valve gave back idle HBM, before the head is left
+                    # block-starved — a higher-ranked head may evict
+                    # lower-ranked LIVE lanes.  Advisory mode only
+                    # counts what it would have done.
+                    need = self._admission_preempt(
+                        head, planned, need, preempted_back
+                    )
                 if head_live and not self._alloc.can_alloc(
                     planned + need
                 ):
@@ -2429,6 +2757,16 @@ class ContinuousBatcher:
                 filled = True
             if not self._queue and not filled:
                 break
+        for victim in preempted_back:
+            # requeued at their class head with tokens preserved: the
+            # next admission re-prefills prompt + generated-so-far and
+            # decoding continues exactly where it stopped (greedy).
+            # Local requeue by design — the caller holds _cv, and the
+            # pool's requeue hook takes locks that must never nest
+            # under it; the mid-decode path (outside _cv) does offer
+            # victims to the pool first.
+            victim.t_queue = _now()
+            self._queue.appendleft(victim)
         # pairs are now this round's in-flight admissions (cumulative
         # across the pipeline-drain top-up call); the worker clears the
         # count once _admit_round has made them slot-resident
@@ -2602,6 +2940,7 @@ class ContinuousBatcher:
                 if table.capacity >= target:
                     continue
                 try:
+                    freed = 0
                     try:
                         table.ensure(target)
                     except OutOfBlocks:
@@ -2609,16 +2948,30 @@ class ContinuousBatcher:
                             raise
                         # a live lane beats a cached idle prefix: evict
                         # LRU pins and retry once before shedding typed
-                        self._prefix_cache.evict_for(
+                        freed = self._prefix_cache.evict_for(
                             self._alloc.blocks_for(target)
                             - len(table.blocks)
                         )
-                        table.ensure(target)
+                        try:
+                            table.ensure(target)
+                        except OutOfBlocks:
+                            if not freed:
+                                raise
+                            # the valve DID evict between the attempts,
+                            # but a concurrent release/alloc raced the
+                            # retry: one more try before degrading a
+                            # live lane whose pressure freed real HBM
+                            table.ensure(target)
                     row = self._block_rows[slot]
                     row[: len(table.blocks)] = table.blocks
                     self._caps_np[slot] = table.capacity
                     self._tables_dirty = True
                 except OutOfBlocks:
+                    if self._grow_preempt(slot, req, table, target):
+                        # a lower-ranked lane gave up its blocks and
+                        # requeued (tokens preserved); this lane decodes
+                        # on — preemption before any shed (docqa-qos)
+                        continue
                     with self._cv:
                         n_queued = len(self._queue)
                     req.error = BlockPoolExhausted(

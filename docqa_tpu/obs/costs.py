@@ -79,6 +79,11 @@ COST_FIELDS = (
     "other_device_ms",        # traced spine items outside the buckets
     "flops_est",              # observatory cost-model attribution
     "kv_block_seconds",       # paged-KV time integral (engines/paged.py)
+    # block-seconds a QoS preemption threw away (docqa-qos): the
+    # victim's holding up to eviction, ALSO billed under
+    # kv_block_seconds (the identity stays exact) — this line names
+    # the waste so operators can price the policy
+    "preempted_block_seconds",
 )
 
 # fields whose per-class cumulative sums ride the metrics registry as
@@ -94,6 +99,7 @@ _COUNTER_FIELDS = (
     "retrieve_device_ms",
     "kv_block_seconds",
     "flops_est",
+    "preempted_block_seconds",  # mints cost_preempted_block_seconds_<cls>
 )
 
 _DEVICE_FIELDS = (
@@ -105,7 +111,14 @@ _DEVICE_FIELDS = (
 )
 
 SHED_OUTCOMES = frozenset(
-    {"shed_deadline", "shed_queue", "shed_block_pool", "shed_spine"}
+    {
+        "shed_deadline", "shed_queue", "shed_block_pool", "shed_spine",
+        # QoS batch deferral (serve.DeferredByPolicy, docqa-qos): a
+        # policy choice, not a capacity shed — kept distinguishable so
+        # "how much batch did self-protection turn away" is a ledger
+        # query, not a log grep
+        "shed_deferred",
+    }
 )
 
 

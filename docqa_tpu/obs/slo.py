@@ -346,3 +346,17 @@ class BurnRateEvaluator:
             return [
                 name for name, st in self._states.items() if st.firing
             ]
+
+    def any_firing(self, *names: str) -> bool:
+        """True if any of the named SLOs is currently burning (all SLOs
+        when called with no names).  Convenience for policy hooks —
+        e.g. the QoS layer's batch-deferral check — that gate on a
+        subset of alerts without list plumbing."""
+        with self._lock:
+            if not names:
+                return any(st.firing for st in self._states.values())
+            return any(
+                st.firing
+                for name, st in self._states.items()
+                if name in names
+            )

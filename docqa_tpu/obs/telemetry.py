@@ -662,6 +662,21 @@ class TelemetrySampler:
             ):
                 if key in occ:
                     rec(f"serve_kv_{key}", float(occ[key]), now=now)
+        qos_status = getattr(b, "qos_status", None)
+        if qos_status is not None:
+            # multi-tenant QoS (docqa-qos): live deferral flag + class
+            # queue depths as gauges; the qos_deferred_* /
+            # qos_preempted_* counters ride the registry scrape like
+            # every other counter
+            q = qos_status()
+            if q.get("enabled"):
+                rec(
+                    "qos_defer_active",
+                    1.0 if q.get("defer_active") else 0.0,
+                    now=now,
+                )
+                for cls, n in q.get("queued_by_class", {}).items():
+                    rec(f"qos_queued_{cls}", float(n), now=now)
         status = getattr(b, "status", None)
         if status is None:
             return

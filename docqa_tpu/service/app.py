@@ -277,7 +277,9 @@ class DocQARuntime:
         else:
             from docqa_tpu.engines.pool import EnginePool
 
-            self.batcher = EnginePool(self.generator, cfg=self.cfg.pool)
+            self.batcher = EnginePool(
+                self.generator, cfg=self.cfg.pool, qos=self.cfg.qos
+            )
         summarizer_cfg = self.cfg.summarizer
         instruction_prompts = True
         if (
@@ -569,6 +571,12 @@ class DocQARuntime:
                 registry=DEFAULT_REGISTRY,
                 recorder=obs.DEFAULT_RECORDER,
             )
+            # QoS self-protection closes its loop here: the burn-rate
+            # evaluator becomes the admission layer's deferral signal
+            # (batch-class sheds while ask_p95/availability burn)
+            probe = getattr(self.batcher, "set_slo_probe", None)
+            if probe is not None:
+                probe(self.slo.firing)
             self.sampler = obs.TelemetrySampler(
                 self.telemetry,
                 registry=DEFAULT_REGISTRY,
@@ -606,6 +614,15 @@ class DocQARuntime:
         probe = getattr(b, "pressure_by_class", None)
         if probe is not None:
             out = probe() or {}
+        # operator dry-run: what KV preemption WOULD evict for an
+        # interactive arrival right now (every mode, including off) —
+        # lets /api/costs/sheds forensics show the counterfactual
+        cand = getattr(b, "preemption_candidates", None)
+        if cand is not None:
+            try:
+                out["preemption_candidates"] = cand()
+            except Exception:
+                pass
         try:
             out["spine_queue_depth"] = self.spine.queue_depth
         except Exception:
@@ -880,6 +897,15 @@ def make_app(rt: DocQARuntime):
                 # is WHY /api/traces?anomalous=1 just grew — the
                 # evaluator flags the firing window's timelines
                 "slo": rt.slo.status() if rt.slo is not None else None,
+                # multi-tenant QoS policy state (docqa-qos): weights,
+                # preemption mode, live deferral flag, queue depths by
+                # class — "is the runtime protecting interactive right
+                # now, and at whose expense"
+                "qos": (
+                    rt.batcher.qos_status()
+                    if hasattr(rt.batcher, "qos_status")
+                    else None
+                ),
                 # device observatory (engines/spine.py + obs/
                 # observatory.py): spine queue/occupancy + per-stage
                 # device time with MFU/roofline where a cost model is

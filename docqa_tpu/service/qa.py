@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional
 from docqa_tpu import obs
 from docqa_tpu.engines.serve import (
     DEFAULT_RESULT_TIMEOUT,
+    DeferredByPolicy,
     QueueFull,
     WorkerDied,
 )
@@ -342,16 +343,24 @@ class QAService:
             return PendingAnswer(
                 sources=sources, answer=answer, chunks=chunks
             )
-        except QueueFull:
+        except QueueFull as e:
             # overload ≠ outage: the 503 + client retry is correct.  The
             # shed never reached the decoder — hand back any half-open
             # probe slot allow() reserved, or the breaker wedges.  The
             # cost record retires typed here (idempotent — the batcher/
             # pool shed path usually retired it already): a 503'd
-            # request must not leak an open record
+            # request must not leak an open record.  Policy deferrals
+            # (DeferredByPolicy, a QueueFull subclass) retire under
+            # their own outcome so operators can split "we were full"
+            # from "we chose to protect interactive".
             if breaker is not None:
                 breaker.release_probe()
-            obs.DEFAULT_COST_LEDGER.retire(cost, "shed_queue")
+            outcome = (
+                "shed_deferred"
+                if isinstance(e, DeferredByPolicy)
+                else "shed_queue"
+            )
+            obs.DEFAULT_COST_LEDGER.retire(cost, outcome)
             raise
         except DeadlineExceeded:
             if breaker is not None:

@@ -124,12 +124,13 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
     import threading
 
     from docqa_tpu import obs
-    from docqa_tpu.config import DecoderConfig, GenerateConfig
+    from docqa_tpu.config import DecoderConfig, GenerateConfig, QoSConfig
     from docqa_tpu.engines.generate import GenerateEngine
     from docqa_tpu.engines.pool import EnginePool
     from docqa_tpu.engines.serve import QueueFull, ResultTimeout, WorkerDied
     from docqa_tpu.resilience import FaultPlan, FaultRule
     from docqa_tpu.resilience.deadline import Deadline, DeadlineExceeded
+    from docqa_tpu.runtime.metrics import DEFAULT_REGISTRY
 
     engine = GenerateEngine(
         DecoderConfig(
@@ -137,7 +138,16 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
             num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=256,
             dtype="float32",
         ),
-        GenerateConfig(temperature=0.0, prefill_buckets=(16, 32), eos_id=2),
+        # kv_pool_tokens=256 overcommits each replica's block pool (one
+        # maximal request's worth for two slots of ~150-token prompts):
+        # mixed-class waves then hit BlockPoolExhausted pressure and the
+        # preemption=on policy below actually evicts — the zero-loss +
+        # exact-accounting sweeps cover preempt -> requeue -> rescue,
+        # not just crash/wedge/drain failover (docqa-qos)
+        GenerateConfig(
+            temperature=0.0, prefill_buckets=(16, 32), eos_id=2,
+            kv_pool_tokens=256,
+        ),
         seed=7,
     )
     pool = EnginePool(
@@ -145,6 +155,7 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
         replicas=2,
         n_slots=2,
         chunk=4,
+        qos=QoSConfig(preemption="on", aging_floor_s=2.0),
         # 256: large enough that the 128-aligned KV prefix cache is
         # ENABLED (docqa-prefix) — the chaos windows then exercise
         # refcounted shared blocks under crash/wedge/drain failover,
@@ -198,6 +209,10 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
                     max_new_tokens=6,
                     deadline=Deadline.after(deadline_s),
                     prefix_key=f"chaos-{pid}",
+                    # mixed-class traffic (docqa-qos): interactive
+                    # arrivals may preempt batch/background holders
+                    # under the overcommitted block pool
+                    req_class=("interactive", "batch", "background")[i % 3],
                 )
             except (QueueFull, DeadlineExceeded) as e:
                 with lock:
@@ -346,6 +361,28 @@ def replica_kill_chaos(seed: int, n_requests: int = 24) -> int:
         f"zero residual across {len(seen_batchers)} generation(s); "
         f"{len(shed_billed)} shed request(s) billed what they held"
     )
+    # preempt -> requeue -> rescue accounting (docqa-qos): every victim's
+    # held time was billed at eviction (the residual sweep above already
+    # proved zero), and the wasted portion is named on the preempted line
+    n_preempted = DEFAULT_REGISTRY.counter("qos_preempted").value
+    preempted_bs = sum(
+        r.cost.snapshot_fields().get("preempted_block_seconds", 0.0)
+        for r in tracked_reqs
+        if r.cost is not None
+    )
+    print(
+        f"qos preemption exercised: {n_preempted} eviction(s), "
+        f"{preempted_bs:.3f} preempted block-second(s) billed as waste "
+        "(zero-residual sweep covers preempt->requeue->rescue)"
+    )
+    if not n_preempted:
+        # not a failure (timing-dependent), but the run proved less
+        # than it should have — seed 7 normally evicts several times
+        print(
+            "WARNING: zero preemptions fired — the preempt->requeue->"
+            "rescue path went unexercised this run",
+            file=sys.stderr,
+        )
 
     hung = [o for o in outcomes if o[2] == "HUNG"]
     untyped = [o for o in outcomes if o[2] == "untyped"]

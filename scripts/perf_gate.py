@@ -199,6 +199,55 @@ def measure(
             sampler.stop()
         b.stop()
 
+    # multi-tenant QoS overload (docqa-qos): a batch long pins most of a
+    # deliberately overcommitted block pool, then a closed-loop stream of
+    # interactive shorts arrives with the policy ON — each one must evict
+    # the batch holder's KV (preemption) instead of queueing behind it.
+    # interactive_p95_under_overload is the protection headline (timing,
+    # wide band); qos_preempt_exercised is structural — the geometry
+    # guarantees collision, so 0 means the preemption path is broken.
+    from docqa_tpu.config import QoSConfig
+
+    bq = ContinuousBatcher(
+        engine, n_slots=3, chunk=8, cache_len=256, kv_block_size=16,
+        kv_pool_tokens=256, prefix_cache=False,
+        qos=QoSConfig(preemption="on"),
+    )
+    try:
+        bq.warmup(buckets=engine.gen.prefill_buckets[:1])
+        p0 = DEFAULT_REGISTRY.counter("qos_preempted").value
+        long_prompt = [(3 + i * 7) % 250 + 1 for i in range(144)]
+        h_batch = bq.submit_ids(
+            long_prompt, max_new_tokens=48, req_class="batch"
+        )
+        # let the long grow past 11 of the 16 blocks: a 96-token
+        # interactive then cannot fit without evicting it
+        t_dead = time.time() + 30
+        while time.time() < t_dead:
+            if (
+                bq.kv_block_occupancy()["blocks_used"] >= 11
+                or h_batch._req.done.is_set()
+            ):
+                break
+            time.sleep(0.005)
+        lat_q = []
+        for i in range(6):
+            short = [(5 + i * 3 + j * 11) % 250 + 1 for j in range(96)]
+            t0 = time.perf_counter()
+            bq.submit_ids(
+                short, max_new_tokens=8, req_class="interactive"
+            ).result(timeout=120)
+            lat_q.append((time.perf_counter() - t0) * 1e3)
+        h_batch.result(timeout=300)  # the victim must still retire fully
+        metrics["interactive_p95_under_overload"] = round(
+            float(np.percentile(lat_q, 95)), 1
+        )
+        metrics["qos_preempt_exercised"] = float(
+            DEFAULT_REGISTRY.counter("qos_preempted").value > p0
+        )
+    finally:
+        bq.stop()
+
     # exact retrieval p50 (batch 8 over 20k×64)
     rng = np.random.default_rng(0)
     vs = VectorStore(StoreConfig(dim=64, shard_capacity=32768))
@@ -557,6 +606,13 @@ def write_baseline(
         # the multi-device measure path
         "index_bytes_per_chunk": ("lower", 10),
         "retrieve_offmesh_fallback_total": ("lower", 0),
+        # multi-tenant QoS (docqa-qos): interactive p95 with a batch
+        # long pinning the overcommitted pool — a timing, so it gets
+        # the load_p95_ms band; the exercised flag is structural (the
+        # smoke's geometry guarantees a collision, so 0.0 means the
+        # preemption path regressed, never jitter)
+        "interactive_p95_under_overload": ("lower", 100),
+        "qos_preempt_exercised": ("higher", 0),
     }
     # context-only outputs (exact token counts, sample sizes) are for
     # humans reading the report, not latency budgets
