@@ -1,6 +1,6 @@
 """docqa-lint: AST invariant analysis for the docqa_tpu tree.
 
-Fourteen project-specific checkers (docs/STATIC_ANALYSIS.md):
+Seventeen project-specific checkers (docs/STATIC_ANALYSIS.md):
 
 * ``cv-protocol``     — condition waits in predicate loops, notify under
   the lock, request-path waits carry a Deadline.
@@ -21,8 +21,19 @@ Fourteen project-specific checkers (docs/STATIC_ANALYSIS.md):
   declared mesh; collectives stay inside their ``shard_map``.
 * ``phi-taint``       — raw pre-deid text never reaches logs/metrics/
   external payloads.
+* ``resource-flow``   — every acquired resource (KV block table, cost
+  record, spine ticket, trace) reaches exactly one release on every
+  control-flow path: leak-on-exception-edge, double-release, and
+  release-of-unacquired are findings.
+* ``retire-once``     — every request path hits exactly one retirement
+  site; terminal sites are ledgered in ``retirement_sites.json``
+  (stale entries fail).
 * ``retrace-hazard``  — jit wrappers are built once and reused; static
   arguments stay hashable and stable.
+* ``shed-taxonomy``   — every raise reachable from the request path is a
+  ledgered typed shed in ``shed_taxonomy.json`` carrying its declared
+  HTTP status, cost outcome, and trace flag; bare ``Exception`` raises
+  and subtype-swallowing catches are findings.
 * ``spec-shape``      — PartitionSpec arity matches the annotated rank.
 * ``thread-lifecycle``— every thread has a reachable join on its owner's
   stop/close path (daemon threads that can reach jax especially).
@@ -33,17 +44,23 @@ to the checked-in ``shard_budget.json`` — in
 ``analysis/compile_audit.py``: drive the canonical serving workloads
 under compile counting, AOT-measure each root's ``memory_analysis()``
 bytes, and hold both to ``compile_budget.json`` (zero steady-state
-retraces, per-root HBM ceilings) — and in ``analysis/race_witness.py``
+retraces, per-root HBM ceilings) — in ``analysis/race_witness.py``
 (docs/STATIC_ANALYSIS.md "Concurrency witness"): opt-in runtime
 instrumentation of lock acquisition whose witnessed order graph is
 cross-checked edge-for-edge against lock-discipline's static graph by
-the chaos/soak gates.
+the chaos/soak gates — and in ``analysis/ledger_audit.py``
+(docs/STATIC_ANALYSIS.md "Ledger witness"): opt-in runtime
+instrumentation of KV-table / cost-record lifecycle events whose
+witnessed acquire sites are cross-checked against resource-flow's
+static protocol table, failing on leaks, unretired records, or static
+blind spots.
 
 Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` /
-``scripts/compile_audit.py`` / ``scripts/serve_cluster_loop.py`` (CLIs)
-and ``pytest -m lint`` (tier-1 gate, tests/test_analysis.py,
-tests/test_numcheck.py, tests/test_shardcheck.py, tests/test_racecheck.py,
-tests/test_shard_audit.py, tests/test_compile_audit.py).
+``scripts/compile_audit.py`` / ``scripts/serve_cluster_loop.py`` /
+``scripts/ledger_audit.py`` (CLIs) and ``pytest -m lint`` (tier-1 gate,
+tests/test_analysis.py, tests/test_numcheck.py, tests/test_shardcheck.py,
+tests/test_racecheck.py, tests/test_shard_audit.py,
+tests/test_compile_audit.py, tests/test_lifecheck.py).
 """
 
 from docqa_tpu.analysis.core import (  # noqa: F401

@@ -497,7 +497,10 @@ def all_checkers() -> Dict[str, object]:
     from docqa_tpu.analysis.lock_discipline import LockDisciplineChecker
     from docqa_tpu.analysis.mesh_axes import MeshAxesChecker
     from docqa_tpu.analysis.phi_taint import PhiTaintChecker
+    from docqa_tpu.analysis.resource_flow import ResourceFlowChecker
+    from docqa_tpu.analysis.retire_once import RetireOnceChecker
     from docqa_tpu.analysis.retrace_hazard import RetraceHazardChecker
+    from docqa_tpu.analysis.shed_taxonomy import ShedTaxonomyChecker
     from docqa_tpu.analysis.spec_shape import SpecShapeChecker
     from docqa_tpu.analysis.thread_lifecycle import ThreadLifecycleChecker
 
@@ -513,7 +516,10 @@ def all_checkers() -> Dict[str, object]:
         LockDisciplineChecker(),
         MeshAxesChecker(),
         PhiTaintChecker(),
+        ResourceFlowChecker(),
+        RetireOnceChecker(),
         RetraceHazardChecker(),
+        ShedTaxonomyChecker(),
         SpecShapeChecker(),
         ThreadLifecycleChecker(),
     ]

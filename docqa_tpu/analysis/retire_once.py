@@ -1,0 +1,292 @@
+"""retire-once: request retirement happens at DECLARED sites, exactly once.
+
+Every admitted request must reach exactly one terminal retirement — the
+``serve._finish`` completion path, a typed shed's cost retirement, the
+pool's rescue/shed, or the trace-completion fallback.  PR 16's
+preempt-requeue bug class (victim retired twice, or error-stamped and
+never finished) motivates making the terminal surface a REVIEWED file:
+``retirement_sites.json`` declares every function allowed to invoke a
+retirement primitive, so a new terminal site is a ledger diff, not an
+accident.  Three sub-rules:
+
+1. **undeclared site** — a call to a retirement primitive (``_finish``,
+   or ``retire(...)`` on a cost-ledger receiver) outside a declared
+   site function is a finding.  The primitives themselves
+   (``serve._finish``, ``RequestCostLedger.retire``) are sites too —
+   the ledger names the whole terminal surface;
+2. **stale site** — a declared site whose function no longer contains a
+   retirement call fails, PR-3 style (the ledger only shrinks by
+   editing it deliberately).  Staleness fires only when the declaring
+   module is inside the analyzed package — the per-root gate
+   (docqa_tpu, then scripts) must not cross-report;
+3. **error-set-without-finish** — in any module that binds ``_finish``
+   (defines or imports it — i.e. participates in the request lifecycle),
+   a function that stamps ``<req>.error = ...`` must reach a terminal
+   call (``_finish``/``_retire``/``_fail_active``) later in its body, or
+   be declared in the ledger with kind ``error-setter`` (it stamps for
+   a caller who finishes).  An error-stamped request nobody finishes
+   strands its waiter to the result timeout AND leaks its cost record —
+   the exact double fault the dynamic ledger witness hunts.
+
+Double-retire on one straight-line path (two ``_finish(x)`` on the same
+request in one block) is flagged as well — the static face of the
+witness's double-release check.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Package,
+    call_name,
+    expr_text,
+)
+
+LEDGER_NAME = "retirement_sites.json"
+
+# call tails that terminally retire a request (reach _finish and the
+# cost-record retirement)
+_TERMINAL_TAILS = frozenset({"_finish", "_retire", "_fail_active"})
+
+
+def default_ledger_path() -> str:
+    """The checked-in ledger: ``<repo>/retirement_sites.json``."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_dir), LEDGER_NAME)
+
+
+def _package_ledger_path(package: Package) -> Optional[str]:
+    """Ledger next to the analyzed package's root (fixture trees carry
+    their own or none; the real runs resolve to the repo's)."""
+    for module in package.modules:
+        rel = module.relpath.replace("/", os.sep)
+        if module.path.endswith(rel):
+            base = module.path[: -len(rel)].rstrip(os.sep)
+            cand = os.path.join(os.path.dirname(base), LEDGER_NAME)
+            if os.path.exists(cand):
+                return cand
+            cand = os.path.join(base, LEDGER_NAME)
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def load_ledger(path: Optional[str]) -> Dict:
+    if not path or not os.path.exists(path):
+        return {"sites": {}}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    data.setdefault("sites", {})
+    return data
+
+
+def _is_retire_call(node: ast.Call) -> bool:
+    """A retirement-primitive call: ``_finish(req)`` (however imported)
+    or ``retire(...)`` on a cost-ledger receiver (``DEFAULT_COST_LEDGER.
+    retire``, ``obs.DEFAULT_COST_LEDGER.retire``, ``self._ledger.
+    retire``)."""
+    name = call_name(node)
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    if tail == "_finish":
+        return True
+    if tail == "retire":
+        receiver = name[: -len(".retire")] if "." in name else ""
+        return "ledger" in receiver.lower()
+    return False
+
+
+class RetireOnceChecker:
+    rule = "retire-once"
+
+    def __init__(self, ledger_path: Optional[str] = None):
+        self._ledger_path = ledger_path
+
+    def check(self, package: Package) -> List[Finding]:
+        path = (
+            self._ledger_path
+            or _package_ledger_path(package)
+            or default_ledger_path()
+        )
+        sites: Dict[str, Dict] = load_ledger(path).get("sites", {})
+        out: List[Finding] = []
+        # which functions actually contain a retirement call
+        retiring: Dict[str, FunctionInfo] = {}
+        for fn in package.functions:
+            key = f"{fn.module.name}:{fn.qualname}"
+            for node in self._own_calls(fn):
+                if _is_retire_call(node):
+                    retiring.setdefault(key, fn)
+                    if key not in sites:
+                        out.append(
+                            Finding(
+                                self.rule,
+                                fn.module.relpath,
+                                node.lineno,
+                                fn.qualname,
+                                f"undeclared retirement site {key} — "
+                                "terminal request retirement must be "
+                                "declared in retirement_sites.json",
+                            )
+                        )
+                    break
+        # stale declared sites (module in-package, function gone or no
+        # longer retiring)
+        module_names = {m.name for m in package.modules}
+        by_name = {m.name: m for m in package.modules}
+        for key in sorted(sites):
+            mod = key.split(":", 1)[0]
+            if mod not in module_names or key in retiring:
+                continue
+            if sites[key].get("kind") == "error-setter":
+                # error-setters stamp <req>.error for a caller to finish;
+                # they need not contain a retirement call themselves, but
+                # the function must still exist and still stamp
+                fn = self._find_fn(package, key)
+                if fn is not None and self._error_assigns(fn):
+                    continue
+            out.append(
+                Finding(
+                    self.rule,
+                    by_name[mod].relpath,
+                    1,
+                    "<ledger>",
+                    f"stale retirement_sites entry: {key} no longer "
+                    "contains a retirement call",
+                )
+            )
+        # error-set-without-finish + straight-line double retire
+        for fn in package.functions:
+            if not self._binds_finish(fn.module):
+                continue
+            out.extend(self._check_error_sets(fn, sites))
+            out.extend(self._check_double(fn))
+        return out
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _own_calls(fn: FunctionInfo):
+        stack = list(ast.iter_child_nodes(fn.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _find_fn(package: Package, key: str) -> Optional[FunctionInfo]:
+        mod, _, qual = key.partition(":")
+        for fn in package.functions:
+            if fn.module.name == mod and fn.qualname == qual:
+                return fn
+        return None
+
+    @staticmethod
+    def _binds_finish(module) -> bool:
+        """The module participates in the request lifecycle: it defines
+        or imports ``_finish``.  Everything else (spine items, broker
+        messages) has its own error fields and its own checkers."""
+        if "_finish" in module.imports:
+            return True
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "_finish"
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _error_assigns(fn: FunctionInfo) -> List[ast.Assign]:
+        out = []
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "error"
+                    and isinstance(t.value, ast.Name)
+                ):
+                    out.append(node)
+        return out
+
+    def _check_error_sets(
+        self, fn: FunctionInfo, sites: Dict[str, Dict]
+    ) -> List[Finding]:
+        assigns = self._error_assigns(fn)
+        if not assigns:
+            return []
+        key = f"{fn.module.name}:{fn.qualname}"
+        if sites.get(key, {}).get("kind") == "error-setter":
+            return []
+        terminal_lines = [
+            node.lineno
+            for node in self._own_calls(fn)
+            if (call_name(node).rsplit(".", 1)[-1] in _TERMINAL_TAILS)
+        ]
+        out: List[Finding] = []
+        for a in assigns:
+            if any(line >= a.lineno for line in terminal_lines):
+                continue
+            out.append(
+                Finding(
+                    self.rule,
+                    fn.module.relpath,
+                    a.lineno,
+                    fn.qualname,
+                    "request error stamped but no terminal call "
+                    "(_finish/_retire/_fail_active) follows — the waiter "
+                    "strands to its timeout and the cost record leaks "
+                    "(declare kind=error-setter in retirement_sites.json "
+                    "if a caller finishes it)",
+                )
+            )
+        return out
+
+    def _check_double(self, fn: FunctionInfo) -> List[Finding]:
+        """Two _finish calls on the SAME request in one straight-line
+        statement block: a guaranteed double-retire attempt (the ledger
+        absorbs it at runtime, but the code path is wrong)."""
+        out: List[Finding] = []
+        for node in ast.walk(fn.node):
+            body = getattr(node, "body", None)
+            if not isinstance(body, list):
+                continue
+            seen: Set[str] = set()
+            for stmt in body:
+                if not (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                ):
+                    continue
+                call = stmt.value
+                if call_name(call).rsplit(".", 1)[-1] != "_finish":
+                    continue
+                arg = expr_text(call.args[0]) if call.args else ""
+                sig = f"_finish({arg})"
+                if sig in seen:
+                    out.append(
+                        Finding(
+                            self.rule,
+                            fn.module.relpath,
+                            stmt.lineno,
+                            fn.qualname,
+                            f"{sig} called twice on one straight-line "
+                            "path — a request retires exactly once",
+                        )
+                    )
+                seen.add(sig)
+        return out

@@ -1,0 +1,405 @@
+"""shed-taxonomy: every request-path raise is a LEDGERED typed shed.
+
+The serving chain's failure surface is a closed taxonomy
+(``shed_taxonomy.json``): each shed class carries its declared HTTP
+status, cost-ledger outcome, and trace flag in ONE reviewed file — the
+same file docs/OPERATIONS.md renders and tests/test_serve_wiring.py
+exercises end-to-end.  Three sub-rules hold the tree to it:
+
+1. **unledgered raise** — every ``raise`` in a function reachable from
+   :data:`~docqa_tpu.analysis.deadline_flow.REQUEST_PATH_MODULES` (BFS
+   over the package call graph via the chassis' ``resolve_call``) must
+   name a ledgered class.  Bare ``Exception``/``RuntimeError``/
+   ``BaseException``/``TimeoutError`` raises are findings — an operator
+   cannot retry/alert on a generic error; validation builtins
+   (``ValueError``, ``TypeError``, ...) are programming-error raises,
+   not sheds, and pass.  Re-raises (``raise`` / ``raise e`` from an
+   except binding / ``raise x.error``) propagate an already-typed error
+   and pass; so does raising a helper call whose arguments name a
+   ledgered class (the ``raise self._shed(req, kind, QueueFull(...))``
+   idiom — the helper retires the cost record, the typed instance rides
+   through).
+2. **undeclared / stale taxonomy** — every package exception class whose
+   base chain reaches a ledgered class must itself be ledgered (a new
+   ``QueueFull`` subclass silently inherits a 503 mapping but NOT its
+   cost outcome — declaring it is the point), and every ledger entry
+   must still name a class defined in its declared module (stale
+   entries fail, PR-3 style).  Entries must carry ``http_status``,
+   ``cost_outcome``, and ``trace_flag``.
+3. **subtype swallow** — an ``except C`` handler on the request path
+   that catches a ledgered class whose ledgered SUBCLASS declares a
+   *different* HTTP status loses that subtype's contract (catch
+   ``TimeoutError`` and map it to one status while ``DeadlineExceeded``
+   is 504 and ``ResultTimeout`` degrades to 200) — unless an earlier
+   handler in the same try already caught the subtype, or the handler
+   re-raises.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Package,
+    call_name,
+    dotted_name,
+    stmt_walk,
+)
+from docqa_tpu.analysis.deadline_flow import REQUEST_PATH_MODULES
+
+LEDGER_NAME = "shed_taxonomy.json"
+
+# builtin raises that are programming-error/validation contracts, not
+# load sheds — an /ask caller never sees these as a typed 5xx story
+_VALIDATION_BUILTINS = frozenset(
+    {
+        "ValueError", "TypeError", "KeyError", "IndexError",
+        "AttributeError", "NotImplementedError", "AssertionError",
+        "StopIteration", "StopAsyncIteration", "FileNotFoundError",
+        "OSError", "IOError", "GeneratorExit", "KeyboardInterrupt",
+    }
+)
+
+# raising one of these bare is ALWAYS a finding on the request path:
+# the operator story ("retry? alert? page?") needs a taxonomy type
+_BARE_GENERICS = frozenset(
+    {"Exception", "RuntimeError", "BaseException", "TimeoutError"}
+)
+
+
+def default_ledger_path() -> str:
+    """The checked-in taxonomy: ``<repo>/shed_taxonomy.json``."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_dir), LEDGER_NAME)
+
+
+def _package_ledger_path(package: Package) -> Optional[str]:
+    """Ledger next to the analyzed package's root (fixture trees carry
+    their own or none; the real runs resolve to the repo's)."""
+    for module in package.modules:
+        rel = module.relpath.replace("/", os.sep)
+        if module.path.endswith(rel):
+            base = module.path[: -len(rel)].rstrip(os.sep)
+            cand = os.path.join(os.path.dirname(base), LEDGER_NAME)
+            if os.path.exists(cand):
+                return cand
+            cand = os.path.join(base, LEDGER_NAME)
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def load_ledger(path: Optional[str]) -> Dict:
+    if not path or not os.path.exists(path):
+        return {"sheds": {}}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    data.setdefault("sheds", {})
+    return data
+
+
+def request_path_functions(package: Package) -> Set[int]:
+    """id()s of every function reachable from a request-path module via
+    the chassis call resolution (BFS; unresolvable calls simply don't
+    extend the frontier — same no-guess contract as resolve_call)."""
+    reachable: Dict[int, FunctionInfo] = {}
+    frontier: List[FunctionInfo] = []
+    for fn in package.functions:
+        if (
+            fn.module.name in REQUEST_PATH_MODULES
+            or fn.module.request_path_pragma
+        ):
+            reachable[id(fn)] = fn
+            frontier.append(fn)
+    while frontier:
+        fn = frontier.pop()
+        for node in stmt_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = package.resolve_call(fn, node)
+            if callee is not None and id(callee) not in reachable:
+                reachable[id(callee)] = callee
+                frontier.append(callee)
+    return set(reachable)
+
+
+class ShedTaxonomyChecker:
+    rule = "shed-taxonomy"
+
+    def __init__(self, ledger_path: Optional[str] = None):
+        self._ledger_path = ledger_path
+
+    def check(self, package: Package) -> List[Finding]:
+        path = (
+            self._ledger_path
+            or _package_ledger_path(package)
+            or default_ledger_path()
+        )
+        ledger = load_ledger(path)
+        sheds: Dict[str, Dict] = ledger.get("sheds", {})
+        out: List[Finding] = []
+        class_defs = self._class_defs(package)
+        out.extend(self._check_ledger(package, sheds, class_defs))
+        out.extend(self._check_subclasses(sheds, class_defs))
+        reachable = request_path_functions(package)
+        for fn in package.functions:
+            if id(fn) not in reachable:
+                continue
+            out.extend(self._check_raises(fn, sheds))
+            out.extend(self._check_handlers(fn, sheds, class_defs))
+        return out
+
+    # -- ledger integrity -----------------------------------------------------
+
+    @staticmethod
+    def _class_defs(
+        package: Package,
+    ) -> Dict[str, Tuple[str, int, str, List[str]]]:
+        """name -> (module_name, lineno, relpath, base names)."""
+        defs: Dict[str, Tuple[str, int, str, List[str]]] = {}
+        for module in package.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = [
+                    dotted_name(b).rsplit(".", 1)[-1]
+                    for b in node.bases
+                    if dotted_name(b)
+                ]
+                defs[node.name] = (
+                    module.name, node.lineno, module.relpath, bases,
+                )
+        return defs
+
+    def _check_ledger(
+        self, package: Package, sheds: Dict[str, Dict], class_defs
+    ) -> List[Finding]:
+        """Stale entries (declared class gone from its module) and
+        malformed entries (missing status/outcome/flag).  Staleness only
+        fires when the declaring module is in THIS package — the gate
+        runs per-root (docqa_tpu, scripts) and the scripts pass must not
+        report the whole taxonomy stale."""
+        out: List[Finding] = []
+        module_names = {m.name for m in package.modules}
+        by_name = {m.name: m for m in package.modules}
+        for name, entry in sorted(sheds.items()):
+            declared_module = entry.get("module", "")
+            if declared_module not in module_names:
+                continue
+            module = by_name[declared_module]
+            defined = class_defs.get(name)
+            if defined is None or defined[0] != declared_module:
+                out.append(
+                    Finding(
+                        self.rule,
+                        module.relpath,
+                        1,
+                        "<ledger>",
+                        f"stale shed_taxonomy entry: class {name} is not "
+                        f"defined in {declared_module}",
+                    )
+                )
+                continue
+            missing = [
+                k
+                for k in ("http_status", "cost_outcome", "trace_flag")
+                if k not in entry
+            ]
+            if missing:
+                out.append(
+                    Finding(
+                        self.rule,
+                        defined[2],
+                        defined[1],
+                        name,
+                        f"shed_taxonomy entry for {name} is missing "
+                        f"{', '.join(missing)}",
+                    )
+                )
+        return out
+
+    def _ledger_bases(
+        self, name: str, sheds: Dict[str, Dict], class_defs
+    ) -> Set[str]:
+        """Transitive base-name closure of a class, through both the
+        package class defs and the ledger's declared bases."""
+        seen: Set[str] = set()
+        frontier = [name]
+        while frontier:
+            n = frontier.pop()
+            bases: List[str] = []
+            if n in class_defs:
+                bases.extend(class_defs[n][3])
+            if n in sheds:
+                bases.extend(sheds[n].get("bases", []))
+            for b in bases:
+                if b not in seen:
+                    seen.add(b)
+                    frontier.append(b)
+        return seen
+
+    def _check_subclasses(
+        self, sheds: Dict[str, Dict], class_defs
+    ) -> List[Finding]:
+        """A package class subclassing a ledgered shed must be ledgered
+        itself — subtypes inherit the except-site mapping but not the
+        declared outcome/flag, so every one is a taxonomy decision."""
+        out: List[Finding] = []
+        if not sheds:
+            return out
+        for name, (mod, lineno, relpath, _bases) in sorted(
+            class_defs.items()
+        ):
+            if name in sheds:
+                continue
+            chain = self._ledger_bases(name, sheds, class_defs)
+            hit = sorted(chain & set(sheds))
+            if hit:
+                out.append(
+                    Finding(
+                        self.rule,
+                        relpath,
+                        lineno,
+                        name,
+                        f"typed shed {name} (subclass of {hit[0]}) is not "
+                        "declared in shed_taxonomy.json",
+                    )
+                )
+        return out
+
+    # -- raise sites ----------------------------------------------------------
+
+    @staticmethod
+    def _raised_class(node: ast.Raise) -> Optional[str]:
+        """Syntactic class name of a raise, or None when the raised
+        value is dynamic (re-raised binding, stored error object,
+        lowercase helper call)."""
+        exc = node.exc
+        if exc is None:
+            return None  # bare re-raise
+        if isinstance(exc, ast.Call):
+            name = call_name(exc)
+        else:
+            name = dotted_name(exc)
+        if not name:
+            return None
+        tail = name.rsplit(".", 1)[-1]
+        if not tail or not tail[0].isupper():
+            return None  # helper call / variable — not a class name
+        return tail
+
+    def _check_raises(
+        self, fn: FunctionInfo, sheds: Dict[str, Dict]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        ledgered = set(sheds)
+        for node in stmt_walk(fn.node):
+            if not isinstance(node, ast.Raise):
+                continue
+            tail = self._raised_class(node)
+            if tail is None:
+                # dynamic raise: OK when any ledgered class name appears
+                # in the expression (the `raise self._shed(..., QueueFull
+                # (...))` idiom); a fully opaque expression is a re-raise
+                # of a stored/bound error and passes
+                continue
+            if tail in ledgered or tail in _VALIDATION_BUILTINS:
+                continue
+            if isinstance(node.exc, ast.Call):
+                arg_names = {
+                    n
+                    for a in list(node.exc.args)
+                    + [kw.value for kw in node.exc.keywords]
+                    for n in (
+                        dotted_name(c).rsplit(".", 1)[-1]
+                        for c in ast.walk(a)
+                        if isinstance(c, (ast.Name, ast.Attribute))
+                    )
+                    if n
+                }
+                if arg_names & ledgered:
+                    continue  # wraps/forwards a ledgered instance
+            if tail in _BARE_GENERICS:
+                out.append(
+                    Finding(
+                        self.rule,
+                        fn.module.relpath,
+                        node.lineno,
+                        fn.qualname,
+                        f"bare {tail} raised on the request path — raise "
+                        "a typed shed declared in shed_taxonomy.json",
+                    )
+                )
+            else:
+                out.append(
+                    Finding(
+                        self.rule,
+                        fn.module.relpath,
+                        node.lineno,
+                        fn.qualname,
+                        f"{tail} raised on the request path is not "
+                        "declared in shed_taxonomy.json",
+                    )
+                )
+        return out
+
+    # -- catch sites ----------------------------------------------------------
+
+    @staticmethod
+    def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+        t = handler.type
+        if t is None:
+            return []
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        return [
+            dotted_name(e).rsplit(".", 1)[-1]
+            for e in elts
+            if dotted_name(e)
+        ]
+
+    def _check_handlers(
+        self, fn: FunctionInfo, sheds: Dict[str, Dict], class_defs
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in stmt_walk(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            caught_earlier: Set[str] = set()
+            for handler in node.handlers:
+                names = self._handler_names(handler)
+                reraises = any(
+                    isinstance(n, ast.Raise)
+                    for n in ast.walk(handler)
+                )
+                for cname in names:
+                    if cname in sheds and not reraises:
+                        c_status = sheds[cname].get("http_status")
+                        for sname, sentry in sorted(sheds.items()):
+                            if sname == cname or sname in caught_earlier:
+                                continue
+                            if cname not in self._ledger_bases(
+                                sname, sheds, class_defs
+                            ):
+                                continue
+                            if sentry.get("http_status") == c_status:
+                                continue
+                            out.append(
+                                Finding(
+                                    self.rule,
+                                    fn.module.relpath,
+                                    handler.lineno,
+                                    fn.qualname,
+                                    f"except {cname} swallows subtype "
+                                    f"{sname} (declared status "
+                                    f"{sentry.get('http_status')} != "
+                                    f"{c_status}) — catch the subtype "
+                                    "first or re-raise",
+                                )
+                            )
+                caught_earlier.update(names)
+        return out

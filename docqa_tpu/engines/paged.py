@@ -488,9 +488,15 @@ class PrefixCache:
                 if old.n_tokens >= n:
                     return False
             pin = self._alloc.new_table()
-            self._alloc.share(
-                pin, table.blocks[: n // self._alloc.block_size]
-            )
+            try:
+                self._alloc.share(
+                    pin, table.blocks[: n // self._alloc.block_size]
+                )
+            except BaseException:
+                # a partial share (released/free source block) must not
+                # strand the refs already taken: nobody owns `pin` yet
+                pin.release()
+                raise
             self._entries[key] = _PrefixEntry(tuple(ids[:n]), pin)
             self._entries.move_to_end(key)
             self.insertions += 1

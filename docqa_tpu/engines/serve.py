@@ -1248,11 +1248,29 @@ class ContinuousBatcher:
         path re-admits the SAME object on a different replica, so the
         original Handle keeps waiting on the same ``done``/``cv``)."""
         with self._cv:
+            # every early refusal retires the record make_request just
+            # opened (via _record_shed, which correctly SKIPS pool-
+            # managed requests — for those a refusal is routing, and
+            # the record lives on to the replica that places or the
+            # pool's terminal _shed).  Caught by the ledger witness:
+            # a direct submit bouncing off a stopped/dead/draining
+            # batcher stranded its cost record forever.
             if self._worker_dead:
+                self._record_shed(
+                    req, "worker_dead", outcome="failed_replica",
+                    stage="serve_submit",
+                )
                 raise WorkerDied("batcher worker is dead")
             if self._stopped:
+                self._record_shed(
+                    req, "stopped", outcome="error", stage="serve_submit",
+                )
                 raise RuntimeError("batcher is stopped")
             if self._draining:
+                self._record_shed(
+                    req, "draining", outcome="shed_queue",
+                    stage="serve_submit", n_queued=len(self._queue),
+                )
                 raise Draining(
                     "batcher is draining",
                     n_queued=len(self._queue),
@@ -2029,32 +2047,48 @@ class ContinuousBatcher:
                 )
                 send_back.append(req)
                 continue
-            if self._prefix_cache is not None and req.prefix_key is not None:
-                # stats credit only AFTER ensure() held: a bounced
-                # admission re-acquires next round and must not count
-                # twice (cache stats and registry counters stay in step)
-                self._prefix_cache.credit(shared)
-            if shared:
-                DEFAULT_REGISTRY.counter("serve_prefix_hits").inc()
-                DEFAULT_REGISTRY.counter(
-                    "serve_prefix_tokens_avoided"
-                ).inc(shared)
-                _req_mark(
-                    req, "prefix_hit", anomalous=False,
-                    shared_tokens=shared, prompt_tokens=len(ids),
-                )
-            if self._prefix_cache is not None:
-                # insert IN the allocation loop, not after it: a later
-                # request of the SAME key in this very round then
-                # acquires this entry and shares in-round (consecutive
-                # questions of one session routinely land in one
-                # admission round under load).  Device ordering makes
-                # it exact: cold groups dispatch before warm ones, and
-                # within a dispatch the layer scatter precedes the
-                # prefix gather — the shared rows are always written
-                # before any sharer reads them.  Abort paths stay
-                # leak-free: a failed round clears the whole cache.
-                self._prefix_cache.insert(req.prefix_key, ids, table)
+            try:
+                if (
+                    self._prefix_cache is not None
+                    and req.prefix_key is not None
+                ):
+                    # stats credit only AFTER ensure() held: a bounced
+                    # admission re-acquires next round and must not
+                    # count twice (cache stats and registry counters
+                    # stay in step)
+                    self._prefix_cache.credit(shared)
+                if shared:
+                    DEFAULT_REGISTRY.counter("serve_prefix_hits").inc()
+                    DEFAULT_REGISTRY.counter(
+                        "serve_prefix_tokens_avoided"
+                    ).inc(shared)
+                    _req_mark(
+                        req, "prefix_hit", anomalous=False,
+                        shared_tokens=shared, prompt_tokens=len(ids),
+                    )
+                if self._prefix_cache is not None:
+                    # insert IN the allocation loop, not after it: a
+                    # later request of the SAME key in this very round
+                    # then acquires this entry and shares in-round
+                    # (consecutive questions of one session routinely
+                    # land in one admission round under load).  Device
+                    # ordering makes it exact: cold groups dispatch
+                    # before warm ones, and within a dispatch the layer
+                    # scatter precedes the prefix gather — the shared
+                    # rows are always written before any sharer reads
+                    # them.  Abort paths stay leak-free: a failed round
+                    # clears the whole cache.
+                    self._prefix_cache.insert(req.prefix_key, ids, table)
+            except BaseException:
+                # between ensure() and the good-list handoff the table
+                # is registered in no slot, so no later cleanup
+                # (_fail_active, _retire) can ever see it — a raise
+                # here would shrink the block pool permanently.
+                # Release first, bill the held interval, then let the
+                # failure propagate as a worker death.
+                table.release()
+                _cost_add(req, "kv_block_seconds", table.billed_block_seconds)
+                raise
             good.append((slot, req, ids, table, shared))
         if send_back:
             sent = {id(r) for r in send_back}

@@ -73,6 +73,14 @@ class DocQARuntime:
         from docqa_tpu.analysis.race_witness import maybe_install_from_env
 
         maybe_install_from_env()
+        # DOCQA_LEDGER_WITNESS=1 tracks every KV table and cost record
+        # from acquire to release/retire; GET /api/ledger serves the
+        # live dump (docs/STATIC_ANALYSIS.md "Ledger witness").  Method-
+        # level wrapping, so this install point covers embedding/test
+        # boots fully — no import-order caveat like the lock witness.
+        from docqa_tpu.analysis import ledger_audit
+
+        ledger_audit.maybe_install_from_env()
         import jax
 
         from docqa_tpu.deid.engine import DeidEngine
@@ -1140,6 +1148,25 @@ def make_app(rt: DocQARuntime):
             )
         return web.json_response(snap)
 
+    async def api_ledger(_req):
+        """The resource-ledger witness's live dump (table/record counts,
+        currently-live entries, witnessed call sites, and the
+        witnessed-⊆-static cross-check).  On a serving process the
+        leaked_tables / unretired_records lists show IN-FLIGHT work,
+        not leaks — the leak assertion only holds at quiesce
+        (chaos/soak run it after stop()).  404 unless booted with
+        DOCQA_LEDGER_WITNESS=1."""
+        from docqa_tpu.analysis.ledger_audit import ledger_snapshot
+
+        snap = ledger_snapshot()
+        if snap is None:
+            return json_error(
+                404,
+                "ledger witness not installed (boot with "
+                "DOCQA_LEDGER_WITNESS=1)",
+            )
+        return web.json_response(snap)
+
     async def api_trace_one(req):
         """One request's full timeline — JSON by default, Chrome-trace
         (Perfetto-loadable) with ?format=chrome."""
@@ -1571,6 +1598,7 @@ def make_app(rt: DocQARuntime):
             web.get("/api/retrieval", api_retrieval),
             web.get("/api/traces", api_traces),
             web.get("/api/witness", api_witness),
+            web.get("/api/ledger", api_ledger),
             web.get("/api/trace/{trace_id}", api_trace_one),
             web.get("/api/pool", api_pool),
             web.post("/api/pool/drain", api_pool_drain),

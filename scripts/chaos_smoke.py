@@ -28,6 +28,16 @@ witnessed edge the static acquisition-order graph missed — chaos load
 is exactly when order inversions happen, and a run that survived one by
 timing luck must still go red.  ``--no-witness`` opts out.
 
+Both phases ALSO run under the **resource-ledger witness**
+(docqa-lifecheck, docs/STATIC_ANALYSIS.md "Ledger witness"): every KV
+table and cost record minted under chaos is tracked from acquire to
+release/retire, the dump lands in ``ledger_witness_seed<N>.json``, and
+after both phases quiesce the run FAILS on a leaked table, an
+unretired record, or a witnessed acquire site the static resource-flow
+protocol table never analyzed (witnessed ⊆ static) — replica kills and
+preemption are exactly the edges where a missed exception path leaks
+HBM.  ``--no-ledger-witness`` opts out.
+
 Deterministic: the same --seed perturbs the same calls every run, so a
 failure here is replayable with the printed command line.
 
@@ -459,6 +469,57 @@ def _witness_gate(seed: int) -> int:
     return 0
 
 
+def _ledger_gate(seed: int) -> int:
+    """Dump the resource-ledger witness (always — it is the CI trend
+    artifact) and fail on leaks, unretired records, or acquire sites
+    the static resource-flow protocol table does not know.  Runs after
+    BOTH phases quiesce: every table and cost record the chaos load
+    minted must be closed out by then, whatever the kill timing was."""
+    from docqa_tpu.analysis.ledger_audit import ledger_snapshot
+
+    snap = ledger_snapshot()
+    if snap is None:
+        return 0
+    path = f"ledger_witness_seed{seed}.json"
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+        c = snap["counts"]
+        print(
+            f"ledger witness: {c['tables_created']} kv table(s), "
+            f"{c['records_opened']} cost record(s), "
+            f"{len(snap['witnessed_sites'])} witnessed site(s) -> {path}"
+        )
+    except Exception as e:
+        print(f"ledger witness dump failed: {e!r}", file=sys.stderr)
+    rc = 0
+    if snap["leaked_tables"]:
+        print(
+            f"LEAKED KV TABLE(S) after quiesce: {snap['leaked_tables']} "
+            "— blocks stranded outside every slot",
+            file=sys.stderr,
+        )
+        rc = 1
+    if snap["unretired_records"]:
+        print(
+            "UNRETIRED COST RECORD(S) after quiesce: "
+            f"{snap['unretired_records']} — a request path lost its "
+            "exactly-once retirement",
+            file=sys.stderr,
+        )
+        rc = 1
+    if snap["sites_missing_from_static"]:
+        print(
+            "WITNESSED SITES MISSING FROM THE STATIC PROTOCOL TABLE: "
+            f"{snap['sites_missing_from_static']} — resource-flow never "
+            "analyzed these acquires; fix the protocol table or the "
+            "resolution",
+            file=sys.stderr,
+        )
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -486,6 +547,11 @@ def main() -> int:
         help="skip the concurrency-witness instrumentation and its "
         "cycle / static-cross-check gate",
     )
+    ap.add_argument(
+        "--no-ledger-witness", action="store_true",
+        help="skip the resource-ledger witness (docqa-lifecheck) and "
+        "its leak / unretired-record / witnessed-⊆-static gate",
+    )
     args = ap.parse_args()
 
     if not args.no_witness:
@@ -494,6 +560,12 @@ def main() -> int:
         from docqa_tpu.analysis.race_witness import install_witness
 
         install_witness()
+    if not args.no_ledger_witness:
+        # method-level wrapping, so install order vs imports does not
+        # matter — but install before load so the counts cover the run
+        from docqa_tpu.analysis.ledger_audit import install_ledger_witness
+
+        install_ledger_witness()
 
     import jax
 
@@ -654,6 +726,7 @@ def main() -> int:
         except Exception as e:
             print(f"flight-recorder dump failed: {e!r}", file=sys.stderr)
         _witness_gate(args.seed)  # dump even on a lost-docs failure
+        _ledger_gate(args.seed)
         return 1
     n_anom = len(obs.DEFAULT_RECORDER.anomalous(100))
     print(
@@ -668,7 +741,8 @@ def main() -> int:
     # UNCONDITIONALLY: a failed replica phase is exactly the run whose
     # lock-order graph the trend artifact must keep for triage
     wrc = _witness_gate(args.seed)
-    return rc or wrc
+    lrc = _ledger_gate(args.seed)
+    return rc or wrc or lrc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,198 @@
+#!/usr/bin/env python3
+"""docqa-lifecheck CLI: run a deterministic serving window under the
+runtime ledger witness and hold the lifecycle invariants.
+
+Usage:
+    python scripts/ledger_audit.py                     # gate (exit 1 on any
+                                                       # leak / unretired /
+                                                       # static blind spot)
+    python scripts/ledger_audit.py --report out.json   # also write the CI
+                                                       # trend artifact
+    python scripts/ledger_audit.py --requests 12       # window size
+
+The gate fails on: a KV table still live after quiesce (leaked blocks),
+a cost record opened but never retired (a stranded request the
+exactly-once-retirement contract lost), a witnessed acquire/release
+site the static resource-flow protocol table does not know (analyzer
+blind spot), and a non-zero block-second residual (billed != accrued).
+chaos_smoke layers the same witness over its replica-kill phase; this
+script is the fast, load-shape-independent CI step.  See
+docs/STATIC_ANALYSIS.md ("Ledger witness").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine(seed: int):
+    from docqa_tpu.config import DecoderConfig, GenerateConfig
+    from docqa_tpu.engines.generate import GenerateEngine
+
+    cfg = DecoderConfig(
+        vocab_size=256,
+        hidden_dim=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        mlp_dim=256,
+        max_seq_len=512,
+        dtype="float32",
+    )
+    gen = GenerateConfig(
+        temperature=0.0, prefill_buckets=(32,), eos_id=2,
+        max_new_tokens=16,
+    )
+    return GenerateEngine(cfg, gen, seed=seed)
+
+
+def run_window(n_requests: int, seed: int) -> dict:
+    """One serving window: shared-prefix admissions (pins + shares),
+    private growth, normal completions, and a post-stop typed refusal —
+    every lifecycle edge the witness instruments fires at least once."""
+    from docqa_tpu.engines.serve import ContinuousBatcher
+
+    engine = build_engine(seed)
+    b = ContinuousBatcher(
+        engine, n_slots=3, chunk=8, cache_len=256, kv_block_size=16,
+        kv_pool_tokens=512, prefix_cache=True,
+    )
+    errs = []
+    try:
+        b.warmup(buckets=engine.gen.prefill_buckets[:1])
+        prefix = [(7 + i * 3) % 250 + 1 for i in range(32)]
+        handles = []
+        for i in range(n_requests):
+            # every other request shares the 32-token prefix — the
+            # prefix cache pins a table and later admissions share it
+            ids = (
+                prefix + [(i * 11) % 250 + 1]
+                if i % 2 == 0
+                else [(3 + i * 7) % 250 + 1 for i in range(24)]
+            )
+            handles.append(
+                b.submit_ids(
+                    ids, max_new_tokens=8,
+                    prefix_key="kb" if i % 2 == 0 else None,
+                )
+            )
+        for i, h in enumerate(handles):
+            try:
+                h.result(timeout=120)
+            except Exception as e:
+                errs.append(f"request {i} failed: {e!r}")
+        occ = b.kv_block_occupancy()
+    finally:
+        b.stop()
+    # typed refusal after stop must not open anything the quiesce gate
+    # then reports as stranded
+    try:
+        b.submit_ids([5, 7, 9], max_new_tokens=4)
+        errs.append("submit after stop() unexpectedly admitted")
+    except RuntimeError:
+        pass
+    occ_after = b.kv_block_occupancy()
+    return {
+        "errors": errs,
+        "occupancy_peak_window": occ,
+        "occupancy_after_stop": occ_after,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--requests", type=int, default=8,
+        help="requests in the serving window",
+    )
+    parser.add_argument(
+        "--report", default=None,
+        help="write the witness snapshot (the CI trend artifact) here",
+    )
+    args = parser.parse_args(argv)
+
+    # BEFORE any component mints tables/records: the witness wraps the
+    # class methods, so earlier objects are merely untracked, but the
+    # gate's counts should cover the whole window
+    from docqa_tpu.analysis.ledger_audit import install_ledger_witness
+
+    witness = install_ledger_witness()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    window = run_window(args.requests, args.seed)
+    snap = witness.snapshot()
+    snap["window"] = window
+
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+        print(f"ledger witness report -> {args.report}")
+
+    c = snap["counts"]
+    print(
+        f"ledger witness: {c['tables_created']} table(s) "
+        f"({c['tables_release_redundant']} redundant release(s)), "
+        f"{c['records_opened']} record(s) "
+        f"({c['records_retire_redundant']} redundant retire(s)), "
+        f"{len(snap['witnessed_sites'])} witnessed site(s) / "
+        f"{snap['static_site_count']} static"
+    )
+
+    rc = 0
+    if window["errors"]:
+        for e in window["errors"]:
+            print(f"WINDOW ERROR: {e}", file=sys.stderr)
+        rc = 1
+    if snap["leaked_tables"]:
+        print(
+            f"LEAKED KV TABLE(S) after quiesce: {snap['leaked_tables']}",
+            file=sys.stderr,
+        )
+        rc = 1
+    if snap["unretired_records"]:
+        print(
+            "UNRETIRED COST RECORD(S) after quiesce: "
+            f"{snap['unretired_records']} — a request path lost its "
+            "exactly-once retirement",
+            file=sys.stderr,
+        )
+        rc = 1
+    if snap["sites_missing_from_static"]:
+        print(
+            "WITNESSED SITES MISSING FROM THE STATIC PROTOCOL TABLE: "
+            f"{snap['sites_missing_from_static']} — resource-flow never "
+            "analyzed these acquires; fix the protocol table or the "
+            "resolution",
+            file=sys.stderr,
+        )
+        rc = 1
+    used = window["occupancy_after_stop"].get("blocks_used")
+    if used:
+        print(
+            f"BLOCK POOL NOT EMPTY after stop: {used} block(s) still "
+            "held",
+            file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            "ledger clean — zero leaks, zero unretired records, "
+            "witnessed ⊆ static"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

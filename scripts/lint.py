@@ -8,6 +8,9 @@ Usage:
     python scripts/lint.py docqa_tpu --update-baseline   # accept current
     python scripts/lint.py docqa_tpu --no-baseline       # raw findings
     python scripts/lint.py docqa_tpu --format json
+    python scripts/lint.py --changed                     # fast local mode:
+                                                         # git diff files +
+                                                         # reverse-deps
 
 The gate fails (exit 1) on any finding not in the baseline AND on any
 stale baseline entry (accepted finding that no longer fires) — the
@@ -44,6 +47,70 @@ DEFAULT_PATHS = [
 ]
 
 
+def _changed_scope():
+    """(roots to analyze, in-scope package relpaths) for --changed:
+    files changed vs HEAD (staged, unstaged, untracked) plus their
+    TRANSITIVE reverse-deps via the package import index — editing
+    paged.py re-lints serve.py too, because serve's findings can change
+    when its callee's tree does.  Whole ROOTS still load (the chassis
+    checkers need full cross-module resolution and the ledger-gated
+    rules need full-package staleness scope); the speedup is skipping
+    untouched roots, and findings are filtered to the scope."""
+    import subprocess
+
+    def _git(*cmd):
+        return subprocess.run(
+            ["git", *cmd], capture_output=True, text=True, cwd=_REPO
+        ).stdout
+
+    lines = (
+        _git("diff", "--name-only", "HEAD")
+        + _git("ls-files", "--others", "--exclude-standard")
+    ).splitlines()
+    changed = {
+        ln.strip()
+        for ln in lines
+        if ln.strip().endswith(".py")
+        and ln.strip().startswith(("docqa_tpu/", "scripts/"))
+    }
+    if not changed:
+        return [], set()
+    from docqa_tpu.analysis.core import Package
+
+    mods, mod_root = [], {}
+    for root in DEFAULT_PATHS:
+        for m in Package.load(root).modules:
+            mods.append(m)
+            mod_root[m.name] = root
+    repo_rel = {
+        m.name: os.path.relpath(os.path.abspath(m.path), _REPO)
+        for m in mods
+    }
+    imports_of = {m.name: set(m.imports.values()) for m in mods}
+    scope = {n for n, rp in repo_rel.items() if rp in changed}
+    frontier = set(scope)
+    while frontier:
+        nxt = set()
+        for name, imps in imports_of.items():
+            if name in scope:
+                continue
+            for target in frontier:
+                if any(
+                    v == target or v.startswith(target + ".")
+                    for v in imps
+                ):
+                    nxt.add(name)
+                    break
+        scope |= nxt
+        frontier = nxt
+    roots = [
+        r for r in DEFAULT_PATHS
+        if any(mod_root[n] == r for n in scope)
+    ]
+    relpaths = {m.relpath for m in mods if m.name in scope}
+    return roots, relpaths
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -77,7 +144,34 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--format", choices=("text", "json"), default="text"
     )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="fast local mode: report only on files changed vs HEAD "
+        "plus their reverse-deps via the package import index "
+        "(untouched roots are skipped entirely).  The full-tree run "
+        "stays the CI gate",
+    )
     args = parser.parse_args(argv)
+
+    changed_scope = None
+    if args.changed:
+        if args.paths is not DEFAULT_PATHS and args.paths:
+            parser.error("--changed computes its own path scope")
+        if args.update_baseline:
+            parser.error(
+                "--changed is a scoped view; update the baseline from "
+                "a full-tree run"
+            )
+        roots, changed_scope = _changed_scope()
+        if not roots:
+            print("docqa-lint: no changed python files in scope")
+            return 0
+        args.paths = roots
+        print(
+            f"docqa-lint --changed: {len(changed_scope)} file(s) in "
+            f"scope (diff + reverse-deps) across {len(roots)} root(s)"
+        )
 
     rules = (
         [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -90,6 +184,9 @@ def main(argv=None) -> int:
     # baseline entries as stale nor (on update) destroy them
     findings, analyzed = analyze_paths(paths, rules=rules)
     active_rules = set(rules) if rules else set(all_checkers())
+    if changed_scope is not None:
+        findings = [f for f in findings if f.path in changed_scope]
+        analyzed &= changed_scope
 
     baseline_path = args.baseline or default_baseline_path()
     if args.no_baseline:

@@ -3,7 +3,7 @@
 Each rule gets three fixture classes: a seeded violation (detected), the
 same violation with a ``# docqa-lint: disable=<rule>`` suppression
 (silent), and a clean/sanctioned variant (silent).  The gate tests then
-run the full fourteen-checker suite over the real ``docqa_tpu`` tree and
+run the full seventeen-checker suite over the real ``docqa_tpu`` tree and
 assert it is exactly in sync with the committed baseline — zero new
 findings AND zero stale entries (the acceptance contract of
 ``scripts/lint.py``).
@@ -848,7 +848,10 @@ class TestTreeGate:
             "lock-discipline",
             "mesh-axes",
             "phi-taint",
+            "resource-flow",
+            "retire-once",
             "retrace-hazard",
+            "shed-taxonomy",
             "spec-shape",
             "thread-lifecycle",
         ]
