@@ -1,6 +1,6 @@
 """docqa-lint: AST invariant analysis for the docqa_tpu tree.
 
-Seventeen project-specific checkers (docs/STATIC_ANALYSIS.md):
+Twenty project-specific checkers (docs/STATIC_ANALYSIS.md):
 
 * ``cv-protocol``     — condition waits in predicate loops, notify under
   the lock, request-path waits carry a Deadline.
@@ -37,6 +37,17 @@ Seventeen project-specific checkers (docs/STATIC_ANALYSIS.md):
 * ``spec-shape``      — PartitionSpec arity matches the annotated rank.
 * ``thread-lifecycle``— every thread has a reachable join on its owner's
   stop/close path (daemon threads that can reach jax especially).
+* ``wire-consumer``   — every subscript/``.get`` read of an HTTP
+  response, broker body, journal record, or bench dotted path resolves
+  to a declared producer key; orphaned producer keys also flag.
+* ``wire-safety``     — device arrays, numpy scalars, locks, Trace/Span
+  objects, and non-finite floats at serialization boundaries
+  (``json_response`` / broker publish / journal write) are findings;
+  ``to_wire()`` coercion sanctions the site.
+* ``wire-schema``     — each route handler's response key tree, derived
+  from the AST, matches its ``api_contract.json`` entry (per-endpoint
+  versioning; NEW, REMOVED, and STALE keys all fail; pydantic models in
+  service/schemas.py must mirror their endpoint's contract).
 
 Tier B lives in ``analysis/shard_audit.py`` (docs/SHARDING.md) — lower
 the device-plane programs on virtual meshes, hold their collective counts
@@ -53,14 +64,20 @@ the chaos/soak gates — and in ``analysis/ledger_audit.py``
 instrumentation of KV-table / cost-record lifecycle events whose
 witnessed acquire sites are cross-checked against resource-flow's
 static protocol table, failing on leaks, unretired records, or static
-blind spots.
+blind spots — and in ``analysis/wire_audit.py`` (docs/STATIC_ANALYSIS.md
+"Wire contract"): boot the fake-mode runtime, drive every registered
+route over real HTTP, validate each live response key tree and JSON
+types against ``api_contract.json``, and round-trip a broker journal
+across a simulated restart.
 
 Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` /
 ``scripts/compile_audit.py`` / ``scripts/serve_cluster_loop.py`` /
-``scripts/ledger_audit.py`` (CLIs) and ``pytest -m lint`` (tier-1 gate,
-tests/test_analysis.py, tests/test_numcheck.py, tests/test_shardcheck.py,
+``scripts/ledger_audit.py`` / ``scripts/wire_audit.py`` (CLIs) and
+``pytest -m lint`` (tier-1 gate, tests/test_analysis.py,
+tests/test_numcheck.py, tests/test_shardcheck.py,
 tests/test_racecheck.py, tests/test_shard_audit.py,
-tests/test_compile_audit.py, tests/test_lifecheck.py).
+tests/test_compile_audit.py, tests/test_lifecheck.py,
+tests/test_wirecheck.py).
 """
 
 from docqa_tpu.analysis.core import (  # noqa: F401

@@ -503,6 +503,9 @@ def all_checkers() -> Dict[str, object]:
     from docqa_tpu.analysis.shed_taxonomy import ShedTaxonomyChecker
     from docqa_tpu.analysis.spec_shape import SpecShapeChecker
     from docqa_tpu.analysis.thread_lifecycle import ThreadLifecycleChecker
+    from docqa_tpu.analysis.wire_consumer import WireConsumerChecker
+    from docqa_tpu.analysis.wire_safety import WireSafetyChecker
+    from docqa_tpu.analysis.wire_schema import WireSchemaChecker
 
     checkers = [
         CvProtocolChecker(),
@@ -522,6 +525,9 @@ def all_checkers() -> Dict[str, object]:
         ShedTaxonomyChecker(),
         SpecShapeChecker(),
         ThreadLifecycleChecker(),
+        WireConsumerChecker(),
+        WireSafetyChecker(),
+        WireSchemaChecker(),
     ]
     return {c.rule: c for c in checkers}
 

@@ -1,0 +1,723 @@
+"""Tier B wire audit: measured contract enforcement over real HTTP.
+
+The static wire rules reason about dict literals; this module boots the
+fake-mode runtime, drives **every** registered route over a real TCP
+socket, and validates each live response — status code, key tree, and
+JSON leaf types — against ``api_contract.json``.  The two tiers fail
+independently: a handler whose payload the static derivation cannot see
+(built by a helper, mutated downstream) still cannot drift, because the
+bytes on the wire are re-parsed and re-checked here; conversely a
+``--write-*`` style edit to the ledger cannot launder drift past the
+static pass, mirroring the compile-/shard-/ledger-audit pattern.
+
+Three measured gates:
+
+* **endpoint coverage** — the driven set, the app's registered route
+  table, and the contract's entries must agree exactly (100% coverage
+  both directions); a route added to ``make_app`` without a driver and
+  a contract entry is a failure by construction.
+* **response validation** — 200-JSON bodies validate against the
+  entry's ``response`` tree (``open`` entries tolerate extras),
+  non-200s against the shared ``error_response`` shape, ``kind``
+  routes (html / prometheus-text / sse) against their media contract;
+  SSE streams are parsed event-by-event.
+* **journal round-trip** — a broker journal is written, the broker is
+  torn down, and a fresh broker replays it: surviving depth, body
+  equality, and per-record ``journal_record`` conformance are asserted
+  across the simulated restart.
+
+Entry point: ``scripts/wire_audit.py`` (blocking in CI);
+``run_wire_audit()`` is importable for tests.  ``render_api_md()``
+generates ``docs/API.md`` from the contract — a stale generation is a
+test failure, so the human-readable reference cannot drift either.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+from docqa_tpu.analysis.wire_schema import (
+    LEDGER_NAME,
+    default_ledger_path,
+    load_contract,
+)
+
+_SCALARS = {
+    "str": (str,),
+    "int": (int,),
+    "float": (float,),
+    "number": (int, float),
+    "bool": (bool,),
+}
+NONFINITE_KEY = "_nonfinite_fields"
+
+
+# ---------------------------------------------------------------------------
+# value validation
+# ---------------------------------------------------------------------------
+
+
+def _leaf_ok(value: Any, leaf: str) -> bool:
+    for alt in leaf.split("|"):
+        alt = alt.strip()
+        if alt == "any":
+            return True
+        if alt == "null":
+            if value is None:
+                return True
+            continue
+        types = _SCALARS.get(alt)
+        if types is None:
+            continue
+        if isinstance(value, bool) and alt != "bool":
+            continue  # bool is an int subclass; don't let it pass as int
+        if isinstance(value, types):
+            return True
+    return False
+
+
+def validate_value(
+    value: Any,
+    spec: Any,
+    open_: bool = False,
+    path: str = "$",
+) -> List[str]:
+    """Violations of ``value`` against a contract spec node."""
+    out: List[str] = []
+    if isinstance(spec, str):
+        if not _leaf_ok(value, spec):
+            out.append(
+                f"{path}: expected {spec}, got "
+                f"{type(value).__name__} ({value!r:.80})"
+            )
+        return out
+    if isinstance(spec, list):
+        if not isinstance(value, list):
+            out.append(
+                f"{path}: expected list, got {type(value).__name__}"
+            )
+            return out
+        elem = spec[0] if spec else "any"
+        for i, v in enumerate(value):
+            out.extend(validate_value(v, elem, open_, f"{path}[{i}]"))
+        return out
+    if isinstance(spec, dict):
+        if not isinstance(value, dict):
+            out.append(
+                f"{path}: expected object, got {type(value).__name__}"
+            )
+            return out
+        star = spec.get("*")
+        declared: Dict[str, Tuple[Any, bool]] = {}
+        for k, sub in spec.items():
+            if k == "*":
+                continue
+            if k.endswith("?"):
+                declared[k[:-1]] = (sub, False)
+            else:
+                declared[k] = (sub, True)
+        for k, (sub, required) in declared.items():
+            if k in value:
+                out.extend(
+                    validate_value(value[k], sub, open_, f"{path}.{k}")
+                )
+            elif required:
+                out.append(f"{path}: missing required key '{k}'")
+        for k, v in value.items():
+            if k in declared or k == NONFINITE_KEY:
+                continue
+            if star is not None:
+                out.extend(validate_value(v, star, open_, f"{path}.{k}"))
+            elif not open_:
+                out.append(f"{path}: undeclared key '{k}'")
+        return out
+    out.append(f"{path}: malformed spec node {spec!r}")
+    return out
+
+
+def validate_response(
+    entry: Dict[str, Any], status: int, body: Any
+) -> List[str]:
+    """Status + body of one live response against its contract entry."""
+    allowed = entry.get("statuses", [200])
+    if status not in allowed:
+        return [f"$: status {status} not in declared {allowed}"]
+    if status != 200:
+        return validate_value(body, {"detail": "str"}, False)
+    spec = entry.get("response")
+    if spec is None:
+        return []
+    return validate_value(body, spec, bool(entry.get("open")))
+
+
+# ---------------------------------------------------------------------------
+# docs/API.md generation
+# ---------------------------------------------------------------------------
+
+
+def _spec_lines(spec: Any, indent: int = 0) -> List[str]:
+    pad = "  " * indent
+    if isinstance(spec, str):
+        return [f"{pad}- `{spec}`"]
+    if isinstance(spec, list):
+        elem = spec[0] if spec else "any"
+        if isinstance(elem, str):
+            return [f"{pad}- list of `{elem}`"]
+        return [f"{pad}- list of:"] + _spec_lines(elem, indent + 1)
+    if isinstance(spec, dict):
+        lines = []
+        for k, sub in spec.items():
+            label = (
+                "any other key"
+                if k == "*"
+                else f"`{k[:-1]}` *(optional)*"
+                if k.endswith("?")
+                else f"`{k}`"
+            )
+            if isinstance(sub, str):
+                lines.append(f"{pad}- {label}: `{sub}`")
+            else:
+                lines.append(f"{pad}- {label}:")
+                lines.extend(_spec_lines(sub, indent + 1))
+        return lines
+    return [f"{pad}- (malformed spec)"]
+
+
+def render_api_md(contract: Dict[str, Any]) -> str:
+    """Deterministic markdown endpoint reference from the contract.
+
+    ``docs/API.md`` must equal this function's output byte-for-byte
+    (tests/test_wirecheck.py) — regenerate with
+    ``python scripts/wire_audit.py --write-api-docs``.
+    """
+    lines = [
+        "# HTTP API reference",
+        "",
+        "Generated from `api_contract.json` by `scripts/wire_audit.py "
+        "--write-api-docs` — do not edit by hand; a stale generation "
+        "is a test failure.",
+        "",
+        "Every non-200 JSON response has the shape "
+        "`{\"detail\": str}`.  `_nonfinite_fields` (a list of dotted "
+        "paths whose non-finite floats were nulled by the boundary "
+        "coercion) may appear in any object.  Contract grammar and the "
+        "amendment workflow: docs/STATIC_ANALYSIS.md, \"Wire contract "
+        "& live audit\".",
+        "",
+    ]
+    for key, entry in contract.get("endpoints", {}).items():
+        lines.append(f"## `{key}`")
+        lines.append("")
+        lines.append(
+            f"Handler `{entry.get('handler', '?')}` · contract "
+            f"version {entry.get('version', '?')}"
+            + (
+                f" · pydantic model `{entry['model']}`"
+                if entry.get("model")
+                else ""
+            )
+        )
+        lines.append("")
+        statuses = entry.get("statuses", [200])
+        lines.append(
+            "Statuses: " + ", ".join(f"`{s}`" for s in statuses)
+        )
+        lines.append("")
+        kind = entry.get("kind")
+        if kind is not None:
+            lines.append(f"Body: non-JSON (`{kind}`).")
+            events = entry.get("events")
+            if events:
+                lines.append("")
+                lines.append("SSE events:")
+                for ev, spec in events.items():
+                    lines.append(f"- `{ev}`:")
+                    lines.extend(_spec_lines(spec, 1))
+            lines.append("")
+            continue
+        spec = entry.get("response")
+        if spec is None:
+            lines.append("Body: unspecified.")
+        else:
+            openness = (
+                " (open: undeclared extra keys tolerated)"
+                if entry.get("open")
+                else ""
+            )
+            lines.append(f"200 body{openness}:")
+            lines.append("")
+            lines.extend(_spec_lines(spec))
+        lines.append("")
+    jr = contract.get("journal_record")
+    if jr is not None:
+        lines.append("## Broker journal record")
+        lines.append("")
+        lines.extend(_spec_lines(jr))
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def default_api_md_path() -> str:
+    return os.path.join(
+        os.path.dirname(default_ledger_path()), "docs", "API.md"
+    )
+
+
+# ---------------------------------------------------------------------------
+# journal round-trip
+# ---------------------------------------------------------------------------
+
+
+def journal_roundtrip(
+    journal_dir: Optional[str] = None,
+    contract: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Publish → ack → close → replay in a fresh broker; validate the
+    journal lines against ``journal_record`` and the surviving message
+    against the original body."""
+    from docqa_tpu.service.broker import MemoryBroker
+
+    if contract is None:
+        contract = load_contract(default_ledger_path())
+    spec = contract.get("journal_record", {"*": "any"})
+    violations: List[str] = []
+    owns_dir = journal_dir is None
+    tmp = journal_dir or tempfile.mkdtemp(prefix="wire_journal_")
+    queue = "wire_audit_q"
+    body_kept = {"doc_id": "wire-1", "n": 2}
+    body_acked = {"doc_id": "wire-0", "n": 1}
+    try:
+        broker = MemoryBroker(journal_dir=tmp)
+        broker.publish(queue, body_acked)
+        broker.publish(queue, body_kept, headers={"x-trace": "t-1"})
+        d = broker.get(queue, timeout=1.0)
+        if d is None:
+            violations.append("journal: first delivery never arrived")
+        else:
+            broker.ack(d)
+        broker.close()
+        path = os.path.join(tmp, f"{queue}.jsonl")
+        if not os.path.exists(path):
+            violations.append(f"journal: {path} was never written")
+        else:
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        violations.append(
+                            f"journal[{i}]: line is not JSON"
+                        )
+                        continue
+                    violations.extend(
+                        validate_value(rec, spec, False, f"journal[{i}]")
+                    )
+        # the simulated restart: a fresh broker replays the journal
+        broker2 = MemoryBroker(journal_dir=tmp)
+        depth = broker2.depth(queue)
+        if depth != 1:
+            violations.append(
+                f"journal: replayed depth {depth}, expected 1 "
+                "(one published message was acked)"
+            )
+        d2 = broker2.get(queue, timeout=1.0)
+        if d2 is None:
+            violations.append("journal: replayed message not deliverable")
+        elif d2.body != body_kept:
+            violations.append(
+                f"journal: replayed body {d2.body!r} != published "
+                f"{body_kept!r}"
+            )
+        else:
+            broker2.ack(d2)
+        broker2.close()
+    finally:
+        if owns_dir:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return {"ok": not violations, "violations": violations}
+
+
+# ---------------------------------------------------------------------------
+# live HTTP drive
+# ---------------------------------------------------------------------------
+
+FAKE_OVERRIDES = {
+    "flags.use_fake_llm": True,
+    "flags.use_fake_encoder": True,
+    "encoder.embed_dim": 64,
+    "store.dim": 64,
+    "store.shard_capacity": 256,
+    "ner.hidden_dim": 32,
+    "ner.num_layers": 1,
+    "ner.num_heads": 2,
+    "ner.mlp_dim": 64,
+    "ner.train_steps": 0,
+}
+
+_DOC_TEXT = (
+    "Aspirin 100 mg daily. BP 130/85 mmHg. Follow-up in 3 months."
+)
+
+
+def _parse_sse(text: str) -> List[Tuple[str, Any]]:
+    """-> [(event name, decoded data)]; default event name is 'data'."""
+    events: List[Tuple[str, Any]] = []
+    name = "data"
+    for block in text.split("\n\n"):
+        name = "data"
+        data_lines = []
+        for line in block.split("\n"):
+            if line.startswith("event:"):
+                name = line.split(":", 1)[1].strip()
+            elif line.startswith("data:"):
+                data_lines.append(line.split(":", 1)[1].strip())
+        if data_lines:
+            try:
+                payload = json.loads("\n".join(data_lines))
+            except ValueError:
+                payload = None
+            events.append((name, payload))
+    return events
+
+
+async def _drive(
+    rt,
+    contract: Dict[str, Any],
+    only: Optional[List[str]],
+) -> Tuple[Dict[str, Any], List[str]]:
+    import aiohttp
+    from aiohttp import web
+
+    from docqa_tpu.service.app import make_app
+
+    endpoints = contract.get("endpoints", {})
+    results: Dict[str, Any] = {}
+    registered: List[str] = []
+
+    app = make_app(rt)
+    for route in app.router.routes():
+        method = route.method.upper()
+        if method not in ("GET", "POST", "PUT", "DELETE", "PATCH"):
+            continue
+        canonical = route.resource.canonical if route.resource else None
+        if canonical is None:
+            continue
+        registered.append(f"{method} {canonical}")
+    registered = sorted(set(registered))
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+
+    def record(key: str, status: int, violations: List[str]) -> None:
+        if only is not None and key not in only:
+            return
+        slot = results.setdefault(
+            key, {"status": status, "violations": []}
+        )
+        slot["status"] = status
+        slot["violations"].extend(violations)
+
+    async def drive_json(
+        key: str,
+        path: str,
+        s: "aiohttp.ClientSession",
+        json_body: Any = None,
+    ):
+        """Drive one endpoint, validate, and return (status, body)."""
+        entry = endpoints.get(key)
+        method = key.split(" ", 1)[0]
+        async with s.request(method, f"{base}{path}", json=json_body) as r:
+            status = r.status
+            try:
+                body = await r.json()
+            except Exception:
+                body = None
+        if entry is None:
+            record(key, status, [f"$: no {LEDGER_NAME} entry"])
+        else:
+            record(key, status, validate_response(entry, status, body))
+        return status, body
+
+    async def drive_text(key: str, path: str, s, expect_ct: str):
+        entry = endpoints.get(key, {})
+        async with s.get(f"{base}{path}") as r:
+            status = r.status
+            text = await r.text()
+            ct = r.headers.get("Content-Type", "")
+        violations: List[str] = []
+        allowed = entry.get("statuses", [200])
+        if status not in allowed:
+            violations.append(f"$: status {status} not in {allowed}")
+        if expect_ct not in ct:
+            violations.append(
+                f"$: content-type {ct!r} lacks {expect_ct!r}"
+            )
+        if not text.strip():
+            violations.append("$: empty body")
+        record(key, status, violations)
+        return status, text
+
+    try:
+        timeout = aiohttp.ClientTimeout(total=120)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # documents first: later routes need indexed content
+            doc_ids = []
+            for i in range(2):
+                _, body = await drive_json(
+                    "POST /ingest/",
+                    "/ingest/?wait=1",
+                    s,
+                    {
+                        "filename": f"wire-{i}.txt",
+                        "text": _DOC_TEXT,
+                        "patient_id": "p-wire",
+                    },
+                )
+                if isinstance(body, dict) and "doc_id" in body:
+                    doc_ids.append(body["doc_id"])
+            await drive_json("GET /documents/", "/documents/", s)
+            if doc_ids:
+                await drive_json(
+                    "GET /documents/{doc_id}",
+                    f"/documents/{doc_ids[0]}",
+                    s,
+                )
+
+            # QA + traces
+            trace_id = None
+            entry = endpoints.get("POST /ask/")
+            async with s.post(
+                f"{base}/ask/", json={"question": "aspirin dose?"}
+            ) as r:
+                status = r.status
+                trace_id = r.headers.get("X-Trace-Id")
+                try:
+                    body = await r.json()
+                except Exception:
+                    body = None
+            record(
+                "POST /ask/",
+                status,
+                validate_response(entry, status, body)
+                if entry
+                else [f"$: no {LEDGER_NAME} entry"],
+            )
+            if trace_id:
+                await drive_json(
+                    "GET /api/trace/{trace_id}",
+                    f"/api/trace/{trace_id}",
+                    s,
+                )
+                await drive_json(
+                    "GET /api/trace/{trace_id}",
+                    f"/api/trace/{trace_id}?format=chrome",
+                    s,
+                )
+            await drive_json("GET /api/traces", "/api/traces?limit=20", s)
+
+            # SSE stream
+            sse_entry = endpoints.get("POST /ask/stream", {})
+            async with s.post(
+                f"{base}/ask/stream", json={"question": "blood pressure?"}
+            ) as r:
+                status = r.status
+                text = await r.text()
+                ct = r.headers.get("Content-Type", "")
+            sse_violations: List[str] = []
+            if status != 200:
+                sse_violations.append(f"$: status {status} != 200")
+            if "text/event-stream" not in ct:
+                sse_violations.append(f"$: content-type {ct!r} not SSE")
+            events = _parse_sse(text)
+            if not events:
+                sse_violations.append("$: no SSE events parsed")
+            declared_events = sse_entry.get("events", {})
+            terminal = [n for n, _ in events if n in ("done", "error")]
+            if not terminal:
+                sse_violations.append("$: stream ended without done/error")
+            for name, payload in events:
+                spec = declared_events.get(name)
+                if spec is None:
+                    sse_violations.append(
+                        f"$: undeclared SSE event '{name}'"
+                    )
+                else:
+                    sse_violations.extend(
+                        validate_value(payload, spec, False, f"$.{name}")
+                    )
+            record("POST /ask/stream", status, sse_violations)
+
+            # status / metrics / observability
+            await drive_json("GET /health", "/health", s)
+            await drive_json("GET /api/status", "/api/status", s)
+            await drive_text("GET /metrics", "/metrics", s, "text/plain")
+            await drive_json("GET /api/metrics", "/api/metrics", s)
+            await drive_json("GET /api/telemetry", "/api/telemetry", s)
+            await drive_json("GET /api/costs", "/api/costs", s)
+            await drive_json(
+                "GET /api/costs/sheds", "/api/costs/sheds?limit=20", s
+            )
+            await drive_json("GET /api/retrieval", "/api/retrieval", s)
+            # witness endpoints 404 without the opt-in env instrumentation
+            await drive_json("GET /api/witness", "/api/witness", s)
+            await drive_json("GET /api/ledger", "/api/ledger", s)
+
+            # pool control plane (404 in fake mode: no rolling_restart)
+            await drive_json("GET /api/pool", "/api/pool", s)
+            await drive_json(
+                "POST /api/pool/drain", "/api/pool/drain?replica=0", s
+            )
+            await drive_json(
+                "POST /api/pool/resume", "/api/pool/resume?replica=0", s
+            )
+            await drive_json(
+                "POST /api/pool/rolling_restart",
+                "/api/pool/rolling_restart",
+                s,
+            )
+
+            # profiler
+            await drive_json(
+                "POST /api/profiler/start", "/api/profiler/start", s
+            )
+            await drive_json(
+                "POST /api/profiler/stop", "/api/profiler/stop", s
+            )
+
+            # clinical surfaces
+            await drive_json(
+                "GET /api/search/patient-snippets",
+                "/api/search/patient-snippets?patient_id=p-wire",
+                s,
+            )
+            await drive_json(
+                "POST /api/llm/summarize",
+                "/api/llm/summarize",
+                s,
+                {"prompt": "Summarize the treatment."},
+            )
+            await drive_json(
+                "POST /api/synthese/patient",
+                "/api/synthese/patient",
+                s,
+                {"patient_id": "p-wire"},
+            )
+            await drive_json(
+                "POST /api/synthese/comparaison",
+                "/api/synthese/comparaison",
+                s,
+                {"patient_ids": ["p-wire", "p-ghost"]},
+            )
+
+            # teardown of one doc + the index page last
+            if len(doc_ids) > 1:
+                await drive_json(
+                    "DELETE /documents/{doc_id}",
+                    f"/documents/{doc_ids[1]}?erase=1",
+                    s,
+                )
+            await drive_text("GET /", "/", s, "text/html")
+    finally:
+        await runner.cleanup()
+    return results, registered
+
+
+def run_wire_audit(
+    contract_path: Optional[str] = None,
+    report_path: Optional[str] = None,
+    only: Optional[List[str]] = None,
+    contract: Optional[Dict[str, Any]] = None,
+    skip_journal: bool = False,
+) -> Dict[str, Any]:
+    """Boot the fake-mode runtime, drive the wire, return the report.
+
+    ``only`` restricts driving/validation to the named endpoint keys
+    and disables the coverage gates (for focused tests);
+    ``contract`` overrides the loaded ledger (for drift injection).
+    """
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from docqa_tpu.config import load_config
+    from docqa_tpu.service.app import DocQARuntime
+
+    if contract is None:
+        contract = load_contract(contract_path or default_ledger_path())
+    endpoints = contract.get("endpoints", {})
+
+    cfg = load_config(env={}, overrides=dict(FAKE_OVERRIDES))
+    rt = DocQARuntime(cfg).start()
+    try:
+        results, registered = asyncio.run(_drive(rt, contract, only))
+    finally:
+        rt.stop()
+
+    coverage: Dict[str, Any] = {"checked": only is None}
+    violations_total = sum(
+        len(r["violations"]) for r in results.values()
+    )
+    if only is None:
+        driven = sorted(results)
+        declared = sorted(endpoints)
+        coverage.update(
+            {
+                "registered": len(registered),
+                "driven": len(driven),
+                "declared": len(declared),
+                "not_driven": sorted(set(registered) - set(driven)),
+                "not_registered": sorted(
+                    set(driven) - set(registered)
+                ),
+                "undeclared_routes": sorted(
+                    set(registered) - set(declared)
+                ),
+                "stale_entries": sorted(
+                    set(declared) - set(registered)
+                ),
+            }
+        )
+        for k in (
+            "not_driven",
+            "not_registered",
+            "undeclared_routes",
+            "stale_entries",
+        ):
+            if coverage[k]:
+                violations_total += len(coverage[k])
+
+    journal = (
+        {"ok": True, "violations": [], "skipped": True}
+        if skip_journal
+        else journal_roundtrip(contract=contract)
+    )
+    violations_total += len(journal["violations"])
+
+    report = {
+        "ok": violations_total == 0,
+        "violations_total": violations_total,
+        "coverage": coverage,
+        "journal": journal,
+        "endpoints": {
+            k: results[k] for k in sorted(results)
+        },
+    }
+    if report_path:
+        with open(report_path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return report

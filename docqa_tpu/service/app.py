@@ -49,6 +49,7 @@ from docqa_tpu.service.schemas import (
     SummarizeRequest,
 )
 from docqa_tpu.service.synthesis import SynthesisError, SynthesisService
+from docqa_tpu.service.wire import to_wire
 
 log = get_logger("docqa.app")
 
@@ -855,8 +856,15 @@ def make_app(rt: DocQARuntime):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(host_pool, lambda: fn(*args, **kw))
 
+    def json_response(payload, **kw):
+        """Every JSON body leaves through to_wire() — numpy scalars
+        become native, non-finite floats become null with the path
+        recorded under ``_nonfinite_fields`` (wire-safety's sanctioned
+        boundary; api_contract.json tolerates the flag key)."""
+        return web.json_response(to_wire(payload), **kw)
+
     def json_error(status: int, detail: str, ctx=None):
-        resp = web.json_response({"detail": detail}, status=status)
+        resp = json_response({"detail": detail}, status=status)
         if ctx is not None:
             resp.headers["X-Trace-Id"] = ctx.trace_id
         return resp
@@ -872,11 +880,11 @@ def make_app(rt: DocQARuntime):
     # ---- health / status ----------------------------------------------------
 
     async def health(_req):
-        return web.json_response({"status": "ok"})
+        return json_response({"status": "ok"})
 
     async def api_status(_req):
         queues = (rt.cfg.broker.raw_queue, rt.cfg.broker.clean_queue)
-        return web.json_response(
+        return json_response(
             {
                 "service": "docqa-tpu",
                 "status": "running",
@@ -954,7 +962,7 @@ def make_app(rt: DocQARuntime):
         )
 
     async def api_metrics(_req):
-        return web.json_response(DEFAULT_REGISTRY.snapshot())
+        return json_response(DEFAULT_REGISTRY.snapshot())
 
     async def api_telemetry(req):
         """Rollup time series as JSON (?name= for one series) — the
@@ -962,7 +970,7 @@ def make_app(rt: DocQARuntime):
         violation carries its ten-minute history, not just the moment."""
         if rt.telemetry is None:
             return json_error(404, "telemetry disabled (telemetry.enabled)")
-        return web.json_response(
+        return json_response(
             obs.telemetry_json(rt.telemetry, req.query.get("name"))
         )
 
@@ -982,7 +990,7 @@ def make_app(rt: DocQARuntime):
                 pool_bs = bs()["total"]
             except Exception:
                 pool_bs = None
-        return web.json_response(
+        return json_response(
             rt.costs.snapshot(
                 spine_device_s=spine_dev, pool_block_seconds=pool_bs
             )
@@ -999,7 +1007,7 @@ def make_app(rt: DocQARuntime):
             return json_error(422, "limit must be an integer")
         if limit < 0:
             return json_error(422, "limit must be >= 0")
-        return web.json_response(rt.costs.sheds(limit))
+        return json_response(rt.costs.sheds(limit))
 
     async def api_retrieval(_req):
         """Retrieval-quality observatory (docqa-recallscope): live
@@ -1031,7 +1039,7 @@ def make_app(rt: DocQARuntime):
                 "retrieve_offmesh_fallback"
             ).value,
         }
-        return web.json_response(payload)
+        return json_response(payload)
 
     # ---- decode-engine pool (docs/OPERATIONS.md "Replica pool") -------------
 
@@ -1045,7 +1053,7 @@ def make_app(rt: DocQARuntime):
         pool = _pool_or_none()
         if pool is None:
             return json_error(404, "no decode pool (fake-llm runtime)")
-        return web.json_response(pool.status())
+        return json_response(pool.status())
 
     async def api_pool_drain(req):
         """Drain one replica (stop admitting → finish in-flight).  Body
@@ -1071,7 +1079,7 @@ def make_app(rt: DocQARuntime):
             return json_error(
                 422, f"replica must be 0..{pool.n_replicas - 1}"
             )
-        return web.json_response(
+        return json_response(
             await on_host(pool.drain, replica, timeout)
         )
 
@@ -1092,7 +1100,7 @@ def make_app(rt: DocQARuntime):
             return json_error(
                 422, f"replica must be 0..{pool.n_replicas - 1}"
             )
-        return web.json_response(
+        return json_response(
             await on_host(
                 pool.resume, replica, bool(body.get("rebuild", False))
             )
@@ -1113,7 +1121,7 @@ def make_app(rt: DocQARuntime):
                 )
             except Exception:
                 pass
-        return web.json_response(
+        return json_response(
             await on_host(pool.rolling_restart, timeout)
         )
 
@@ -1128,7 +1136,7 @@ def make_app(rt: DocQARuntime):
             limit = int(req.query.get("limit", "50"))
         except ValueError:
             return json_error(422, "limit must be an integer")
-        return web.json_response(
+        return json_response(
             obs.DEFAULT_RECORDER.summaries(n=limit, anomalous=anomalous)
         )
 
@@ -1146,7 +1154,7 @@ def make_app(rt: DocQARuntime):
                 404,
                 "witness not installed (boot with DOCQA_RACE_WITNESS=1)",
             )
-        return web.json_response(snap)
+        return json_response(snap)
 
     async def api_ledger(_req):
         """The resource-ledger witness's live dump (table/record counts,
@@ -1165,7 +1173,7 @@ def make_app(rt: DocQARuntime):
                 "ledger witness not installed (boot with "
                 "DOCQA_LEDGER_WITNESS=1)",
             )
-        return web.json_response(snap)
+        return json_response(snap)
 
     async def api_trace_one(req):
         """One request's full timeline — JSON by default, Chrome-trace
@@ -1174,8 +1182,8 @@ def make_app(rt: DocQARuntime):
         if trace is None:
             return json_error(404, "trace not found (evicted or unknown)")
         if req.query.get("format") == "chrome":
-            return web.json_response(obs.to_chrome_trace([trace]))
-        return web.json_response(obs.timeline_dict(trace))
+            return json_response(obs.to_chrome_trace([trace]))
+        return json_response(obs.timeline_dict(trace))
 
     async def profiler_start(req):
         """Open an on-demand ``jax.profiler`` window (jit-exterior by
@@ -1194,7 +1202,7 @@ def make_app(rt: DocQARuntime):
             return json_error(409, str(e))
         except Exception as e:  # backend without profiler support
             return json_error(500, f"profiler start failed: {e!r}")
-        return web.json_response({"profiling": True, "logdir": logdir})
+        return json_response({"profiling": True, "logdir": logdir})
 
     async def profiler_stop(_req):
         try:
@@ -1203,7 +1211,7 @@ def make_app(rt: DocQARuntime):
             return json_error(409, str(e))
         except Exception as e:
             return json_error(500, f"profiler stop failed: {e!r}")
-        return web.json_response({"profiling": False, "logdir": logdir})
+        return json_response({"profiling": False, "logdir": logdir})
 
     # ---- ingestion ----------------------------------------------------------
 
@@ -1265,14 +1273,14 @@ def make_app(rt: DocQARuntime):
             )
             record = rt.registry.get(record.doc_id)
         return with_trace(
-            web.json_response(
+            json_response(
                 {"doc_id": record.doc_id, "status": record.status}
             ),
             ctx,
         )
 
     async def documents(_req):
-        return web.json_response(
+        return json_response(
             [r.to_dict() for r in rt.registry.list_documents()]
         )
 
@@ -1280,7 +1288,7 @@ def make_app(rt: DocQARuntime):
         rec = rt.registry.get(req.match_info["doc_id"])
         if rec is None:
             return json_error(404, "document not found")
-        return web.json_response(rec.to_dict())
+        return json_response(rec.to_dict())
 
     async def document_delete(req):
         doc_id = req.match_info["doc_id"]
@@ -1290,7 +1298,7 @@ def make_app(rt: DocQARuntime):
         erase = req.query.get("erase") in ("1", "true")
         # device lane: tombstoning races with appends/searches otherwise
         n = await on_device(rt.delete_document, doc_id, erase)
-        return web.json_response(
+        return json_response(
             {"doc_id": doc_id, "chunks_removed": n, "erased": erase}
         )
 
@@ -1372,7 +1380,7 @@ def make_app(rt: DocQARuntime):
             )
             obs.finish(ctx)
             _ask_outcome(200)
-            return with_trace(web.json_response(result), ctx)
+            return with_trace(json_response(result), ctx)
         except Exception:
             obs.finish(ctx, status="error")
             _ask_outcome(500)
@@ -1475,7 +1483,7 @@ def make_app(rt: DocQARuntime):
             )
         except ValueError as e:  # malformed date bounds reject loudly
             return json_error(422, str(e))
-        return web.json_response(rows)
+        return json_response(rows)
 
     async def llm_summarize(req):
         try:
@@ -1508,7 +1516,7 @@ def make_app(rt: DocQARuntime):
                 trace_id=ctx.trace_id if ctx else None,
             )
         obs.finish(ctx)
-        return with_trace(web.json_response({"summary": summary}), ctx)
+        return with_trace(json_response({"summary": summary}), ctx)
 
     # ---- synthesis ----------------------------------------------------------
 
@@ -1543,7 +1551,7 @@ def make_app(rt: DocQARuntime):
             raise
         obs.finish(ctx)
         return with_trace(
-            web.json_response(json.loads(resp.model_dump_json())), ctx
+            json_response(json.loads(resp.model_dump_json())), ctx
         )
 
     async def synthese_comparaison(req):
@@ -1574,7 +1582,7 @@ def make_app(rt: DocQARuntime):
             raise
         obs.finish(ctx)
         return with_trace(
-            web.json_response(json.loads(resp.model_dump_json())), ctx
+            json_response(json.loads(resp.model_dump_json())), ctx
         )
 
     async def index_page(_req):

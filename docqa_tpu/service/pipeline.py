@@ -455,6 +455,11 @@ class DocumentPipeline:
                 continue
             text = body["original_text_masked"]
             md = body.get("metadata", {})
+            published_at = body.get("processed_at")
+            if published_at is not None:
+                DEFAULT_REGISTRY.histogram("clean_queue_lag_s").observe(
+                    max(0.0, time.time() - float(published_at))
+                )
             chunks = chunk_text(text, self.cfg.chunk)
             per_doc.append((body["doc_id"], len(chunks)))
             for ci, ch in enumerate(chunks):

@@ -92,4 +92,6 @@ class DocumentInfo(BaseModel):
     status: str
     doc_type: Optional[str] = None
     patient_id: Optional[str] = None
+    doc_date: Optional[str] = None
     n_chunks: int = 0
+    status_detail: Optional[str] = None

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""docqa-wirecheck Tier B CLI: live wire-contract audit.
+
+Usage:
+    python scripts/wire_audit.py                      # gate (exit 1 on any
+                                                      # contract violation,
+                                                      # coverage gap, or
+                                                      # journal failure)
+    python scripts/wire_audit.py --report out.json    # also write the CI
+                                                      # trend artifact
+    python scripts/wire_audit.py --write-api-docs     # regenerate docs/API.md
+                                                      # from api_contract.json
+    python scripts/wire_audit.py --only "GET /health" # focused run (coverage
+                                                      # gates disabled)
+
+Boots the fake-mode runtime, drives every registered route over real
+HTTP, validates each live response's status, key tree, and JSON leaf
+types against ``api_contract.json``, asserts 100% endpoint coverage in
+both directions (registered ↔ driven ↔ declared), and round-trips a
+broker journal across a simulated restart.  Independent of the static
+``wire-*`` rules by construction: the bytes on the wire are re-parsed
+and re-validated, so neither a ledger edit nor an analyzer blind spot
+can launder drift.  See docs/STATIC_ANALYSIS.md ("Wire contract & live
+audit") and docs/API.md (generated here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--contract", default=None, help="ledger path")
+    ap.add_argument("--report", default=None, help="JSON report path")
+    ap.add_argument(
+        "--only",
+        action="append",
+        default=None,
+        metavar="ENDPOINT",
+        help='restrict to "METHOD /path" keys (repeatable; disables '
+        "the coverage gates)",
+    )
+    ap.add_argument(
+        "--write-api-docs",
+        action="store_true",
+        help="regenerate docs/API.md from the contract and exit",
+    )
+    args = ap.parse_args()
+
+    from docqa_tpu.analysis.wire_audit import (
+        default_api_md_path,
+        render_api_md,
+        run_wire_audit,
+    )
+    from docqa_tpu.analysis.wire_schema import (
+        default_ledger_path,
+        load_contract,
+    )
+
+    if args.write_api_docs:
+        contract = load_contract(args.contract or default_ledger_path())
+        path = default_api_md_path()
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(render_api_md(contract))
+        print(f"wire-audit: wrote {path}")
+        return 0
+
+    report = run_wire_audit(
+        contract_path=args.contract,
+        report_path=args.report,
+        only=args.only,
+    )
+    cov = report["coverage"]
+    if cov.get("checked"):
+        print(
+            f"wire-audit: {cov['driven']}/{cov['registered']} registered "
+            f"endpoints driven, {cov['declared']} declared"
+        )
+        for k in (
+            "not_driven",
+            "not_registered",
+            "undeclared_routes",
+            "stale_entries",
+        ):
+            for key in cov.get(k, []):
+                print(f"wire-audit: COVERAGE {k}: {key}")
+    for key, res in report["endpoints"].items():
+        for v in res["violations"]:
+            print(f"wire-audit: VIOLATION {key}: {v}")
+    for v in report["journal"]["violations"]:
+        print(f"wire-audit: JOURNAL {v}")
+    status = "OK" if report["ok"] else "FAIL"
+    print(
+        f"wire-audit: {status} "
+        f"({report['violations_total']} violation(s))"
+    )
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

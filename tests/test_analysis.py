@@ -3,7 +3,7 @@
 Each rule gets three fixture classes: a seeded violation (detected), the
 same violation with a ``# docqa-lint: disable=<rule>`` suppression
 (silent), and a clean/sanctioned variant (silent).  The gate tests then
-run the full seventeen-checker suite over the real ``docqa_tpu`` tree and
+run the full twenty-checker suite over the real ``docqa_tpu`` tree and
 assert it is exactly in sync with the committed baseline — zero new
 findings AND zero stale entries (the acceptance contract of
 ``scripts/lint.py``).
@@ -854,6 +854,9 @@ class TestTreeGate:
             "shed-taxonomy",
             "spec-shape",
             "thread-lifecycle",
+            "wire-consumer",
+            "wire-safety",
+            "wire-schema",
         ]
 
     def test_tree_in_sync_with_baseline(self):

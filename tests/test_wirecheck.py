@@ -1,0 +1,1068 @@
+"""docqa-wirecheck: fixture tests per wire rule + the Tier-B live audit.
+
+Mirrors tests/test_analysis.py: every rule gets seeded-violation /
+suppressed / clean fixtures, the ledger mechanics (NEW, REMOVED, STALE,
+TODO-justification, model drift) are exercised against tmp contracts,
+and the live audit gates are held for real — one fake-mode boot drives
+all registered endpoints, a second (focused) boot proves a deliberately
+drifted ledger key turns the measured pass red, and the broker journal
+round-trips across a simulated restart.  docs/API.md staleness is a
+failure here too: the committed file must equal ``render_api_md`` of
+the committed contract byte-for-byte.
+"""
+
+import copy
+import json
+import math
+import os
+import textwrap
+
+import pytest
+
+from docqa_tpu.analysis import run
+from docqa_tpu.analysis.core import Package
+from docqa_tpu.analysis.wire_audit import (
+    default_api_md_path,
+    journal_roundtrip,
+    render_api_md,
+    run_wire_audit,
+    validate_value,
+)
+from docqa_tpu.analysis.wire_schema import (
+    default_ledger_path,
+    load_contract,
+    route_table,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "docqa_tpu")
+
+
+def wire_fixture(tmp_path, rule, sources, contract=None):
+    """Write fixture modules (and their own contract ledger, so the
+    repo's real ``api_contract.json`` never leaks in) and run ONE rule."""
+    if contract is not None:
+        (tmp_path / "api_contract.json").write_text(
+            json.dumps(contract)
+        )
+    for name, src in sources.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return run(str(tmp_path), rules=[rule], package_name="fixture")
+
+
+def _contract(endpoints, **extra):
+    data = {"endpoints": endpoints}
+    data.update(extra)
+    return data
+
+
+_HEALTH_ROUTE = """
+def health(_req):
+    return web.json_response({"status": "ok"})
+
+def make_app(app):
+    app.router.add_routes([web.get("/health", health)])
+"""
+
+
+# ---------------------------------------------------------------------------
+# wire-schema
+# ---------------------------------------------------------------------------
+
+
+class TestWireSchema:
+    def test_new_key_detected(self, tmp_path):
+        """The acceptance drill: a key added to a handler but absent
+        from the ledger turns the static pass red."""
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response(
+                        {"status": "ok", "uptime_s": 12.5}
+                    )
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "produces key 'uptime_s'" in findings[0].message
+        assert "bump the entry's version" in findings[0].message
+
+    def test_new_key_suppressed(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response(  # docqa-lint: disable=wire-schema
+                        {"status": "ok", "uptime_s": 12.5}
+                    )
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert findings == []
+
+    def test_declared_payload_clean(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response({"status": "ok"})
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert findings == []
+
+    def test_removed_key_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response({"status": "ok"})
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str", "uptime_s": "float"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "declares key 'uptime_s'" in findings[0].message
+        assert "never produces it" in findings[0].message
+
+    def test_undeclared_route_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response({"status": "ok"})
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract({}),
+        )
+        assert len(findings) == 1
+        assert "not declared" in findings[0].message
+
+    def test_stale_entry_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response({"status": "ok"})
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    },
+                    "GET /gone": {
+                        "handler": "gone",
+                        "version": 3,
+                        "response": {"x": "int"},
+                    },
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert findings[0].symbol == "<ledger>"
+        assert "stale" in findings[0].message
+        assert "GET /gone" in findings[0].message
+
+    def test_todo_entry_rejected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response({"status": "ok"})
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "_note": "TODO tighten this",
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert any("TODO" in f.message for f in findings)
+
+    def test_handler_mismatch_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                def health(_req):
+                    return web.json_response({"status": "ok"})
+
+                def wire(app):
+                    web.get("/health", health)
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "old_health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert any(
+            "names handler 'old_health'" in f.message for f in findings
+        )
+
+    def test_model_drift_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "schemas.py": """
+                from pydantic import BaseModel
+
+                class Health(BaseModel):
+                    status: str
+                    extra_field: int = 0
+                """,
+                "mod.py": _HEALTH_ROUTE,
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "model": "Health",
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "drifted" in findings[0].message
+        assert "extra_field" in findings[0].message
+
+    def test_dead_model_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "schemas.py": """
+                from pydantic import BaseModel
+
+                class Orphan(BaseModel):
+                    x: int
+                """,
+                "mod.py": _HEALTH_ROUTE,
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "dead schema model Orphan" in findings[0].message
+
+    def test_referenced_model_not_dead(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "schemas.py": """
+                from pydantic import BaseModel
+
+                class Query(BaseModel):
+                    question: str
+                """,
+                "mod.py": """
+                from schemas import Query
+
+                def health(req):
+                    q = Query(**req)
+                    return web.json_response({"status": q.question})
+
+                def wire(app):
+                    web.get("/health", health)
+                """,
+            },
+            contract=_contract(
+                {
+                    "GET /health": {
+                        "handler": "health",
+                        "version": 1,
+                        "response": {"status": "str"},
+                    }
+                }
+            ),
+        )
+        assert findings == []
+
+    def test_journal_record_gated(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-schema",
+            {
+                "mod.py": """
+                class Broker:
+                    def _journal_write(self, queue, record):
+                        pass
+
+                    def publish_like(self, queue):
+                        self._journal_write(
+                            queue, {"op": "pub", "surprise": 1}
+                        )
+                """
+            },
+            contract=_contract(
+                {}, journal_record={"op": "str", "tag": "int"}
+            ),
+        )
+        msgs = " | ".join(f.message for f in findings)
+        assert len(findings) == 2
+        assert "journal record key 'surprise'" in msgs
+        assert "missing required key 'tag'" in msgs
+
+
+# ---------------------------------------------------------------------------
+# wire-consumer
+# ---------------------------------------------------------------------------
+
+
+_BROKER_FIXTURE = """
+class Pipeline:
+    def start(self, broker):
+        self.consumer = Consumer(broker, "clean", self._index)
+        broker.publish("clean", {"doc_id": "d1", "text": "hello"})
+
+    def _index(self, bodies, headers=None):
+        for body in bodies:
+            use(body["doc_id"], body[%r])
+"""
+
+
+class TestWireConsumer:
+    def test_undeclared_broker_read_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {"mod.py": _BROKER_FIXTURE % "missing"},
+            contract=_contract({}),
+        )
+        reads = [f for f in findings if "reads key" in f.message]
+        assert len(reads) == 1
+        assert "'missing'" in reads[0].message
+        assert "queue 'clean'" in reads[0].message
+
+    def test_undeclared_broker_read_suppressed(self, tmp_path):
+        src = _BROKER_FIXTURE % "missing"
+        src = src.replace(
+            "body['missing'])",
+            "body['missing'])  # docqa-lint: disable=wire-consumer",
+        )
+        assert "disable=wire-consumer" in src
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {"mod.py": src},
+            contract=_contract({}),
+        )
+        assert all("reads key" not in f.message for f in findings)
+
+    def test_declared_broker_read_clean(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {"mod.py": _BROKER_FIXTURE % "text"},
+            contract=_contract({}),
+        )
+        assert findings == []
+
+    def test_orphan_producer_key_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "mod.py": """
+                class Pipeline:
+                    def start(self, broker):
+                        self.consumer = Consumer(broker, "clean", self._index)
+                        broker.publish(
+                            "clean", {"doc_id": "d1", "nobody_reads": 1}
+                        )
+
+                    def _index(self, bodies, headers=None):
+                        for body in bodies:
+                            use(body["doc_id"])
+                """
+            },
+            contract=_contract({}),
+        )
+        assert len(findings) == 1
+        assert "orphaned producer key" in findings[0].message
+        assert "'nobody_reads'" in findings[0].message
+
+    def test_undeclared_http_read_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "mod.py": """
+                import json
+                from urllib.request import urlopen
+
+                def fetch(url):
+                    with urlopen(url) as r:
+                        return json.loads(r.read())
+
+                def main(base):
+                    st = fetch(f"{base}/api/status")
+                    print(st["nope"])
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /api/status": {
+                        "handler": "api_status",
+                        "version": 1,
+                        "response": {"service": "str"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "'nope'" in findings[0].message
+        assert "GET /api/status" in findings[0].message
+
+    def test_declared_http_read_clean(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "mod.py": """
+                import json
+                from urllib.request import urlopen
+
+                def fetch(url):
+                    with urlopen(url) as r:
+                        return json.loads(r.read())
+
+                def main(base):
+                    st = fetch(f"{base}/api/status")
+                    print(st["service"])
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /api/status": {
+                        "handler": "api_status",
+                        "version": 1,
+                        "response": {"service": "str"},
+                    }
+                }
+            ),
+        )
+        assert findings == []
+
+    def test_unmatched_url_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "mod.py": """
+                import json
+                from urllib.request import urlopen
+
+                def fetch(url):
+                    with urlopen(url) as r:
+                        return json.loads(r.read())
+
+                def main(base):
+                    st = fetch(f"{base}/api/unknown")
+                    return st
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /api/status": {
+                        "handler": "api_status",
+                        "version": 1,
+                        "response": {"service": "str"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "matches no route" in findings[0].message
+
+    def test_tuple_fetch_helper_tagged(self, tmp_path):
+        """soak.py's idiom: the helper returns (status, payload)."""
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "mod.py": """
+                import json
+                from urllib.request import urlopen
+
+                def req(method, path):
+                    with urlopen(path) as r:
+                        return r.status, json.loads(r.read())
+
+                def main():
+                    code, js = req("GET", "/api/status")
+                    return js["oops"]
+                """
+            },
+            contract=_contract(
+                {
+                    "GET /api/status": {
+                        "handler": "api_status",
+                        "version": 1,
+                        "response": {"service": "str"},
+                    }
+                }
+            ),
+        )
+        assert len(findings) == 1
+        assert "'oops'" in findings[0].message
+
+    def test_bench_dotted_path_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "bench.py": """
+                DETAILS = {}
+
+                def bench_qa():
+                    DETAILS["qa_e2e"] = {"p50_ms": 1.0, "p95_ms": 2.0}
+                """,
+                "gate.py": """
+                CHECKS = ["qa_e2e.p50_ms", "qa_e2e.p999_ms"]
+                """,
+            },
+            contract=_contract({}),
+        )
+        assert len(findings) == 1
+        assert "'p999_ms'" in findings[0].message
+        assert "qa_e2e" in findings[0].message
+
+    def test_open_bench_section_not_checked(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "bench.py": """
+                DETAILS = {}
+
+                def bench_qa():
+                    DETAILS["qa_e2e"] = build_details()
+                """,
+                "gate.py": """
+                CHECKS = ["qa_e2e.anything_at_all"]
+                """,
+            },
+            contract=_contract({}),
+        )
+        assert findings == []
+
+    def test_undeclared_journal_read_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-consumer",
+            {
+                "mod.py": """
+                import json
+
+                def _replay(lines):
+                    for line in lines:
+                        rec = json.loads(line)
+                        use(rec["op"], rec["oops"])
+                """
+            },
+            contract=_contract(
+                {}, journal_record={"op": "str", "tag": "int"}
+            ),
+        )
+        assert len(findings) == 1
+        assert "'oops'" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# wire-safety
+# ---------------------------------------------------------------------------
+
+
+class TestWireSafety:
+    def test_numpy_scalar_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import numpy as np
+                from aiohttp import web
+
+                def handler(_req):
+                    p50 = np.percentile([1.0, 2.0], 50)
+                    return web.json_response({"p50": p50})
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "numpy scalar" in findings[0].message
+        assert "json_response" in findings[0].message
+
+    def test_numpy_scalar_suppressed(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import numpy as np
+                from aiohttp import web
+
+                def handler(_req):
+                    p50 = np.percentile([1.0, 2.0], 50)
+                    return web.json_response({"p50": p50})  # docqa-lint: disable=wire-safety
+                """
+            },
+        )
+        assert findings == []
+
+    def test_float_coercion_clean(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import numpy as np
+                from aiohttp import web
+
+                def handler(_req):
+                    p50 = np.percentile([1.0, 2.0], 50)
+                    return web.json_response({"p50": float(p50)})
+                """
+            },
+        )
+        assert findings == []
+
+    def test_to_wire_wrapper_sanctions_sites(self, tmp_path):
+        """Calls routed through a local to_wire-coercing wrapper (the
+        app.py pattern) are sanctioned even with tainted facts."""
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import numpy as np
+                from aiohttp import web
+
+                from wirelib import to_wire
+
+                def json_response(payload, **kw):
+                    return web.json_response(to_wire(payload), **kw)
+
+                def handler(_req):
+                    p50 = np.percentile([1.0, 2.0], 50)
+                    return json_response({"p50": p50})
+                """,
+                "wirelib.py": """
+                def to_wire(payload):
+                    return payload
+                """,
+            },
+        )
+        assert findings == []
+
+    def test_device_array_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import jax.numpy as jnp
+                from aiohttp import web
+
+                def handler(_req):
+                    emb = jnp.zeros((4,))
+                    return web.json_response({"embedding": emb})
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "device array" in findings[0].message
+
+    def test_lock_in_broker_body_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import threading
+
+                def enqueue(broker):
+                    guard = threading.Lock()
+                    broker.publish("q", {"guard": guard})
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "lock" in findings[0].message
+        assert "broker publish" in findings[0].message
+
+    def test_nonfinite_float_detected(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                from aiohttp import web
+
+                def handler(_req):
+                    ratio = float("nan")
+                    return web.json_response({"ratio": ratio})
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "non-finite float" in findings[0].message
+
+    def test_journal_write_boundary_checked(self, tmp_path):
+        findings = wire_fixture(
+            tmp_path,
+            "wire-safety",
+            {
+                "mod.py": """
+                import numpy as np
+
+                def journal(broker, queue):
+                    n = np.sum([1, 2])
+                    broker._journal_write(queue, {"op": "pub", "n": n})
+                """
+            },
+        )
+        assert len(findings) == 1
+        assert "journal write" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# to_wire() boundary coercion (the wire-safety fix)
+# ---------------------------------------------------------------------------
+
+
+class TestToWire:
+    def test_numpy_scalars_become_native(self):
+        import numpy as np
+
+        from docqa_tpu.service.wire import to_wire
+
+        out = to_wire(
+            {"p50": np.float64(1.5), "n": np.int32(3), "ok": True}
+        )
+        assert out == {"p50": 1.5, "n": 3, "ok": True}
+        assert type(out["p50"]) is float
+        assert type(out["n"]) is int
+        json.dumps(out)  # round-trips
+
+    def test_numpy_array_becomes_list(self):
+        import numpy as np
+
+        from docqa_tpu.service.wire import to_wire
+
+        out = to_wire({"xs": np.array([1.0, 2.0])})
+        assert out == {"xs": [1.0, 2.0]}
+        json.dumps(out)
+
+    def test_nonfinite_nulled_and_flagged(self):
+        from docqa_tpu.service.wire import to_wire
+
+        out = to_wire(
+            {"a": float("nan"), "b": {"c": float("inf")}, "d": 1.0}
+        )
+        assert out["a"] is None
+        assert out["b"]["c"] is None
+        assert out["d"] == 1.0
+        assert out["_nonfinite_fields"] == ["a", "b.c"]
+        assert "NaN" not in json.dumps(out)
+
+    def test_nonfinite_in_list_path(self):
+        from docqa_tpu.service.wire import to_wire
+
+        out = to_wire({"xs": [1.0, float("-inf")]})
+        assert out["xs"] == [1.0, None]
+        assert out["_nonfinite_fields"] == ["xs[1]"]
+
+    def test_tuple_becomes_list_and_scalars_pass(self):
+        from docqa_tpu.service.wire import to_wire
+
+        assert to_wire({"t": (1, "x")}) == {"t": [1, "x"]}
+        assert to_wire("plain") == "plain"
+        assert to_wire(None) is None
+
+    def test_nonfinite_root_not_annotated(self):
+        from docqa_tpu.service.wire import to_wire
+
+        flagged = []
+        assert to_wire(float("nan"), flagged=flagged) is None
+        assert flagged == [""]  # root path is empty — caller's problem
+
+    def test_numpy_nan_inside_array(self):
+        import numpy as np
+
+        from docqa_tpu.service.wire import to_wire
+
+        out = to_wire({"xs": np.array([1.0, np.nan])})
+        assert out["xs"] == [1.0, None]
+        assert out["_nonfinite_fields"] == ["xs[1]"]
+
+
+# ---------------------------------------------------------------------------
+# the committed ledger itself
+# ---------------------------------------------------------------------------
+
+
+class TestCommittedContract:
+    @pytest.fixture(scope="class")
+    def contract(self):
+        return load_contract(default_ledger_path())
+
+    @pytest.fixture(scope="class")
+    def real_routes(self):
+        return route_table(Package.load(PKG, "docqa_tpu"))
+
+    def test_every_route_declared(self, contract, real_routes):
+        assert real_routes, "route table derivation found no routes"
+        declared = set(contract["endpoints"])
+        registered = {r.key for r in real_routes}
+        assert registered - declared == set()
+        assert declared - registered == set()
+
+    def test_zero_todo_entries(self, contract):
+        for key, entry in contract["endpoints"].items():
+            assert "TODO" not in json.dumps(entry), key
+
+    def test_versions_positive(self, contract):
+        for key, entry in contract["endpoints"].items():
+            assert isinstance(entry.get("version"), int), key
+            assert entry["version"] >= 1, key
+
+    def test_handlers_match(self, contract, real_routes):
+        by_key = {r.key: r.handler for r in real_routes}
+        for key, entry in contract["endpoints"].items():
+            assert entry.get("handler") == by_key[key], key
+
+    def test_api_md_not_stale(self, contract):
+        path = default_api_md_path()
+        assert os.path.exists(path), (
+            "docs/API.md missing — run "
+            "`python scripts/wire_audit.py --write-api-docs`"
+        )
+        with open(path, encoding="utf-8") as f:
+            committed = f.read()
+        assert committed == render_api_md(contract), (
+            "docs/API.md is stale — regenerate with "
+            "`python scripts/wire_audit.py --write-api-docs`"
+        )
+
+
+# ---------------------------------------------------------------------------
+# validate_value (the live audit's type lattice)
+# ---------------------------------------------------------------------------
+
+
+class TestValidateValue:
+    def test_scalars_and_unions(self):
+        assert validate_value("x", "str") == []
+        assert validate_value(None, "str|null") == []
+        assert validate_value(3, "number") == []
+        assert validate_value(3.5, "int") != []
+        assert validate_value(True, "int") != []  # bool is not an int here
+        assert validate_value(True, "bool") == []
+
+    def test_dict_required_optional_star(self):
+        spec = {"a": "int", "b?": "str", "*": "any"}
+        assert validate_value({"a": 1}, spec) == []
+        assert validate_value({"a": 1, "b": "x", "z": []}, spec) == []
+        assert any(
+            "missing required key 'a'" in v
+            for v in validate_value({}, spec)
+        )
+
+    def test_closed_dict_rejects_extras_open_tolerates(self):
+        spec = {"a": "int"}
+        assert any(
+            "undeclared key 'z'" in v
+            for v in validate_value({"a": 1, "z": 2}, spec)
+        )
+        assert validate_value({"a": 1, "z": 2}, spec, open_=True) == []
+
+    def test_nonfinite_flag_key_always_tolerated(self):
+        spec = {"a": "float|null"}
+        assert (
+            validate_value({"a": None, "_nonfinite_fields": ["a"]}, spec)
+            == []
+        )
+
+    def test_list_elements_validated(self):
+        assert validate_value([{"x": 1}], [{"x": "int"}]) == []
+        assert any(
+            "expected int" in v
+            for v in validate_value([{"x": "s"}], [{"x": "int"}])
+        )
+
+
+# ---------------------------------------------------------------------------
+# Tier B: the live audit
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def audit_report(tmp_path_factory):
+    """One fake-mode boot driving every registered endpoint."""
+    path = tmp_path_factory.mktemp("wire") / "wire_audit_report.json"
+    return run_wire_audit(report_path=str(path)), str(path)
+
+
+class TestLiveAudit:
+    def test_audit_green(self, audit_report):
+        report, _ = audit_report
+        assert report["ok"], json.dumps(report, indent=2)[:4000]
+
+    def test_full_endpoint_coverage(self, audit_report):
+        """The acceptance gate: 100% of registered routes driven, and
+        the driven/registered/declared sets agree exactly."""
+        report, _ = audit_report
+        cov = report["coverage"]
+        assert cov["checked"]
+        assert cov["driven"] == cov["registered"] == cov["declared"]
+        assert cov["not_driven"] == []
+        assert cov["not_registered"] == []
+        assert cov["undeclared_routes"] == []
+        assert cov["stale_entries"] == []
+
+    def test_report_artifact_written(self, audit_report):
+        report, path = audit_report
+        with open(path, encoding="utf-8") as f:
+            on_disk = json.load(f)
+        assert on_disk["ok"] == report["ok"]
+        assert on_disk["coverage"]["driven"] == report["coverage"][
+            "driven"
+        ]
+
+    def test_journal_roundtrip_green(self, audit_report):
+        report, _ = audit_report
+        assert report["journal"]["ok"], report["journal"]["violations"]
+
+    def test_drifted_ledger_turns_audit_red(self):
+        """The acceptance drill, measured half: a handler key the
+        ledger does not declare fails the live audit regardless of the
+        static pass."""
+        contract = copy.deepcopy(load_contract(default_ledger_path()))
+        contract["endpoints"]["GET /health"]["response"].pop("status")
+        report = run_wire_audit(
+            contract=contract,
+            only=["GET /health"],
+            skip_journal=True,
+        )
+        assert not report["ok"]
+        violations = report["endpoints"]["GET /health"]["violations"]
+        assert any("undeclared key 'status'" in v for v in violations)
+
+
+class TestJournalRoundtrip:
+    def test_roundtrip_standalone(self, tmp_path):
+        result = journal_roundtrip(journal_dir=str(tmp_path))
+        assert result["ok"], result["violations"]
+
+    def test_spec_violation_flagged(self, tmp_path):
+        """Against a deliberately narrowed journal_record spec, the
+        broker's real pub records (which carry 'body'/'headers') must
+        flag — proving the per-record validation actually bites."""
+        contract = {"journal_record": {"op": "str", "tag": "int"}}
+        result = journal_roundtrip(
+            journal_dir=str(tmp_path), contract=contract
+        )
+        assert not result["ok"]
+        assert any(
+            "undeclared key 'body'" in v for v in result["violations"]
+        )
