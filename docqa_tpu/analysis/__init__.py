@@ -1,6 +1,6 @@
 """docqa-lint: AST invariant analysis for the docqa_tpu tree.
 
-Twenty project-specific checkers (docs/STATIC_ANALYSIS.md):
+Twenty-four project-specific checkers (docs/STATIC_ANALYSIS.md):
 
 * ``cv-protocol``     — condition waits in predicate loops, notify under
   the lock, request-path waits carry a Deadline.
@@ -10,6 +10,9 @@ Twenty project-specific checkers (docs/STATIC_ANALYSIS.md):
 * ``donation``        — buffers donated to jitted calls aren't read after.
 * ``dtype-flow``      — bf16/int8 matmuls accumulate f32; bf16 reductions
   upcast; no float64 / silent widening in device code.
+* ``entropy-in-state``— no wall-clock/uuid/urandom values in cache keys,
+  prefix keys, or replayed journal records; telemetry timestamp fields
+  are sanctioned by naming convention.
 * ``guarded-state``   — a field written under a lock anywhere is accessed
   under that lock everywhere (per-class + cross-object bridge facts).
 * ``host-sync``       — no blocking device→host syncs on the /ask path
@@ -19,8 +22,15 @@ Twenty project-specific checkers (docs/STATIC_ANALYSIS.md):
   acquisition graph); no blocking I/O under a lock.
 * ``mesh-axes``       — sharding/collective axis names resolve to the
   declared mesh; collectives stay inside their ``shard_map``.
+* ``order-stability`` — set/listdir/glob iteration (and dict iteration
+  inside order-sink functions) feeding pack order, batch assembly, key
+  construction, or journal serialization must be sorted or justified
+  via ``# docqa-lint: ordered(<reason>)``.
 * ``phi-taint``       — raw pre-deid text never reaches logs/metrics/
   external payloads.
+* ``replay-key-integrity`` — no builtin ``hash()`` of str/bytes in
+  cross-restart-persistent keys (per-process hash salting); hashlib/
+  crc32/pure-integer arithmetic are the sanctioned derivations.
 * ``resource-flow``   — every acquired resource (KV block table, cost
   record, spine ticket, trace) reaches exactly one release on every
   control-flow path: leak-on-exception-edge, double-release, and
@@ -30,6 +40,11 @@ Twenty project-specific checkers (docs/STATIC_ANALYSIS.md):
   (stale entries fail).
 * ``retrace-hazard``  — jit wrappers are built once and reused; static
   arguments stay hashable and stable.
+* ``rng-discipline``  — jax.random keys are affine on the serving path
+  (consume once, then split/fold_in); no literal ``PRNGKey`` reachable
+  from the request path (per-request keys come from the counter-minted
+  scheme); no module-global numpy/``random`` RNG on device-result or
+  replay-key paths.
 * ``shed-taxonomy``   — every raise reachable from the request path is a
   ledgered typed shed in ``shed_taxonomy.json`` carrying its declared
   HTTP status, cost outcome, and trace flag; bare ``Exception`` raises
@@ -68,16 +83,22 @@ blind spots — and in ``analysis/wire_audit.py`` (docs/STATIC_ANALYSIS.md
 "Wire contract"): boot the fake-mode runtime, drive every registered
 route over real HTTP, validate each live response key tree and JSON
 types against ``api_contract.json``, and round-trip a broker journal
-across a simulated restart.
+across a simulated restart — and in ``analysis/replay_audit.py``
+(docs/STATIC_ANALYSIS.md "Replay witness"): run the deterministic CPU
+smoke twice under identical seeds but different hash salts and gate on
+bitwise equality of token streams, retrieval ids, journal replay, and
+the shadow-sampler selection, with every entropy source in the tree
+ledgered and justified in ``determinism_manifest.json``.
 
 Entry points: ``scripts/lint.py`` / ``scripts/shard_audit.py`` /
 ``scripts/compile_audit.py`` / ``scripts/serve_cluster_loop.py`` /
-``scripts/ledger_audit.py`` / ``scripts/wire_audit.py`` (CLIs) and
+``scripts/ledger_audit.py`` / ``scripts/wire_audit.py`` /
+``scripts/replay_audit.py`` (CLIs) and
 ``pytest -m lint`` (tier-1 gate, tests/test_analysis.py,
 tests/test_numcheck.py, tests/test_shardcheck.py,
 tests/test_racecheck.py, tests/test_shard_audit.py,
 tests/test_compile_audit.py, tests/test_lifecheck.py,
-tests/test_wirecheck.py).
+tests/test_wirecheck.py, tests/test_detcheck.py).
 """
 
 from docqa_tpu.analysis.core import (  # noqa: F401

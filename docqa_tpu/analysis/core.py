@@ -491,15 +491,19 @@ def all_checkers() -> Dict[str, object]:
     from docqa_tpu.analysis.dispatch_streams import DispatchStreamsChecker
     from docqa_tpu.analysis.donation import DonationChecker
     from docqa_tpu.analysis.dtype_flow import DtypeFlowChecker
+    from docqa_tpu.analysis.entropy_state import EntropyStateChecker
     from docqa_tpu.analysis.guarded_state import GuardedStateChecker
     from docqa_tpu.analysis.host_sync import HostSyncChecker
     from docqa_tpu.analysis.jit_purity import JitPurityChecker
     from docqa_tpu.analysis.lock_discipline import LockDisciplineChecker
     from docqa_tpu.analysis.mesh_axes import MeshAxesChecker
+    from docqa_tpu.analysis.order_stability import OrderStabilityChecker
     from docqa_tpu.analysis.phi_taint import PhiTaintChecker
+    from docqa_tpu.analysis.replay_keys import ReplayKeyChecker
     from docqa_tpu.analysis.resource_flow import ResourceFlowChecker
     from docqa_tpu.analysis.retire_once import RetireOnceChecker
     from docqa_tpu.analysis.retrace_hazard import RetraceHazardChecker
+    from docqa_tpu.analysis.rng_discipline import RngDisciplineChecker
     from docqa_tpu.analysis.shed_taxonomy import ShedTaxonomyChecker
     from docqa_tpu.analysis.spec_shape import SpecShapeChecker
     from docqa_tpu.analysis.thread_lifecycle import ThreadLifecycleChecker
@@ -513,15 +517,19 @@ def all_checkers() -> Dict[str, object]:
         DispatchStreamsChecker(),
         DonationChecker(),
         DtypeFlowChecker(),
+        EntropyStateChecker(),
         GuardedStateChecker(),
         HostSyncChecker(),
         JitPurityChecker(),
         LockDisciplineChecker(),
         MeshAxesChecker(),
+        OrderStabilityChecker(),
         PhiTaintChecker(),
+        ReplayKeyChecker(),
         ResourceFlowChecker(),
         RetireOnceChecker(),
         RetraceHazardChecker(),
+        RngDisciplineChecker(),
         ShedTaxonomyChecker(),
         SpecShapeChecker(),
         ThreadLifecycleChecker(),

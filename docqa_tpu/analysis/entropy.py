@@ -1,0 +1,146 @@
+"""Shared entropy-source classification for docqa-detcheck.
+
+Every determinism gate in this repo is a *replay* gate: two runs under
+the same seeds must produce bitwise-identical token streams, retrieval
+ids, and journal states.  The enemy is entropy — values a process mints
+that the next process (or the same process restarted) cannot re-mint.
+This module is the one place that knows what counts as an entropy
+source; the four detcheck rules and the replay-witness manifest
+(``determinism_manifest.json``) all classify through it so the static
+rules, the dynamic gate, and the ledger can never disagree about what
+"entropy" means.
+
+Kinds:
+
+* ``rng`` — explicit RNG mints: ``jax.random.PRNGKey``/``key``,
+  ``np.random.default_rng``, ``random.Random``, seeding calls.  Sanctioned
+  when the seed derives from config/request state (the manifest entry
+  records the derivation);
+* ``process`` — per-process entropy that can NEVER replay: ``os.urandom``,
+  ``secrets.*``, ``uuid.uuid1``/``uuid4``.  Sanctioned only when the value
+  is minted once and *persisted* (replay reads it back, never re-mints) or
+  is deliberately process-local (the PHI unlinkability salt);
+* ``wallclock`` — ``time.time``/``time_ns``, ``datetime.now``/``utcnow``:
+  identity-capable clocks (two runs read different values).  Sanctioned for
+  telemetry timestamps and scheduling fields, never for keys.
+
+Monotonic *interval* clocks (``perf_counter``, ``monotonic``) are
+deliberately NOT enumerated into the manifest: an interval clock measures
+durations and cannot mint identity, and the tree reads one in nearly every
+module — ledgering ~100 sites would bury the entries that matter.  The
+entropy-in-state rule still polices them at key sinks (a perf_counter
+value concatenated into a cache key is exactly as unreplayable as
+``time.time``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from docqa_tpu.analysis.core import (
+    Module,
+    Package,
+    call_name,
+    stmt_walk,
+)
+
+# resolved dotted call -> entropy kind (exact matches)
+RNG_MINTS = frozenset(
+    {
+        "jax.random.PRNGKey",
+        "jax.random.key",
+        "numpy.random.default_rng",
+        "numpy.random.RandomState",
+        "numpy.random.SeedSequence",
+        "numpy.random.seed",
+        "random.Random",
+        "random.seed",
+    }
+)
+PROCESS_SOURCES = frozenset(
+    {
+        "os.urandom",
+        "uuid.uuid1",
+        "uuid.uuid4",
+    }
+)
+WALLCLOCK_SOURCES = frozenset(
+    {
+        "time.time",
+        "time.time_ns",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "datetime.date.today",
+    }
+)
+# interval clocks: policed at sinks by entropy-in-state, excluded from
+# the manifest enumeration (see module docstring)
+MONOTONIC_CLOCKS = frozenset(
+    {
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+    }
+)
+
+
+def classify_entropy_call(
+    module: Module, node: ast.Call
+) -> Optional[Tuple[str, str]]:
+    """(kind, resolved-dotted-name) for an entropy-minting call, else
+    None.  Resolution goes through the module's import-alias map, so
+    ``jrandom.PRNGKey`` and ``from time import time`` both classify."""
+    name = call_name(node)
+    if not name:
+        return None
+    resolved = module.resolve_alias(name)
+    if resolved in RNG_MINTS:
+        return ("rng", resolved)
+    if resolved in PROCESS_SOURCES or resolved.startswith("secrets."):
+        return ("process", resolved)
+    if resolved in WALLCLOCK_SOURCES:
+        return ("wallclock", resolved)
+    return None
+
+
+def enumerate_entropy_sites(package: Package) -> List[Dict[str, str]]:
+    """Every sanctioned-or-not entropy mint in the package, one entry per
+    (kind, path, symbol, call) — the unit the determinism manifest
+    ledgers.  Multiple same-call sites inside one function collapse to
+    one entry (the justification covers the function's scheme, not each
+    textual occurrence), so line drift never churns the manifest."""
+    seen = {}
+    for fn in package.functions:
+        for node in stmt_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = classify_entropy_call(fn.module, node)
+            if hit is None:
+                continue
+            kind, call = hit
+            key = (kind, fn.module.relpath, fn.qualname, call)
+            seen.setdefault(key, getattr(node, "lineno", 1))
+    for module in package.modules:
+        for node in stmt_walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = classify_entropy_call(module, node)
+            if hit is None:
+                continue
+            kind, call = hit
+            key = (kind, module.relpath, "<module>", call)
+            seen.setdefault(key, getattr(node, "lineno", 1))
+    out = [
+        {
+            "kind": kind,
+            "path": path,
+            "symbol": symbol,
+            "call": call,
+            "line": line,
+        }
+        for (kind, path, symbol, call), line in seen.items()
+    ]
+    out.sort(key=lambda e: (e["path"], e["symbol"], e["call"]))
+    return out

@@ -1,0 +1,271 @@
+"""docqa-detcheck Tier B: the bitwise replay witness.
+
+The four detcheck rules (rng-discipline, replay-key-integrity,
+order-stability, entropy-in-state) are static over-approximations; this
+module holds the *dynamic* side of the same contract: two smoke runs
+under identical seeds — fresh interpreter each, different
+``PYTHONHASHSEED`` so salted-hash and set-order bugs cannot hide — must
+produce bitwise-identical results.  ``scripts/replay_audit.py`` drives
+the runs and calls into here for everything pure:
+
+* :func:`compare_transcripts` — the equality gate over two run
+  transcripts: per-request token streams (bitwise), retrieval result
+  ids, broker-journal document states across a simulated restart, and
+  the recallscope shadow-sampler selection set.  Returns a divergence
+  report (first-diverging request, token index, stage attribution) —
+  the CI artifact an operator starts from;
+* the determinism manifest — ``determinism_manifest.json`` ledgers
+  every sanctioned entropy source in the tree (enumerated by
+  :func:`docqa_tpu.analysis.entropy.enumerate_entropy_sites`) with a
+  human justification.  NEW sites (unledgered entropy) and STALE
+  entries (ledgered sites that no longer exist) both fail, exactly like
+  the lint baseline; so does any TODO justification.  ``--write-manifest``
+  regenerates the ledger but CANNOT launder a divergence: the gate
+  re-derives equality from the measurement, and fresh entries carry a
+  TODO that itself fails until a human justifies the source.
+
+Stage attribution order follows the request path: a decode divergence
+is reported first (it usually *causes* downstream retrieval/journal
+diffs in a real serving stack), then retrieval, journal, shadow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+MANIFEST_FILENAME = "determinism_manifest.json"
+_TODO_MARK = "TODO"
+
+
+def default_manifest_path() -> str:
+    """``<repo>/determinism_manifest.json`` (repo root = parent of the
+    ``docqa_tpu`` package directory, same convention as the lint
+    baseline)."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_dir), MANIFEST_FILENAME)
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def _site_key(entry: Dict[str, Any]) -> Tuple[str, str, str, str]:
+    """Manifest identity: (kind, path, symbol, call) — deliberately not
+    the line number, so unrelated edits don't churn the ledger."""
+    return (
+        entry.get("kind", ""),
+        entry.get("path", ""),
+        entry.get("symbol", ""),
+        entry.get("call", ""),
+    )
+
+
+def load_manifest(path: str) -> List[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def save_manifest(path: str, entries: Sequence[Dict[str, Any]]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"entries": list(entries)}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def manifest_split(
+    sites: Sequence[Dict[str, Any]], entries: Sequence[Dict[str, Any]]
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Partition into (new-sites, matched-sites, stale-entries)."""
+    by_key = {_site_key(e): e for e in entries}
+    new: List[Dict[str, Any]] = []
+    matched: List[Dict[str, Any]] = []
+    seen = set()
+    for s in sites:
+        key = _site_key(s)
+        if key in by_key:
+            matched.append(s)
+            seen.add(key)
+        else:
+            new.append(s)
+    stale = [e for k, e in by_key.items() if k not in seen]
+    return new, matched, stale
+
+
+def manifest_todos(
+    entries: Sequence[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Entries whose justification is missing or still a TODO — a
+    freshly ``--write-manifest``-ed site stays failing until a human
+    writes down WHY the entropy source is sanctioned."""
+    out = []
+    for e in entries:
+        j = str(e.get("justification", "")).strip()
+        if not j or j.upper().startswith(_TODO_MARK):
+            out.append(e)
+    return out
+
+
+def updated_manifest(
+    sites: Sequence[Dict[str, Any]],
+    old_entries: Sequence[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """The ``--write-manifest`` result: one entry per current site,
+    preserving the justification of every entry that still matches;
+    new sites get an explicit TODO (which fails the gate)."""
+    keep = {_site_key(e): e.get("justification", "") for e in old_entries}
+    out = []
+    for s in sites:
+        entry = {
+            "kind": s["kind"],
+            "path": s["path"],
+            "symbol": s["symbol"],
+            "call": s["call"],
+            "justification": keep.get(_site_key(s), "")
+            or "TODO: justify this entropy source",
+        }
+        out.append(entry)
+    out.sort(key=_site_key)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# transcript comparison
+# ---------------------------------------------------------------------------
+
+
+def _first_token_diff(a: Sequence[int], b: Sequence[int]) -> int:
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            return i
+    return min(len(a), len(b))
+
+
+def _by_id(items: Sequence[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    return {str(r["id"]): r for r in items}
+
+
+def compare_transcripts(
+    run_a: Dict[str, Any], run_b: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Bitwise equality gate over two smoke transcripts.
+
+    Returns ``{"equal", "divergences", "first_divergence"}``; each
+    divergence carries ``stage`` plus stage-specific attribution
+    (request id + token index for decode, query id for retrieval,
+    queue/doc for journal).
+    """
+    divergences: List[Dict[str, Any]] = []
+
+    # -- stage: decode (per-request token streams, bitwise) ------------------
+    req_a = _by_id(run_a.get("decode", {}).get("requests", []))
+    req_b = _by_id(run_b.get("decode", {}).get("requests", []))
+    for rid in sorted(set(req_a) | set(req_b)):
+        ra, rb = req_a.get(rid), req_b.get(rid)
+        if ra is None or rb is None:
+            divergences.append(
+                {
+                    "stage": "decode",
+                    "request": rid,
+                    "detail": "request present in only one run",
+                }
+            )
+            continue
+        ta, tb = list(ra.get("tokens", [])), list(rb.get("tokens", []))
+        if ta != tb:
+            divergences.append(
+                {
+                    "stage": "decode",
+                    "request": rid,
+                    "phase": ra.get("phase"),
+                    "token_index": _first_token_diff(ta, tb),
+                    "len_a": len(ta),
+                    "len_b": len(tb),
+                    "detail": "token streams diverge",
+                }
+            )
+
+    # -- stage: retrieval (result ids, ordered) ------------------------------
+    q_a = _by_id(run_a.get("retrieval", {}).get("queries", []))
+    q_b = _by_id(run_b.get("retrieval", {}).get("queries", []))
+    for qid in sorted(set(q_a) | set(q_b)):
+        qa, qb = q_a.get(qid), q_b.get(qid)
+        if qa is None or qb is None:
+            divergences.append(
+                {
+                    "stage": "retrieval",
+                    "query": qid,
+                    "detail": "query present in only one run",
+                }
+            )
+            continue
+        if list(qa.get("doc_ids", [])) != list(qb.get("doc_ids", [])):
+            divergences.append(
+                {
+                    "stage": "retrieval",
+                    "query": qid,
+                    "detail": "retrieval result ids differ",
+                    "doc_ids_a": list(qa.get("doc_ids", [])),
+                    "doc_ids_b": list(qb.get("doc_ids", [])),
+                }
+            )
+
+    # -- stage: journal (restart convergence, within and across runs) --------
+    for label, run in (("run_a", run_a), ("run_b", run_b)):
+        j = run.get("journal", {})
+        if j and j.get("doc_states_pre") != j.get("doc_states_post"):
+            divergences.append(
+                {
+                    "stage": "journal",
+                    "detail": f"{label}: journal replay did not converge "
+                    "to the pre-restart document states",
+                }
+            )
+    ja = run_a.get("journal", {}).get("doc_states_post")
+    jb = run_b.get("journal", {}).get("doc_states_post")
+    if ja != jb:
+        diff_docs = sorted(
+            k
+            for k in set(ja or {}) | set(jb or {})
+            if (ja or {}).get(k) != (jb or {}).get(k)
+        )
+        divergences.append(
+            {
+                "stage": "journal",
+                "detail": "post-restart document states differ across runs",
+                "docs": diff_docs,
+            }
+        )
+    da = run_a.get("journal", {}).get("drained")
+    db = run_b.get("journal", {}).get("drained")
+    if da != db:
+        divergences.append(
+            {
+                "stage": "journal",
+                "detail": "replayed delivery order/content differs "
+                "across runs",
+            }
+        )
+
+    # -- stage: shadow sampler (identical request selection set) -------------
+    sa = run_a.get("shadow", {})
+    sb = run_b.get("shadow", {})
+    if list(sa.get("selected", [])) != list(sb.get("selected", [])):
+        divergences.append(
+            {
+                "stage": "shadow_sampler",
+                "detail": "shadow sampler selected different request sets",
+                "selected_a": list(sa.get("selected", [])),
+                "selected_b": list(sb.get("selected", [])),
+            }
+        )
+
+    return {
+        "equal": not divergences,
+        "divergences": divergences,
+        "first_divergence": divergences[0] if divergences else None,
+    }

@@ -1,0 +1,451 @@
+"""rng-discipline: jax.random keys are affine on the serving path.
+
+JAX's functional RNG makes determinism *checkable*: a key is an explicit
+value, and the contract is affine use — consume a key at most once (a
+sampling call, or handing it to a callee), and derive every further key
+with ``split``/``fold_in``.  Reusing a consumed key silently correlates
+draws that must be independent; a *fixed* ``PRNGKey(<literal>)`` on the
+request path makes every request sample identically — both break the
+bitwise replay gates (warm==cold, spec on==off) in ways no test that
+only runs one process can see.
+
+Scope: the /ask chain (``deadline_flow.REQUEST_PATH_MODULES``) plus the
+decode/batching engines and the broker (whose redelivery jitter must
+come from seeded state); fixtures opt in with
+the ``docqa-lint: request-path`` pragma.
+
+Findings:
+
+1. ``jax.random.PRNGKey(<numeric literal>)`` / ``jax.random.key(<lit>)``
+   — a fixed key reachable from the request path.  Per-request keys must
+   derive from the counter-minted scheme (``serve._next_rng`` /
+   ``GenerateEngine.next_request_key``: ``PRNGKey(seed * 100_003 +
+   counter)``).  Structural exemptions, not baselines: a literal key
+   inside ``.lower(...)`` arguments (an AOT shape probe traces shapes,
+   never draws), and the body of ``greedy_dummy_key`` (the one declared
+   constructor for keys that greedy paths thread but never consume).
+2. Key reuse: a tracked key name (minted by ``PRNGKey``/``key``/
+   ``split``/``fold_in``/the counter scheme, or a parameter named
+   ``rng``/``key``/``rng_key``/``prng_key``) passed to a second call
+   without an intervening rebind from a derive.  Loop bodies are scanned
+   twice so a consume-without-rebind inside a loop flags; ``if``/``else``
+   branches merge conservatively (consumed in either arm counts).
+3. Module-level RNG (``np.random.<fn>`` bar ``default_rng``-family,
+   bare ``random.<fn>`` bar ``random.Random``) — global mutable RNG
+   state in device-result or replay-key paths; use a seeded generator
+   instance or the engine key scheme.
+
+Resolution is name-based (the chassis has no type system): only bare
+names are tracked (``self._rng`` attributes escape), and a tracked name
+returned or stored escapes tracking rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from docqa_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Module,
+    Package,
+    call_name,
+)
+from docqa_tpu.analysis.deadline_flow import REQUEST_PATH_MODULES
+
+RNG_SCOPE_MODULES = REQUEST_PATH_MODULES | frozenset(
+    {
+        "docqa_tpu.engines.generate",
+        "docqa_tpu.engines.paged",
+        "docqa_tpu.engines.qos",
+        "docqa_tpu.engines.seq2seq",
+        "docqa_tpu.service.broker",
+    }
+)
+
+# The declared constructor for keys greedy paths thread but never
+# consume (temperature==0.0 takes the argmax branch; the sampling key is
+# dead).  The checker exempts its BODY structurally — callers get a
+# dummy key without owning a literal-key site.
+GREEDY_DUMMY_KEY = "greedy_dummy_key"
+
+_KEY_MINTS = frozenset({"jax.random.PRNGKey", "jax.random.key"})
+_KEY_DERIVES = frozenset({"jax.random.split", "jax.random.fold_in"})
+# counter-minted per-request scheme accessors (serve.py / generate.py)
+_KEY_SCHEME_TAILS = frozenset(
+    {"next_request_key", "_next_rng", GREEDY_DUMMY_KEY}
+)
+_KEY_PARAMS = frozenset({"rng", "key", "rng_key", "prng_key"})
+_NP_RANDOM_OK = frozenset(
+    {"default_rng", "Generator", "RandomState", "SeedSequence"}
+)
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_numeric_literal(node.operand)
+    return False
+
+
+class RngDisciplineChecker:
+    rule = "rng-discipline"
+
+    def check(self, package: Package) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in package.functions:
+            module = fn.module
+            if not (
+                module.name in RNG_SCOPE_MODULES
+                or module.request_path_pragma
+            ):
+                continue
+            self._scan(fn, out)
+        for module in package.modules:
+            if not (
+                module.name in RNG_SCOPE_MODULES
+                or module.request_path_pragma
+            ):
+                continue
+            self._scan_module_level(module, out)
+        return out
+
+    # -- shared call checks ---------------------------------------------------
+
+    def _resolved(self, module: Module, node: ast.Call) -> str:
+        name = call_name(node)
+        return module.resolve_alias(name) if name else ""
+
+    def _check_literal_key(
+        self,
+        module: Module,
+        node: ast.Call,
+        symbol: str,
+        exempt: Set[int],
+        out: List[Finding],
+    ) -> None:
+        if id(node) in exempt:
+            return
+        if self._resolved(module, node) not in _KEY_MINTS:
+            return
+        if len(node.args) == 1 and _is_numeric_literal(node.args[0]):
+            out.append(
+                Finding(
+                    self.rule,
+                    module.relpath,
+                    getattr(node, "lineno", 1),
+                    symbol,
+                    "fixed jax.random.PRNGKey(<literal>) on the request "
+                    "path — every request would sample identically; mint "
+                    "per-request keys from the counter scheme "
+                    "(GenerateEngine.next_request_key / serve._next_rng), "
+                    "or thread greedy_dummy_key() on greedy-only paths",
+                )
+            )
+
+    def _check_module_rng(
+        self,
+        module: Module,
+        node: ast.Call,
+        symbol: str,
+        out: List[Finding],
+    ) -> None:
+        resolved = self._resolved(module, node)
+        if not resolved:
+            return
+        tail = resolved.rsplit(".", 1)[-1]
+        if (
+            resolved.startswith("numpy.random.")
+            and tail not in _NP_RANDOM_OK
+        ):
+            out.append(
+                Finding(
+                    self.rule,
+                    module.relpath,
+                    getattr(node, "lineno", 1),
+                    symbol,
+                    f"np.random.{tail}() — global numpy RNG state on a "
+                    "device-result/replay path; use a seeded "
+                    "np.random.default_rng instance",
+                )
+            )
+        elif (
+            resolved.startswith("random.")
+            and resolved.count(".") == 1
+            and tail != "Random"
+        ):
+            out.append(
+                Finding(
+                    self.rule,
+                    module.relpath,
+                    getattr(node, "lineno", 1),
+                    symbol,
+                    f"random.{tail}() — process-global RNG on a "
+                    "device-result/replay path; use a seeded "
+                    "random.Random instance or the engine key scheme",
+                )
+            )
+
+    def _lower_exempt_ids(self, root: ast.AST) -> Set[int]:
+        """ids of every node inside ``.lower(...)`` call arguments — AOT
+        shape probes pass placeholder keys that trace shapes and never
+        draw."""
+        exempt: Set[int] = set()
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name or name.rsplit(".", 1)[-1] != "lower":
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    exempt.add(id(sub))
+        return exempt
+
+    # -- module level ---------------------------------------------------------
+
+    def _scan_module_level(self, module: Module, out: List[Finding]) -> None:
+        exempt = self._lower_exempt_ids(module.tree)
+        stack = list(ast.iter_child_nodes(module.tree))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                self._check_literal_key(
+                    module, node, "<module>", exempt, out
+                )
+                self._check_module_rng(module, node, "<module>", out)
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- per-function affine scan ---------------------------------------------
+
+    def _scan(self, fn: FunctionInfo, out: List[Finding]) -> None:
+        module = fn.module
+        exempt = self._lower_exempt_ids(fn.node)
+        in_dummy = fn.name == GREEDY_DUMMY_KEY
+        # Key-named PARAMS are tracked only when the body actually
+        # touches jax.random — ``rng``/``key`` params elsewhere are
+        # numpy generators or cache-key strings, and flagging a dict key
+        # passed to two calls would be pure noise.  Locally minted keys
+        # are always tracked.
+        touches_jax_random = False
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                resolved = self._resolved(module, node)
+                name = call_name(node)
+                if resolved.startswith("jax.random.") or (
+                    name
+                    and name.rsplit(".", 1)[-1] in _KEY_SCHEME_TAILS
+                ):
+                    touches_jax_random = True
+                    break
+        # fresh[name]: True = mint/derive result not yet consumed;
+        # False = consumed once already
+        fresh: Dict[str, bool] = (
+            {p: True for p in fn.params if p in _KEY_PARAMS}
+            if touches_jax_random
+            else {}
+        )
+        emitted: Set[tuple] = set()
+
+        def add(node, message, dedup_key=None) -> None:
+            key = dedup_key or (getattr(node, "lineno", 1), message)
+            if key in emitted:
+                return
+            emitted.add(key)
+            out.append(
+                Finding(
+                    self.rule,
+                    module.relpath,
+                    getattr(node, "lineno", 1),
+                    fn.qualname,
+                    message,
+                )
+            )
+
+        def key_source(value: ast.AST) -> Optional[str]:
+            """'fresh' when the expression mints/derives a key (or indexes
+            one out of a split result), else None."""
+            if isinstance(value, ast.Subscript):
+                return key_source(value.value)
+            if not isinstance(value, ast.Call):
+                return None
+            resolved = self._resolved(module, value)
+            if resolved in _KEY_MINTS or resolved in _KEY_DERIVES:
+                return "fresh"
+            name = call_name(value)
+            if name and name.rsplit(".", 1)[-1] in _KEY_SCHEME_TAILS:
+                return "fresh"
+            return None
+
+        def consume_args(call: ast.Call) -> None:
+            """Any call consumes the tracked key names in its argument
+            list — including split/fold_in (they consume the old key and
+            mint fresh ones into the assignment targets)."""
+            if id(call) in exempt:
+                return
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                target = arg
+                if isinstance(target, ast.Starred):
+                    target = target.value
+                if not isinstance(target, ast.Name):
+                    continue
+                name = target.id
+                if name not in fresh:
+                    continue
+                if not fresh[name]:
+                    add(
+                        call,
+                        f"key '{name}' reused after being consumed — "
+                        "jax.random keys are affine; split/fold_in "
+                        "before every additional use",
+                        dedup_key=(getattr(call, "lineno", 1), name),
+                    )
+                fresh[name] = False
+
+        def handle_expr(node: ast.AST) -> None:
+            stack = [node]
+            while stack:
+                cur = stack.pop()
+                if isinstance(
+                    cur,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                if isinstance(cur, ast.Call):
+                    if not in_dummy:
+                        self._check_literal_key(
+                            module, cur, fn.qualname, exempt, out
+                        )
+                    self._check_module_rng(module, cur, fn.qualname, out)
+                    consume_args(cur)
+                stack.extend(ast.iter_child_nodes(cur))
+
+        def untrack_escapes(node: ast.AST) -> None:
+            """A tracked key that escapes (returned, yielded, stored on
+            an attribute/container) leaves the affine scan — ownership
+            moved somewhere this name-based pass cannot follow."""
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id in fresh:
+                    del fresh[sub.id]
+
+        def bind_assign(stmt: ast.Assign) -> None:
+            src = key_source(stmt.value)
+            is_tuple_derive = isinstance(stmt.value, ast.Call) and (
+                self._resolved(module, stmt.value) in _KEY_DERIVES
+            )
+            for target in stmt.targets:
+                names = []
+                if isinstance(target, ast.Name):
+                    names = [target]
+                elif isinstance(target, (ast.Tuple, ast.List)):
+                    names = [
+                        e for e in target.elts if isinstance(e, ast.Name)
+                    ]
+                elif isinstance(target, (ast.Attribute, ast.Subscript)):
+                    # storing INTO state: the value escapes
+                    untrack_escapes(stmt.value)
+                    continue
+                for n in names:
+                    if src == "fresh" or (is_tuple_derive and names):
+                        fresh[n.id] = True
+                    elif n.id in fresh:
+                        del fresh[n.id]
+
+        def merge(base: Dict[str, bool], *branches: Dict[str, bool]):
+            names = set()
+            for b in branches:
+                names |= set(b)
+            base.clear()
+            for name in names:
+                vals = [b[name] for b in branches if name in b]
+                if len(vals) == len(branches):
+                    base[name] = all(vals)
+                # tracked in only one arm: untracked after the join
+                # (the other arm escaped/rebound it — don't guess)
+
+        def walk(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if isinstance(stmt, ast.Assign):
+                    handle_expr(stmt.value)
+                    bind_assign(stmt)
+                    continue
+                if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    if stmt.value is not None:
+                        handle_expr(stmt.value)
+                    continue
+                if isinstance(stmt, (ast.Return, ast.Expr)) and isinstance(
+                    getattr(stmt, "value", None), (ast.Yield, ast.YieldFrom)
+                ):
+                    if stmt.value.value is not None:
+                        handle_expr(stmt.value.value)
+                        untrack_escapes(stmt.value.value)
+                    continue
+                if isinstance(stmt, ast.Return):
+                    if stmt.value is not None:
+                        handle_expr(stmt.value)
+                        untrack_escapes(stmt.value)
+                    continue
+                if isinstance(stmt, ast.If):
+                    handle_expr(stmt.test)
+                    saved = dict(fresh)
+                    walk(stmt.body)
+                    then_end = dict(fresh)
+                    fresh.clear()
+                    fresh.update(saved)
+                    walk(stmt.orelse)
+                    else_end = dict(fresh)
+                    merge(fresh, then_end, else_end)
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    handle_expr(stmt.iter)
+                    # two passes: a consume-without-rebind shows up when
+                    # iteration two replays the body
+                    walk(stmt.body)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                    continue
+                if isinstance(stmt, ast.While):
+                    handle_expr(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    walk(stmt.body)
+                    for handler in stmt.handlers:
+                        walk(handler.body)
+                    walk(stmt.orelse)
+                    walk(stmt.finalbody)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        handle_expr(item.context_expr)
+                    walk(stmt.body)
+                    continue
+                for _name, field in ast.iter_fields(stmt):
+                    if isinstance(field, ast.expr):
+                        handle_expr(field)
+                    elif isinstance(field, list):
+                        if field and isinstance(field[0], ast.stmt):
+                            walk(field)
+                        elif field and isinstance(field[0], ast.expr):
+                            for e in field:
+                                handle_expr(e)
+
+        body = getattr(fn.node, "body", None)
+        if body:
+            walk(body)

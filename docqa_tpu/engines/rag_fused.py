@@ -47,6 +47,7 @@ from docqa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from docqa_tpu.engines.dispatch import dispatch_with_donation_retry
+from docqa_tpu.engines.generate import greedy_dummy_key
 from docqa_tpu.engines.spine import spine_run
 from docqa_tpu.engines.encoder import marshal_texts
 from docqa_tpu.index.store import NEG_INF, SearchResult, _search_single
@@ -406,10 +407,20 @@ class FusedRAG:
         gfn = gen._get_fn(
             1, l_bucket, max_new, greedy=gen.gen.temperature == 0.0
         )
+        # minted OUTSIDE the lane closure: a donation/spine retry must
+        # replay the SAME key, and each request gets its own — a shared
+        # literal key would make every fused answer sample identically
+        # at temperature>0.  Greedy programs take the argmax branch and
+        # never consume the key, so the marked dummy is sufficient there.
+        rng_key = (
+            greedy_dummy_key()
+            if gen.gen.temperature == 0.0
+            else gen.next_request_key()
+        )
 
         def _generate_on_lane():
             return gfn(
-                gen.params, prompt, total, jax.random.PRNGKey(0),
+                gen.params, prompt, total, rng_key,
                 jnp.float32(gen.gen.temperature),
             )
 

@@ -104,7 +104,9 @@ class MemoryBroker:
         Message headers (trace ids) replay with their bodies — a crash
         must not unlink a document's timeline."""
         assert self._journal_dir is not None
-        for name in os.listdir(self._journal_dir):
+        # sorted: listdir order is filesystem-dependent, and replay order
+        # must be identical on every host (docqa-detcheck order-stability)
+        for name in sorted(os.listdir(self._journal_dir)):
             if not name.endswith(".jsonl"):
                 continue
             queue = name[: -len(".jsonl")]
@@ -132,7 +134,11 @@ class MemoryBroker:
             # pub+dlq pairs) — dead letters must survive any number of restarts
             tmp = self._journal_path(queue) + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
-                for tag, (body, headers) in alive.items():
+                # sorted by tag == publish order: the compacted journal
+                # and the rebuilt pending queue must not depend on dict
+                # insertion history (tags are monotonic, so this is also
+                # exactly the original delivery order)
+                for tag, (body, headers) in sorted(alive.items()):
                     f.write(json.dumps(
                         {"op": "pub", "tag": tag, "body": body,
                          "headers": headers}
@@ -144,7 +150,7 @@ class MemoryBroker:
                     ) + "\n")
                     f.write(json.dumps({"op": "dlq", "tag": tag}) + "\n")
             os.replace(tmp, self._journal_path(queue))
-            for tag, (body, headers) in alive.items():
+            for tag, (body, headers) in sorted(alive.items()):
                 q.pending.append((tag, body, 0, 0.0, headers))
                 self._next_tag = max(self._next_tag, tag + 1)
             for tag, _b, _h in dead:

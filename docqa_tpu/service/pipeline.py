@@ -526,7 +526,7 @@ class DocumentPipeline:
                         log.info(
                             "dropped %d doc(s) deleted mid-encode", len(late)
                         )
-                        for d in late:
+                        for d in sorted(late):
                             obs.finish(ctx_by_doc.get(d), status="dropped")
                     if all_meta:
                         self.store.add(

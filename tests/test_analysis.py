@@ -3,7 +3,7 @@
 Each rule gets three fixture classes: a seeded violation (detected), the
 same violation with a ``# docqa-lint: disable=<rule>`` suppression
 (silent), and a clean/sanctioned variant (silent).  The gate tests then
-run the full twenty-checker suite over the real ``docqa_tpu`` tree and
+run the full twenty-four-checker suite over the real ``docqa_tpu`` tree and
 assert it is exactly in sync with the committed baseline — zero new
 findings AND zero stale entries (the acceptance contract of
 ``scripts/lint.py``).
@@ -842,15 +842,19 @@ class TestTreeGate:
             "dispatch-streams",
             "donation",
             "dtype-flow",
+            "entropy-in-state",
             "guarded-state",
             "host-sync",
             "jit-purity",
             "lock-discipline",
             "mesh-axes",
+            "order-stability",
             "phi-taint",
+            "replay-key-integrity",
             "resource-flow",
             "retire-once",
             "retrace-hazard",
+            "rng-discipline",
             "shed-taxonomy",
             "spec-shape",
             "thread-lifecycle",
