@@ -69,6 +69,8 @@ AUDIT_PROGRAMS = (
     "ulysses_attention",
     "retrieve_fused",
     "retrieve_ivf_sharded",
+    "retrieve_lexical_sharded",
+    "retrieve_hybrid_sharded",
 )
 
 
@@ -528,6 +530,136 @@ def _audit_retrieve_ivf(mesh_name: str):
     }
 
 
+def _lexical_operand_structs(rows: int = 64, width: int = 8, batch: int = 4,
+                             q_terms: int = 16):
+    """Abstract operands for the lexical impact-tile kernel (rows
+    divisible by 8 so every audit mesh shards them evenly)."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.ShapeDtypeStruct((rows, width), jnp.int32),  # term_ids
+        jax.ShapeDtypeStruct((rows, width), jnp.int8),  # impacts
+        jax.ShapeDtypeStruct((rows,), jnp.bool_),  # row_live
+        jax.ShapeDtypeStruct((batch, q_terms), jnp.int32),  # q_terms
+        jax.ShapeDtypeStruct((batch, q_terms), jnp.float32),  # q_weights
+    )
+
+
+def _audit_retrieve_lexical(mesh_name: str):
+    """Lower the lexical tier's search program
+    (``index/lexical.py:build_lexical_search_program`` — impact-tile
+    scoring over row-sharded int8 tiles -> top-k): tiles/liveness shard
+    rows over ``model``, queries replicate, each shard scores its local
+    rows in f32 (preferred_element_type) and the only collective content
+    is the SAME 2-gather top-k merge (vals + ids) the dense tiers pay.
+    1x1 lowers the single-device kernel and must be collective-free
+    (docqa-lexroute)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from docqa_tpu.index.lexical import (
+        build_lexical_search_program,
+        lexical_specs,
+    )
+
+    mesh = _mesh(mesh_name)
+    sharded = mesh.n_model > 1
+    operands = _lexical_operand_structs()
+    program = build_lexical_search_program(mesh if sharded else None, k=4)
+    specs = lexical_specs(mesh.model_axis)
+    in_shardings = tuple(
+        NamedSharding(mesh.mesh, spec if sharded else P())
+        for spec in specs
+    )
+    compiled = (
+        jax.jit(program, in_shardings=in_shardings)
+        .lower(*operands)
+        .compile()
+    )
+    counts = count_hlo_collectives(compiled.as_text())
+    return counts, {
+        "row_shards": mesh.n_model if sharded else 1,
+        "storage": "lexical_int8",
+    }
+
+
+def _audit_retrieve_hybrid(mesh_name: str):
+    """Lower the single-dispatch HYBRID retrieve program
+    (``engines/retrieve.py:build_hybrid_search_program`` — the audited
+    tiered dense program PLUS the audited lexical kernel in one XLA
+    program).  On a mesh both tier scans enter their ``shard_map`` merge
+    kernels inside the same dispatch, so the program owes exactly TWO
+    2-gather merge pairs (dense probe + lexical) and nothing else; 1x1
+    must stay collective-free (docqa-lexroute)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from docqa_tpu.engines.retrieve import build_hybrid_search_program
+    from docqa_tpu.index.ivf import ivf_cell_specs
+    from docqa_tpu.index.lexical import lexical_specs
+    from docqa_tpu.models.encoder import init_encoder_params
+
+    cfg = _audit_encoder_cfg()
+    mesh = _mesh(mesh_name)
+    params = jax.eval_shape(
+        functools.partial(init_encoder_params, cfg=cfg),
+        jax.random.PRNGKey(0),
+    )
+    batch = 4
+    n_cells, cap, n_spill, tail_rows = 16, 8, 4, 32  # cells divisible by 8
+    ids = jax.ShapeDtypeStruct((batch, cfg.max_seq_len), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    cells = jax.ShapeDtypeStruct((n_cells, cap, cfg.embed_dim), jnp.int8)
+    scale = jax.ShapeDtypeStruct((n_cells, cap), jnp.float32)
+    cell_ids = jax.ShapeDtypeStruct((n_cells, cap), jnp.int32)
+    centroids = jax.ShapeDtypeStruct((n_cells, cfg.embed_dim), jnp.float32)
+    spill = jax.ShapeDtypeStruct((n_spill, cfg.embed_dim), jnp.float32)
+    spill_ids = jax.ShapeDtypeStruct((n_spill,), jnp.int32)
+    tail = jax.ShapeDtypeStruct((tail_rows, cfg.embed_dim), jnp.float32)
+    n_live = jax.ShapeDtypeStruct((), jnp.int32)
+    lex_operands = _lexical_operand_structs(batch=batch)
+
+    sharded = mesh.n_model > 1
+    program = build_hybrid_search_program(
+        cfg, mesh if sharded else None,
+        nprobe=4, fetch=8, k_tail=4, k_lex=4, n_real_cells=n_cells,
+    )
+    replicated = NamedSharding(mesh.mesh, P())
+    cell_specs = ivf_cell_specs(mesh.model_axis)
+    lex_specs = lexical_specs(mesh.model_axis)
+    in_shardings = (
+        jax.tree_util.tree_map(lambda _: replicated, params),
+        replicated,  # ids
+        replicated,  # lengths
+        NamedSharding(mesh.mesh, cell_specs[0] if sharded else P()),
+        NamedSharding(mesh.mesh, cell_specs[1] if sharded else P()),
+        NamedSharding(mesh.mesh, cell_specs[2] if sharded else P()),
+        replicated,  # centroids
+        replicated,  # spill
+        replicated,  # spill_ids
+        replicated,  # tail
+        replicated,  # n_live
+    ) + tuple(
+        NamedSharding(mesh.mesh, spec if sharded else P())
+        for spec in lex_specs
+    )
+    compiled = (
+        jax.jit(program, in_shardings=in_shardings)
+        .lower(
+            params, ids, lengths, cells, scale, cell_ids, centroids,
+            spill, spill_ids, tail, n_live, *lex_operands,
+        )
+        .compile()
+    )
+    counts = count_hlo_collectives(compiled.as_text())
+    return counts, {
+        "row_shards": mesh.n_model if sharded else 1,
+        "storage": "int8+lexical_int8",
+    }
+
+
 _AUDITS: Dict[str, Callable[[str], Tuple[Dict[str, int], Dict[str, Any]]]] = {
     "decoder_decode": functools.partial(_audit_decoder, prefill=False),
     "decoder_prefill": functools.partial(_audit_decoder, prefill=True),
@@ -537,6 +669,8 @@ _AUDITS: Dict[str, Callable[[str], Tuple[Dict[str, int], Dict[str, Any]]]] = {
     "ulysses_attention": _audit_ulysses,
     "retrieve_fused": _audit_retrieve,
     "retrieve_ivf_sharded": _audit_retrieve_ivf,
+    "retrieve_lexical_sharded": _audit_retrieve_lexical,
+    "retrieve_hybrid_sharded": _audit_retrieve_hybrid,
 }
 
 
@@ -682,22 +816,29 @@ def semantic_violations(report: Dict[str, Any]) -> List[str]:
                         f"{counts[op]}"
                     )
 
-    # both retrieve programs owe the SAME collective story: the exact
-    # path's sharded_topk merge and the tiered path's sharded-cell merge
-    # are each exactly one (vals, ids) all-gather pair, nothing else —
-    # the corpus scan itself never leaves the shard, and 1x1 lowers the
-    # single-device kernel collective-free
-    for rname in ("retrieve_fused", "retrieve_ivf_sharded"):
+    # every retrieve program owes the SAME collective story: each tier
+    # scan pays exactly one (vals, ids) all-gather pair for its top-k
+    # merge, nothing else — the corpus scan itself never leaves the
+    # shard, and 1x1 lowers the single-device kernel collective-free.
+    # The hybrid program runs TWO tier scans (dense probe + lexical) in
+    # one dispatch, so it owes two merge pairs (docqa-lexroute).
+    for rname, merge_pairs in (
+        ("retrieve_fused", 1),
+        ("retrieve_ivf_sharded", 1),
+        ("retrieve_lexical_sharded", 1),
+        ("retrieve_hybrid_sharded", 2),
+    ):
         prog = progs.get(rname)
         if not prog:
             continue
         for mesh_name, counts in prog["per_mesh"].items():
-            want_ag = 2 if _model_dim(mesh_name) > 1 else 0
+            want_ag = 2 * merge_pairs if _model_dim(mesh_name) > 1 else 0
             if counts.get("all-gather") != want_ag:
                 out.append(
                     f"{rname}/{mesh_name}: {counts.get('all-gather')} "
-                    f"all-gather(s) — the path owes exactly the top-k "
-                    f"merge pair (vals + ids; expected {want_ag})"
+                    f"all-gather(s) — the path owes exactly "
+                    f"{merge_pairs} top-k merge pair(s) (vals + ids; "
+                    f"expected {want_ag})"
                 )
             for op in ("all-reduce", "collective-permute", "all-to-all"):
                 if counts.get(op, 0):

@@ -722,6 +722,56 @@ class QoSConfig:
 
 
 @dataclass(frozen=True)
+class LexicalConfig:
+    """Device-resident lexical (BM25-impact) tier + hybrid fusion
+    (``index/lexical.py``, docqa-lexroute; docs/SHARDING.md "Lexical
+    tier").  Exact-token recall — MRNs, phone numbers, drug names —
+    that the dense encoder's semantic neighborhood misses."""
+
+    # master switch: False skips building the tier entirely (no sink
+    # registration, hybrid/lexical retrieve modes fall back to dense)
+    enabled: bool = True
+    # hashed term vocabulary (crc32 mod vocab_size; collisions are
+    # counted, not resolved — at 128k slots a clinical corpus stays
+    # sparse).  Power of two keeps the modulo cheap on host.
+    vocab_size: int = 131072
+    # impact-ordered terms kept per document tile row; terms beyond the
+    # top tile_width by impact are dropped (counted in stats)
+    tile_width: int = 32
+    # BM25 shape parameters; ref_len replaces the corpus-average doc
+    # length so incremental adds never rescale existing impacts
+    k1: float = 1.5
+    b: float = 0.75
+    ref_len: int = 64
+    # hybrid fusion mix: alpha * norm(dense) + (1-alpha) * norm(lexical)
+    hybrid_alpha: float = 0.6
+    # serving retrieve mode: "dense" | "lexical" | "hybrid".  Dense stays
+    # the default per the advisory-first rule (PR 13): hybrid is promoted
+    # only when the measured recall CI-low on the labeled mix beats
+    # dense-only (bench answer_routing reports both).
+    serving_mode: str = "dense"
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Confidence-gated answer routing (``engines/router.py``,
+    docqa-lexroute; docs/OPERATIONS.md "Tune the answer router").
+    Extractive/lookup questions are served straight from the index —
+    the decoder is never dispatched and no KV slot is allocated."""
+
+    # master switch: False sends every /ask down the generative path
+    # (the pre-lexroute behavior, bit for bit)
+    enabled: bool = True
+    # text-stage decisions below this confidence take the generative
+    # path; raise toward 1.0 to make extractive routing rarer/safer
+    min_confidence: float = 0.7
+    # post-retrieval evidence floor: routed-extractive demotes to
+    # generative when the retrieved context covers less of the
+    # question's content vocabulary than this
+    evidence_min: float = 0.5
+
+
+@dataclass(frozen=True)
 class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     encoder: EncoderConfig = field(default_factory=EncoderConfig)
@@ -745,6 +795,8 @@ class Config:
         default_factory=RetrievalQualityConfig
     )
     qos: QoSConfig = field(default_factory=QoSConfig)
+    lexical: LexicalConfig = field(default_factory=LexicalConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
 
 
 _SECTIONS = {f.name: f.type for f in fields(Config)}

@@ -321,6 +321,106 @@ def build_tiered_search_program(
     return program
 
 
+def build_hybrid_search_program(
+    enc_cfg,
+    mesh,
+    *,
+    nprobe: int,
+    fetch: int,
+    k_tail: int,
+    k_lex: int,
+    n_real_cells: Optional[int] = None,
+):
+    """The single-dispatch HYBRID retrieve program (docqa-lexroute): the
+    tiered dense program (encoder forward -> coarse probe -> exact tail)
+    plus the lexical impact-tile kernel, all in one XLA program — the
+    lexical tier adds five operands (term_ids, impacts, row_live,
+    q_terms, q_weights; the term encoding is host work, no device
+    round-trip) and one extra (vals, ids) output pair.  On a mesh both
+    the probe and the lexical scorer enter their ``shard_map`` merge
+    kernels inside the SAME dispatch, so the hybrid program owes exactly
+    TWO 2-gather merge pairs (audited as ``retrieve_hybrid_sharded`` in
+    shard_budget.json) and the off-mesh-fallback ban carries over
+    unchanged.  Fusion itself (score normalization + mix) is host work
+    on the k-sized candidate lists — ``engines/router.py:fuse_scores``."""
+    from docqa_tpu.index.ivf import (
+        _probe_kernel,
+        _probe_kernel_sharded,
+        ivf_cell_specs,
+    )
+    from docqa_tpu.index.lexical import (
+        _lexical_kernel,
+        _lexical_kernel_sharded,
+        lexical_specs,
+    )
+    from docqa_tpu.index.tiered import _tail_kernel
+
+    sharded = mesh is not None and mesh.n_model > 1
+
+    def program(
+        enc_params, ids, lengths, cells, cell_scale, cell_ids,
+        centroids, spill, spill_ids, tail, n_live,
+        term_ids, impacts, row_live, q_terms, q_weights,
+    ):
+        emb = encode_batch(enc_params, enc_cfg, ids, lengths)
+        emb = emb / jnp.maximum(
+            jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+        )
+        q = emb.astype(centroids.dtype)
+        if sharded:
+            kernel = functools.partial(
+                _probe_kernel_sharded,
+                nprobe=nprobe, k=fetch,
+                n_real_cells=n_real_cells or cells.shape[0],
+                axis=mesh.model_axis,
+            )
+
+            def hybrid_probe_body(bcells, bscale, bids, bcent, bsp, bsp_ids, bq):
+                return kernel(bcells, bscale, bids, bcent, bsp, bsp_ids, bq)
+
+            bulk_vals, bulk_ids = shard_map(
+                hybrid_probe_body,
+                mesh=mesh.mesh,
+                in_specs=ivf_cell_specs(mesh.model_axis),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(cells, cell_scale, cell_ids, centroids, spill, spill_ids, q)
+            lex_kernel = functools.partial(
+                _lexical_kernel_sharded, k=k_lex, axis=mesh.model_axis
+            )
+
+            def hybrid_lexical_body(tids, timp, tlive, qt, qw):
+                return lex_kernel(tids, timp, tlive, qt, qw)
+
+            lex_vals, lex_ids = shard_map(
+                hybrid_lexical_body,
+                mesh=mesh.mesh,
+                in_specs=lexical_specs(mesh.model_axis),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(term_ids, impacts, row_live, q_terms, q_weights)
+        else:
+            bulk_vals, bulk_ids = _probe_kernel(
+                cells, cell_scale, cell_ids, centroids, spill,
+                spill_ids, q, nprobe=nprobe, k=fetch,
+                n_real_cells=n_real_cells,
+            )
+            lex_vals, lex_ids = _lexical_kernel(
+                term_ids, impacts, row_live, q_terms, q_weights, k=k_lex
+            )
+        if k_tail:
+            tail_vals, tail_ids = _tail_kernel(tail, q, n_live, k_tail)
+        else:
+            tail_vals = jnp.zeros((q.shape[0], 0), jnp.float32)
+            tail_ids = jnp.zeros((q.shape[0], 0), jnp.int32)
+        return (
+            bulk_vals, bulk_ids, tail_vals, tail_ids,
+            lex_vals, lex_ids, emb,
+        )
+
+    return program
+
+
 class FusedTieredRetriever:
     """Text-in, ranked-rows-out over a :class:`TieredIndex` in ONE dispatch.
 
@@ -342,6 +442,10 @@ class FusedTieredRetriever:
     the perf gate holds that counter to zero on the multi-device path.
     """
 
+    # docqa-lexroute: search_texts accepts mode= — the QA service's
+    # tier-routing opt-in marker (plain FusedRetriever stays dense-only)
+    supports_modes = True
+
     def __init__(self, encoder, tiered):
         self.encoder = encoder
         self.tiered = tiered
@@ -349,20 +453,36 @@ class FusedTieredRetriever:
         self._fns: Dict[Any, Any] = {}
         self._tier_token: Any = None  # evicts _fns when the tier swaps
 
-    def _get_fn(self, fetch: int, nprobe: int, k_tail: int, ivf):
-        key = (fetch, nprobe, k_tail)
+    def _get_fn(
+        self, fetch: int, nprobe: int, k_tail: int, ivf,
+        k_lex: Optional[int] = None,
+    ):
+        key = (fetch, nprobe, k_tail, k_lex)
         fn = self._fns.get(key)
         if fn is None:
-            fn = jax.jit(
-                build_tiered_search_program(
-                    self.encoder.cfg,
-                    self.tiered.store.mesh,
-                    nprobe=nprobe,
-                    fetch=fetch,
-                    k_tail=k_tail,
-                    n_real_cells=ivf.n_real_cells,
+            if k_lex is None:
+                fn = jax.jit(
+                    build_tiered_search_program(
+                        self.encoder.cfg,
+                        self.tiered.store.mesh,
+                        nprobe=nprobe,
+                        fetch=fetch,
+                        k_tail=k_tail,
+                        n_real_cells=ivf.n_real_cells,
+                    )
                 )
-            )
+            else:
+                fn = jax.jit(
+                    build_hybrid_search_program(
+                        self.encoder.cfg,
+                        self.tiered.store.mesh,
+                        nprobe=nprobe,
+                        fetch=fetch,
+                        k_tail=k_tail,
+                        k_lex=k_lex,
+                        n_real_cells=ivf.n_real_cells,
+                    )
+                )
             self._fns[key] = fn
         return fn
 
@@ -372,8 +492,16 @@ class FusedTieredRetriever:
         k: Optional[int] = None,
         filters: Optional[Dict[str, Any]] = None,
         deadline=None,  # resilience.Deadline: shed before marshal/dispatch
+        mode: Optional[str] = None,
     ) -> List[List[SearchResult]]:
-        """Same contract as ``TieredIndex.search`` but from raw texts."""
+        """Same contract as ``TieredIndex.search`` but from raw texts.
+
+        ``mode`` (docqa-lexroute): dense (default) / lexical / hybrid.
+        Hybrid keeps the ONE-dispatch shape — the lexical kernel rides
+        the same fused program (``build_hybrid_search_program``), so the
+        off-mesh-fallback ban and the nprobe-snapshot discipline carry
+        over verbatim.  Lexical mode skips the encoder entirely (the
+        term encoding is host work)."""
         tiered = self.tiered
         store = tiered.store
         k = k or store.cfg.default_k
@@ -381,11 +509,31 @@ class FusedTieredRetriever:
             return []
         if deadline is not None:
             deadline.check("retrieve")
+        mode = tiered._resolve_mode(mode, texts, None, filters)
+        DEFAULT_REGISTRY.counter(f"retrieve_mode_{mode}").inc()
+        if mode == "lexical":
+            return tiered._search_lexical(list(texts), k)
+        lex_tiles = None
+        if mode == "hybrid":
+            lex_tiles = tiered.lexical.device_tiles()
+            if lex_tiles is None:  # empty lexical tier: nothing to fuse
+                mode = "dense"
         tiered._maybe_background_rebuild()
         tier = tiered._tier  # one read: (ivf, covered) stay consistent
         if tier is None or filters:
             # pre-IVF or filtered: the (masked) exact fused path is the
-            # right tool — identical policy to TieredIndex.search
+            # right tool — identical policy to TieredIndex.search.  A
+            # pre-IVF hybrid pays the bootstrap's second dispatch; the
+            # one-dispatch claim is for the steady tiered serving state.
+            if mode == "hybrid":
+                seen_count = store.count
+                dense, emb = self._exact.search_texts(
+                    texts, k=k, deadline=deadline, return_emb=True
+                )
+                lex = tiered.lexical.search(list(texts), k=k)
+                out = tiered._fuse_rows(dense, lex, k)
+                tiered._observe_hybrid(emb, list(texts), out, k, seen_count)
+                return out
             return self._exact.search_texts(
                 texts, k=k, filters=filters, deadline=deadline
             )
@@ -423,11 +571,21 @@ class FusedTieredRetriever:
         # encoder included — on every append while the tail is small).
         # The padded bucket size bounds top_k's k.
         k_tail = min(max(k_bulk, k), int(tail_dev.shape[0]))
-        fn = self._get_fn(fetch, nprobe, k_tail, ivf)
+        lex_vals = lex_ids = None
+        lex_count = 0
+        if mode == "hybrid":
+            lex_term_ids, lex_impacts, lex_live, lex_count = lex_tiles
+            # the term encoding is pure host work; batch-bucket ladders
+            # match marshal_texts' so the query axes stay aligned
+            q_terms, q_weights = tiered.lexical.encode_queries(texts)
+            k_lex = min(k, lex_count)
+            fn = self._get_fn(fetch, nprobe, k_tail, ivf, k_lex=k_lex)
+        else:
+            fn = self._get_fn(fetch, nprobe, k_tail, ivf)
         if deadline is not None:  # marshal/rebuild may have eaten the budget
             deadline.check("retrieve_dispatch")
         def _tiered_on_lane():
-            return fn(
+            args = [
                 self.encoder.params,
                 jnp.asarray(ids_p),
                 jnp.asarray(len_p),
@@ -439,16 +597,26 @@ class FusedTieredRetriever:
                 ivf._spill_ids,
                 tail_dev,
                 jnp.int32(n_live),
-            )
+            ]
+            if mode == "hybrid":
+                args += [
+                    lex_term_ids, lex_impacts, lex_live,
+                    jnp.asarray(q_terms), jnp.asarray(q_weights),
+                ]
+            return fn(*args)
 
         t_probe = perf_counter()
+        seen_count = store.count  # hybrid shadow horizon (pre-dispatch)
         with span("fused_tiered_query", DEFAULT_REGISTRY):
             # async like the exact path: the lane covers trace/compile +
             # enqueue; the np.asarray fetches below block on the caller
             # (an executor lane, not a dispatch stream) as before
-            bulk_vals, bulk_ids, tail_vals, tail_ids, emb_dev = spine_run(
-                "retrieve", _tiered_on_lane, deadline=deadline
-            )
+            out_dev = spine_run("retrieve", _tiered_on_lane, deadline=deadline)
+        if mode == "hybrid":
+            (bulk_vals, bulk_ids, tail_vals, tail_ids,
+             lex_vals, lex_ids, emb_dev) = out_dev
+        else:
+            bulk_vals, bulk_ids, tail_vals, tail_ids, emb_dev = out_dev
         bulk_vals = np.asarray(bulk_vals, np.float32)[:n]
         bulk_ids = np.asarray(bulk_ids)[:n]
         tail_vals = np.asarray(tail_vals, np.float32)[:n]
@@ -503,6 +671,24 @@ class FusedTieredRetriever:
         DEFAULT_REGISTRY.histogram("retrieve_tier_ms_merge").observe(
             (perf_counter() - t_merge) * 1e3
         )
+        if mode == "hybrid":
+            # lexical candidates from the SAME dispatch -> host fusion
+            lex_vals = np.asarray(lex_vals, np.float32)[:n]
+            lex_ids = np.asarray(lex_ids)[:n]
+            lex_rows = []
+            for qi in range(n):
+                row = []
+                for s, rid in zip(lex_vals[qi], lex_ids[qi]):
+                    if s <= 0.0 or rid < 0 or rid >= lex_count:
+                        continue
+                    row.append((float(s), int(rid)))
+                lex_rows.append(row)
+            out = tiered._fuse_rows(out, lex_rows, k)
+            tiered._observe_hybrid(
+                np.asarray(emb_dev, np.float32)[:n], list(texts), out, k,
+                seen_count,
+            )
+            return out
         self._observe_quality(
             emb_dev, out, ivf, covered, covered + n_live, k, nprobe
         )

@@ -176,6 +176,14 @@ class VectorStore:
         # time — a mismatch means their ids no longer address these rows
         # (see TieredIndex._rerank_active).
         self._n_compactions = 0
+        # index sinks (docqa-lexroute): secondary index consumers that
+        # must stay row-aligned with THIS store — the lexical tier
+        # registers here.  Sinks are notified inside the same locked
+        # mutation that commits the dense change, on every path that
+        # reaches add/delete/compact — including journal replay and
+        # snapshot restore, which re-drive add() — so a crash-replayed
+        # ingest converges every tier, not just the dense one.
+        self._index_sinks: List[Any] = []
         # Token sidecar (cfg.token_width > 0): per-row generator-token ids
         # + true lengths, row-aligned with the vector buffer through every
         # add/grow/compact/snapshot — the device-side prompt source for
@@ -313,6 +321,40 @@ class VectorStore:
     def dim(self) -> int:
         return self.cfg.dim
 
+    def register_index_sink(self, sink: Any) -> None:
+        """Register a secondary index consumer (protocol: ``on_add(row_ids,
+        metadata)``, ``on_delete(row_ids)``, ``on_compact(keep_mask)``).
+        One seam, every mutation path: the pipeline's journal-replayed
+        ingest lands in :meth:`add`, so a registered sink needs no
+        replay-awareness of its own.
+
+        Registration is order-independent: rows already committed (e.g.
+        a snapshot restore that ran before the sink existed) are
+        back-filled through ``on_add`` immediately, tombstones included
+        (the metadata row carries ``deleted`` — the sink decides)."""
+        with self._lock:
+            self._index_sinks.append(sink)
+            if self._count:
+                try:
+                    sink.on_add(
+                        list(range(self._count)), self._meta[: self._count]
+                    )
+                except Exception:
+                    DEFAULT_REGISTRY.counter("index_sink_errors").inc()
+                    log.exception("index sink %s backfill failed", sink)
+
+    def _notify_sinks(self, method: str, *args) -> None:
+        """Best-effort fan-out (called with the store lock held, after
+        the dense mutation committed): a broken sink must not take dense
+        ingest down with it, but it fails LOUDLY — the counter feeds the
+        replay-convergence witness."""
+        for sink in self._index_sinks:
+            try:
+                getattr(sink, method)(*args)
+            except Exception:
+                DEFAULT_REGISTRY.counter("index_sink_errors").inc()
+                log.exception("index sink %s.%s failed", sink, method)
+
     def add(
         self,
         vectors: np.ndarray,
@@ -378,7 +420,9 @@ class VectorStore:
             self._append_columns(metadata)
             self._count = start + n
             self._version += 1
-            return list(range(start, start + n))
+            row_ids = list(range(start, start + n))
+            self._notify_sinks("on_add", row_ids, metadata)
+            return row_ids
 
     def _append_tokens_locked(
         self, start, n, n_pad, token_rows, token_lens
@@ -549,6 +593,9 @@ class VectorStore:
             for i in np.nonzero(hit)[0]:
                 self._meta[int(i)]["deleted"] = True  # persists via snapshot
             self._version += 1
+            self._notify_sinks(
+                "on_delete", [int(i) for i in np.nonzero(hit)[0]]
+            )
             log.info("tombstoned %d rows across %d docs", n, len(codes))
             return n
 
@@ -604,6 +651,7 @@ class VectorStore:
                 self._host = np.zeros((1, self.cfg.dim), np.float32)
             self._n_compactions += 1
             self._version += 1
+            self._notify_sinks("on_compact", keep.copy())
             log.info("compacted %d deleted rows; %d remain", removed, self._count)
             return removed
 

@@ -61,6 +61,10 @@ class TieredIndex:
     """Serving facade over (VectorStore, IVFIndex) with the store's search
     signature — drop-in for ``QAService``."""
 
+    # docqa-lexroute: this surface accepts search(..., mode=, query_texts=)
+    # — the QA service's tier-routing opt-in marker
+    supports_modes = True
+
     def __init__(
         self,
         store: VectorStore,
@@ -70,6 +74,9 @@ class TieredIndex:
         n_clusters: Optional[int] = None,
         seed: int = 0,
         storage: str = "int8",
+        lexical=None,  # index.lexical.LexicalIndex: the exact-token tier
+        hybrid_alpha: float = 0.6,
+        default_mode: str = "dense",
     ) -> None:
         self.store = store
         self.nprobe = nprobe
@@ -77,6 +84,14 @@ class TieredIndex:
         self.rebuild_tail_rows = rebuild_tail_rows
         self.n_clusters = n_clusters
         self.seed = seed
+        # docqa-lexroute: optional lexical tier + fusion knobs.  The
+        # serving default stays "dense" unless the measured hybrid
+        # recall CI-low beats dense-only on the labeled exact-token mix
+        # (bench ``answer_routing``) — the PR 13 advisory-first rule;
+        # hybrid/lexical modes are always available per request.
+        self.lexical = lexical
+        self.hybrid_alpha = float(hybrid_alpha)
+        self.default_mode = default_mode
         # bulk-tier cell format: "int8" (per-row-scaled tiles, the
         # mesh-shardable HBM-resident layout) or "float" (store dtype,
         # exact scores, 2x bytes, single-device only)
@@ -324,6 +339,51 @@ class TieredIndex:
         k: Optional[int] = None,
         where: Optional[Callable[[Dict[str, Any]], bool]] = None,
         filters: Optional[Dict[str, Any]] = None,
+        mode: Optional[str] = None,
+        query_texts: Optional[List[str]] = None,
+    ) -> List[List[SearchResult]]:
+        """Mode-aware retrieval (docqa-lexroute): ``mode`` is one of
+        ``dense`` (the embedding tiers, unchanged), ``lexical`` (the
+        exact-token impact tier alone), or ``hybrid`` (both, fused by
+        ``engines.router.fuse_scores``).  Lexical evidence needs the raw
+        ``query_texts`` (the clinical tokenizer runs on text, not
+        embeddings); without them — or with metadata filters, which only
+        the dense store implements — non-dense modes fall back to dense
+        and count ``retrieve_mode_fallback``."""
+        k_final = k or self.store.cfg.default_k
+        mode = self._resolve_mode(mode, query_texts, where, filters)
+        DEFAULT_REGISTRY.counter(f"retrieve_mode_{mode}").inc()
+        if mode == "lexical":
+            return self._search_lexical(query_texts, k_final)
+        dense = self._search_dense(
+            queries, k, where, filters, observe=mode == "dense"
+        )
+        if mode == "dense":
+            return dense
+        return self._fuse_hybrid(queries, query_texts, dense, k_final)
+
+    def _resolve_mode(self, mode, query_texts, where, filters) -> str:
+        mode = mode or self.default_mode
+        if mode not in ("dense", "lexical", "hybrid"):
+            log.warning("unknown retrieve mode %r; serving dense", mode)
+            mode = "dense"
+        if mode != "dense" and (
+            self.lexical is None
+            or query_texts is None
+            or where is not None
+            or filters
+        ):
+            DEFAULT_REGISTRY.counter("retrieve_mode_fallback").inc()
+            return "dense"
+        return mode
+
+    def _search_dense(
+        self,
+        queries: np.ndarray,
+        k: Optional[int] = None,
+        where: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        filters: Optional[Dict[str, Any]] = None,
+        observe: bool = True,
     ) -> List[List[SearchResult]]:
         self._maybe_background_rebuild()
         tier = self._tier  # one read: (ivf, covered) stay consistent
@@ -397,10 +457,189 @@ class TieredIndex:
         DEFAULT_REGISTRY.histogram("retrieve_tier_ms_merge").observe(
             (perf_counter() - t_stage) * 1e3
         )
-        self._observe_quality(
-            queries, out, ivf, covered, covered + n_live, k, nprobe_now
-        )
+        if observe:
+            # hybrid/lexical modes submit their OWN per-tier shadow jobs
+            # (one sampled job per request, labeled with the served tier)
+            self._observe_quality(
+                queries, out, ivf, covered, covered + n_live, k, nprobe_now
+            )
         return out
+
+    # ---- lexical / hybrid serving (docqa-lexroute) ---------------------------
+
+    def _row_meta(self, rid: int) -> Optional[Dict[str, Any]]:
+        """Metadata for a lexical-surfaced row id (the dense candidates
+        carry theirs already).  Lock-held read of the store's row-aligned
+        metadata list."""
+        store = self.store
+        with store._lock:
+            if 0 <= rid < store._count:
+                return store._meta[rid]
+        return None
+
+    def _search_lexical(
+        self, texts: List[str], k: int
+    ) -> List[List[SearchResult]]:
+        """Pure lexical serving: impact-tile top-k mapped onto the dense
+        store's metadata (same row-id space by the index-sink contract),
+        tombstones filtered like every tier."""
+        lex = self.lexical.search(texts, k=k)
+        out: List[List[SearchResult]] = []
+        for row in lex:
+            res = []
+            for score, rid in row:
+                md = self._row_meta(rid)
+                if md is None or md.get("deleted"):
+                    continue
+                res.append(SearchResult(float(score), rid, md))
+            out.append(res)
+        self._observe_lexical(texts, out, k)
+        return out
+
+    def _fuse_hybrid(
+        self,
+        queries: np.ndarray,
+        texts: List[str],
+        dense: List[List[SearchResult]],
+        k: int,
+    ) -> List[List[SearchResult]]:
+        """Hybrid merge: normalized dense + lexical mix
+        (``engines.router.fuse_scores``) over the candidate union, cut
+        to ``k``.  The dense candidates were produced by the unchanged
+        dense path (nprobe snapshot discipline and all); the lexical
+        dispatch is the tier's own single program."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        seen_count = self.store.count  # shadow horizon: pre-fusion view
+        t_stage = perf_counter()
+        lex = self.lexical.search(texts, k=k)
+        DEFAULT_REGISTRY.histogram("retrieve_tier_ms_lexical").observe(
+            (perf_counter() - t_stage) * 1e3
+        )
+        out = self._fuse_rows(dense, lex, k)
+        self._observe_hybrid(queries, texts, out, k, seen_count)
+        return out
+
+    def _fuse_rows(
+        self,
+        dense: List[List[SearchResult]],
+        lex: List[List[Tuple[float, int]]],
+        k: int,
+    ) -> List[List[SearchResult]]:
+        """The fusion core shared by the two-step path above and the
+        one-dispatch fused path (``engines/retrieve.py``, which hands
+        in the lexical candidates its own program produced)."""
+        from docqa_tpu.engines.router import fuse_scores
+
+        out: List[List[SearchResult]] = []
+        for qi, drow in enumerate(dense):
+            lrow = lex[qi] if qi < len(lex) else []
+            md_by: Dict[int, Dict[str, Any]] = {
+                r.row_id: r.metadata for r in drow
+            }
+            fused = fuse_scores(
+                [(r.score, r.row_id) for r in drow],
+                lrow,
+                self.hybrid_alpha,
+            )
+            res: List[SearchResult] = []
+            for score, rid in fused:
+                md = md_by.get(rid)
+                if md is None:
+                    md = self._row_meta(rid)
+                if md is None or md.get("deleted"):
+                    continue
+                res.append(SearchResult(float(score), rid, md))
+                if len(res) >= k:
+                    break
+            out.append(res)
+        return out
+
+    def _observe_lexical(
+        self, texts: List[str], out: List[List[SearchResult]], k: int
+    ) -> None:
+        """Per-tier shadow job for the lexical tier (docqa-recallscope):
+        ground truth is the EXACT host-side reference scoring
+        (full-precision impacts, ``LexicalIndex.host_topk``), computed
+        EAGERLY on sampled requests so the pending job never holds raw
+        query text (the PHI rule: jobs hold embeddings and salted
+        hashes, never text — a lexical job holds only row/score pairs)."""
+        robs = get_retrieval_observatory()
+        if robs is None or not robs.sample():
+            return
+        served = [[(r.row_id, r.score) for r in row] for row in out]
+        reference = self.lexical.host_topk(texts, k)
+
+        def shadow_fn():
+            return [[(rid, s) for rid, s in row] for row in reference], None
+
+        robs.submit(
+            ShadowJob(
+                tier="lexical",
+                nprobe=0,  # no probe axis on this tier
+                k=k,
+                served=served,
+                shadow_fn=shadow_fn,
+            )
+        )
+
+    def _observe_hybrid(
+        self,
+        queries: np.ndarray,
+        texts: List[str],
+        out: List[List[SearchResult]],
+        k: int,
+        seen_count: int,
+    ) -> None:
+        """Per-tier shadow job for the hybrid tier: ground truth fuses
+        the store's exact dense shadow scan with the lexical tier's
+        exact host reference under the SAME alpha the serving merge
+        used, so a fusion-weight drift fires the existing recall SLO.
+        The lexical half is computed eagerly (no text in the pending
+        job); the dense half runs on the background probe stream as
+        usual."""
+        robs = get_retrieval_observatory()
+        if robs is None or not robs.sample():
+            return
+        served = [[(r.row_id, r.score) for r in row] for row in out]
+        alpha = self.hybrid_alpha
+        lex_ref = self.lexical.host_topk(texts, k, count_cap=seen_count)
+        q_copy = np.array(queries, np.float32, copy=True)
+        store = self.store
+
+        def shadow_fn():
+            from docqa_tpu.engines.router import fuse_scores
+
+            rows = store.shadow_search(q_copy, k, count_cap=seen_count)
+            fused = []
+            for qi, row in enumerate(rows):
+                dense_pairs = [(r.score, r.row_id) for r in row]
+                lrow = [
+                    (s, rid)
+                    for rid, s in (lex_ref[qi] if qi < len(lex_ref) else [])
+                ]
+                fused.append(
+                    [
+                        (rid, s)
+                        for s, rid in fuse_scores(dense_pairs, lrow, alpha, k=k)
+                    ]
+                )
+            return fused, q_copy
+
+        robs.submit(
+            ShadowJob(
+                tier="hybrid",
+                nprobe=0,
+                k=k,
+                served=served,
+                shadow_fn=shadow_fn,
+                query_norms=[
+                    float(x) for x in np.linalg.norm(q_copy, axis=1)
+                ],
+                attrs={"alpha": alpha},
+            )
+        )
 
     def _observe_quality(
         self,
@@ -557,18 +796,23 @@ class TieredIndex:
         with self._rebuild_lock:
             tier = self._tier
         if tier is None:
-            return {"active": False}
-        ivf, covered = tier
-        out = {
-            "active": True,
-            "covered": covered,
-            "n_clusters": ivf.n_clusters,
-            "nprobe": self.nprobe,
-            "n_assign": ivf.n_assign,
-            "cap": ivf.cap,
-            "spilled": ivf.n_spilled,
-        }
-        out.update(ivf.index_bytes())
+            out = {"active": False}
+        else:
+            ivf, covered = tier
+            out = {
+                "active": True,
+                "covered": covered,
+                "n_clusters": ivf.n_clusters,
+                "nprobe": self.nprobe,
+                "n_assign": ivf.n_assign,
+                "cap": ivf.cap,
+                "spilled": ivf.n_spilled,
+            }
+            out.update(ivf.index_bytes())
+        if self.lexical is not None:
+            out["lexical"] = self.lexical.stats()
+            out["retrieve_mode_default"] = self.default_mode
+            out["hybrid_alpha"] = self.hybrid_alpha
         return out
 
     # ---- store passthroughs (QAService drop-in) -----------------------------

@@ -195,6 +195,25 @@ class DocQARuntime:
         # serving index: exact store, or the tiered IVF+tail composition
         # for beyond-exact-scale corpora (store stays the ingest target and
         # source of truth either way)
+        # lexical tier (docqa-lexroute): device-resident inverted index
+        # over the SAME corpus, fed by the store's index-sink seam so
+        # journal replay / snapshot restore converge both tiers from one
+        # ingest path (index/lexical.py).  Registered before any
+        # bootstrap indexing so first-boot CSVs land in both tiers.
+        self.lexical = None
+        if self.cfg.lexical.enabled:
+            from docqa_tpu.index.lexical import LexicalIndex
+
+            self.lexical = LexicalIndex(
+                vocab_size=self.cfg.lexical.vocab_size,
+                tile_width=self.cfg.lexical.tile_width,
+                k1=self.cfg.lexical.k1,
+                b=self.cfg.lexical.b,
+                ref_len=self.cfg.lexical.ref_len,
+                mesh=self.mesh,
+            )
+            self.store.register_index_sink(self.lexical)
+
         if self.cfg.store.serving_index == "tiered":
             from docqa_tpu.index.tiered import TieredIndex
 
@@ -204,6 +223,9 @@ class DocQARuntime:
                 min_rows=self.cfg.store.ivf_min_rows,
                 rebuild_tail_rows=self.cfg.store.ivf_rebuild_tail,
                 storage=self.cfg.store.ivf_storage,
+                lexical=self.lexical,
+                hybrid_alpha=self.cfg.lexical.hybrid_alpha,
+                default_mode=self.cfg.lexical.serving_mode,
             )
         else:
             self.search_index = self.store
@@ -479,6 +501,17 @@ class DocQARuntime:
                 QA_TEMPLATE,
                 k=self.cfg.store.default_k,
             )
+        # answer router (docqa-lexroute): extractive/lookup questions are
+        # served straight from retrieval — zero decode dispatches, no KV
+        # slot.  Disabled = the pre-lexroute generative-only path.
+        self.router = None
+        if self.cfg.router.enabled:
+            from docqa_tpu.engines.router import AnswerRouter
+
+            self.router = AnswerRouter(
+                min_confidence=self.cfg.router.min_confidence,
+                evidence_min=self.cfg.router.evidence_min,
+            )
         self.qa = QAService(
             self.encoder,
             self.search_index,
@@ -491,6 +524,7 @@ class DocQARuntime:
             fused_rag=fused_rag,
             breakers=self.breakers,
             resilience=self.cfg.resilience,
+            router=self.router,
         )
         if self.cfg.flags.use_fake_retrieval:
             # standalone/dev parity with the reference's USE_FAKE_RETRIEVAL
@@ -1038,6 +1072,22 @@ def make_app(rt: DocQARuntime):
             "offmesh_fallbacks": DEFAULT_REGISTRY.counter(
                 "retrieve_offmesh_fallback"
             ).value,
+        }
+        # docqa-lexroute: answer-router posture + live route split, on
+        # the same surface the retrieval runbooks already read (the
+        # "Tune the answer router" runbook's evidence source)
+        payload["routing"] = {
+            "enabled": rt.router is not None,
+            "min_confidence": getattr(rt.router, "min_confidence", None),
+            "evidence_min": getattr(rt.router, "evidence_min", None),
+            "routed_extractive": DEFAULT_REGISTRY.counter(
+                "qa_routed_extractive"
+            ).value,
+            "routed_generative": DEFAULT_REGISTRY.counter(
+                "qa_routed_generative"
+            ).value,
+            "hybrid_alpha": rt.cfg.lexical.hybrid_alpha,
+            "serving_mode": rt.cfg.lexical.serving_mode,
         }
         return json_response(payload)
 

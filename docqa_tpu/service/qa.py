@@ -66,15 +66,14 @@ def prefix_key_for(chunks: List[str]) -> str:
     return f"{_TEMPLATE_HASH}:{h.hexdigest()[:16]}"
 
 
-def extractive_answer(chunks: List[str], max_chars: int = 600) -> str:
-    """The degraded-mode answer: the top-k retrieved chunks verbatim.
-
-    Retrieval stays up when generation is down — serving the evidence
-    beats serving a 500.  Deterministic and model-free by construction."""
-    text = "\n\n".join(c for c in chunks if c).strip()
-    if not text:
-        return "Aucun contexte trouvé."
-    return text[:max_chars]
+# Promoted to engines/router.py (docqa-lexroute): ONE implementation now
+# serves both the degraded fallback here (behavior pinned unchanged by
+# the resilience tests) and the routed-extractive fast path.  Re-exported
+# so existing imports of qa.extractive_answer keep working.
+from docqa_tpu.engines.router import (  # noqa: E402
+    ROUTE_EXTRACTIVE,
+    extractive_answer,
+)
 
 
 @dataclass
@@ -100,6 +99,12 @@ class PendingAnswer:
     degrade_reason: Optional[str] = None
     breaker: Optional[Any] = None  # decoder CircuitBreaker (outcome sink)
     degraded_max_chars: int = 600
+    # docqa-lexroute: set on routed-extractive answers (the decoder was
+    # never dispatched); declared as the optional ``route`` key in
+    # api_contract.json (contract version 2)
+    route: Optional[str] = None
+    route_confidence: Optional[float] = None
+    route_reason: Optional[str] = None
 
     def _result(self, answer: str) -> Dict[str, Any]:
         out: Dict[str, Any] = {"answer": answer, "sources": self.sources}
@@ -108,6 +113,10 @@ class PendingAnswer:
             # stays exactly {"answer", "sources"} (reference parity)
             out["degraded"] = True
             out["degrade_reason"] = self.degrade_reason
+        if self.route is not None:
+            # same opt-in shape as the degraded keys: generative answers
+            # keep the exact reference contract
+            out["route"] = self.route
         return out
 
     def _degrade(self, reason: str) -> Dict[str, Any]:
@@ -209,6 +218,7 @@ class QAService:
         fused_rag=None,  # FusedRAG: single-sync retrieval->prompt->decode
         breakers=None,  # resilience.BreakerBoard: "decoder" gates generation
         resilience=None,  # ResilienceConfig: degrade thresholds
+        router=None,  # engines.router.AnswerRouter: decoder-skip routing
     ) -> None:
         self.encoder = encoder
         self.store = store
@@ -228,18 +238,36 @@ class QAService:
         self.degraded_max_chars = (
             resilience.degraded_max_chars if resilience is not None else 600
         )
+        self.router = router
 
-    def _retrieve(self, text: str, k: int, filters=None, deadline=None):
+    def _retrieve(
+        self, text: str, k: int, filters=None, deadline=None, mode=None
+    ):
         """One fused dispatch when a retriever is wired (encoder forward +
         store top-k in a single XLA program — half the tunnel round-trips);
-        otherwise the classic encode-then-search pair."""
+        otherwise the classic encode-then-search pair.
+
+        ``mode`` (docqa-lexroute) requests a retrieve tier —
+        ``"hybrid"``/``"lexical"`` — and is forwarded only to surfaces
+        that declare ``supports_modes`` (TieredIndex and the fused
+        tiered retriever); everything else serves dense, which is the
+        tier contract's own fallback."""
         if self.retriever is not None:
+            kw = {}
+            if mode is not None and getattr(
+                self.retriever, "supports_modes", False
+            ):
+                kw["mode"] = mode
             return self.retriever.search_texts(
-                [text], k=k, filters=filters, deadline=deadline
+                [text], k=k, filters=filters, deadline=deadline, **kw
             )[0]
         if deadline is not None:
             deadline.check("retrieve")
         emb = self.encoder.encode_texts([text])
+        if mode is not None and getattr(self.store, "supports_modes", False):
+            return self.store.search(
+                emb, k=k, filters=filters, mode=mode, query_texts=[text]
+            )[0]
         return self.store.search(emb, k=k, filters=filters)[0]
 
     # ---- /ask/ ---------------------------------------------------------------
@@ -287,8 +315,29 @@ class QAService:
         # hook.  The HTTP layer usually attached one already (with its
         # endpoint's class); cost_open reuses it.
         cost = obs.cost_open(obs.current(), req_class)
+        # docqa-lexroute stage 1: text-only route decision, taken BEFORE
+        # retrieval because it picks the retrieve tier — extractive
+        # candidates retrieve hybrid (dense + lexical fusion) so the
+        # exact-token evidence an MRN/phone lookup needs is actually in
+        # the candidate set.  Stamped on the trace either way.
+        decision = None
+        if self.router is not None and self.router.enabled:
+            decision = self.router.decide(question)
+            obs.event(
+                "route_decision",
+                route=decision.route,
+                confidence=round(decision.confidence, 3),
+                reason=decision.reason,
+            )
+        mode = (
+            "hybrid"
+            if decision is not None and decision.route == ROUTE_EXTRACTIVE
+            else None
+        )
         with span("qa_retrieve", DEFAULT_REGISTRY):
-            hits = self._retrieve(question, k=k or self.k, deadline=deadline)
+            hits = self._retrieve(
+                question, k=k or self.k, deadline=deadline, mode=mode
+            )
         chunks = [
             h.metadata.get("text_content", h.metadata.get("source", ""))
             for h in hits
@@ -296,6 +345,37 @@ class QAService:
         context = "\n\n".join(chunks)
         prompt = QA_TEMPLATE.format(context=context, question=question)
         sources = [h.metadata.get("source", "") for h in hits]
+        if decision is not None:
+            # stage 2: the evidence gate — a routed answer must actually
+            # be IN the retrieved context.  A demotion is the generative
+            # path with a reason, never a failure (ISSUE contract).
+            decision, ev = self.router.evidence_gate(
+                decision, question, chunks
+            )
+            if decision.route == ROUTE_EXTRACTIVE:
+                # decoder-skip fast path: the answer is served straight
+                # from retrieval — no prompt, no batcher lane, no KV
+                # allocation, no decode dispatch (routing_smoke asserts
+                # the spine's decode stage counters stay flat here)
+                DEFAULT_REGISTRY.counter("qa_routed_extractive").inc()
+                obs.event(
+                    "routed_extractive",
+                    reason=decision.reason,
+                    evidence=round(ev, 3),
+                )
+                if cost is not None:
+                    cost.add("routed_extractive", 1.0)
+                return PendingAnswer(
+                    sources=sources,
+                    answer=extractive_answer(
+                        chunks, self.degraded_max_chars
+                    ),
+                    chunks=chunks,
+                    route=ROUTE_EXTRACTIVE,
+                    route_confidence=decision.confidence,
+                    route_reason=decision.reason,
+                )
+            DEFAULT_REGISTRY.counter("qa_routed_generative").inc()
         if self.use_fake_llm:
             answer = context[:500] if context else "Aucun contexte trouvé."
             return PendingAnswer(sources=sources, answer=answer)

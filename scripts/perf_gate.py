@@ -308,6 +308,37 @@ def measure(
     est = rq_status.get("estimate") or {}
     metrics["retrieve_recall_smoke"] = est.get("recall")
 
+    # answer-routing precision floor (docqa-lexroute): the checked-in
+    # labeled query mix (EN+FR; authored like the deid HELDOUT set and
+    # never tuned against) driven through the router's text stage.
+    # Precision is what the gate protects — an extractive-routed
+    # generative question ships a wrong-shaped answer, while the
+    # reverse merely costs a decode — so precision gets the structural
+    # floor and recall rides along as a context metric.  Fully
+    # deterministic: only a router-logic change moves it.
+    from docqa_tpu.engines.router import ROUTE_EXTRACTIVE, AnswerRouter
+
+    mix_path = os.path.join(
+        os.path.dirname(BASELINE_DEFAULT), "data", "routing_mix.jsonl"
+    )
+    router = AnswerRouter()
+    tp = fp = fn = 0
+    with open(mix_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ex = json.loads(line)
+            want = ex["label"] == "extractive"
+            got = router.decide(ex["question"]).route == ROUTE_EXTRACTIVE
+            tp += want and got
+            fp += got and not want
+            fn += want and not got
+    metrics["routing_precision_smoke"] = round(
+        tp / max(tp + fp, 1), 3
+    )
+    metrics["routing_recall_smoke"] = round(tp / max(tp + fn, 1), 3)
+
     # mesh-sharded int8 tier (docqa-meshindex): structural ceilings, not
     # timings — measured in a SUBPROCESS on an 8-virtual-device mesh
     # (see the module-top note on why this process must stay
@@ -613,6 +644,14 @@ def write_baseline(
         # preemption path regressed, never jitter)
         "interactive_p95_under_overload": ("lower", 100),
         "qos_preempt_exercised": ("higher", 0),
+        # answer-routing floors (docqa-lexroute): deterministic labeled
+        # mix, so the bands ARE the contract, not jitter absorbers —
+        # 5% under a 1.0 precision baseline pins the ISSUE's >=0.95
+        # routing-precision floor; recall gets a slightly wider band
+        # (a missed extractive merely costs a decode, it never ships a
+        # wrong-shaped answer)
+        "routing_precision_smoke": ("higher", 5),
+        "routing_recall_smoke": ("higher", 10),
     }
     # context-only outputs (exact token counts, sample sizes) are for
     # humans reading the report, not latency budgets

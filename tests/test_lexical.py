@@ -1,0 +1,437 @@
+"""Lexical tier (docqa-lexroute, ``index/lexical.py``).
+
+Four contracts under test:
+
+1. **Clinical tokenizer edge cases** — diacritic folding (FR), dotted/
+   dashed phone groups and MRN digit runs joining to one token,
+   hyphenated drug names emitting parts AND the joined form, empty/
+   whitespace documents.  Tokenization is one pure function shared by
+   documents and queries, so a query written with different punctuation
+   than the document must still exact-match.
+2. **Index correctness** — impact-tile search vs the exact host
+   reference, delete masking, compaction renumbering, collision and
+   truncation accounting, and the query-batch padding regression
+   (>16 queries must get an exact batch axis, not a silent clamp to the
+   ladder's top bucket).
+3. **Sharded == single-device** — the shard_map program over the tp8
+   virtual mesh must return the SAME row ids as the single-device
+   kernel at non-divisible vocab/row counts (the global-id offset and
+   the 2-gather merge are where an off-by-one would live).
+4. **Index-sink convergence** — the tier rides the store's
+   ``register_index_sink`` seam: adds/deletes/compactions propagate,
+   late registration backfills, and a snapshot -> restore -> register
+   cycle (the crash-replay path) converges both tiers from one ingest
+   stream.  The full-runtime restart variant exercises the journal/
+   snapshot path end to end (satellite (a) of the lexroute ISSUE).
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from docqa_tpu.index.lexical import (
+    LexicalIndex,
+    clinical_tokens,
+    term_slot,
+)
+
+
+def _ids(rows):
+    return [rid for _, rid in rows]
+
+
+# ---------------------------------------------------------------------------
+# Clinical tokenizer
+# ---------------------------------------------------------------------------
+
+
+class TestClinicalTokens:
+    def test_diacritic_fold_fr(self):
+        # "résumé" and "resume" must land in the same vocab slot
+        assert clinical_tokens("Résumé : numéro de téléphone") == (
+            clinical_tokens("Resume : numero de telephone")
+        )
+        assert "negatif" in clinical_tokens("groupe sanguin B négatif")
+
+    def test_dotted_phone_joins_to_one_token(self):
+        assert clinical_tokens("450.555.0142") == ["4505550142"]
+        assert clinical_tokens("514-555-0187") == ["5145550187"]
+        assert clinical_tokens("01 42 34 56") == ["01423456"]
+
+    def test_mrn_digit_run_survives(self):
+        assert clinical_tokens("MRN 40081223 admitted") == [
+            "mrn", "40081223", "admitted",
+        ]
+
+    def test_letter_boundary_not_joined(self):
+        # digit-join only fires BETWEEN digits: a dose stays dose-shaped
+        assert clinical_tokens("850 mg twice daily") == [
+            "850", "mg", "twice", "daily",
+        ]
+
+    def test_hyphenated_drug_name_emits_parts_and_joined(self):
+        toks = clinical_tokens("co-amoxiclav 625 mg")
+        assert {"co", "amoxiclav", "coamoxiclav"} <= set(toks)
+
+    def test_empty_and_whitespace_docs(self):
+        assert clinical_tokens("") == []
+        assert clinical_tokens("   \n\t  ") == []
+        assert clinical_tokens("—…·") == []
+
+    def test_query_document_punctuation_symmetry(self):
+        # document wrote dashes, the query writes dots: same token, so
+        # exact-match retrieval works across notations
+        doc = clinical_tokens("contact phone number 514-555-0187")
+        query = clinical_tokens("phone 514.555.0187 ?")
+        assert "5145550187" in doc
+        assert "5145550187" in query
+
+
+class TestTermSlot:
+    def test_crc32_not_builtin_hash(self):
+        # replayable across PYTHONHASHSEED: the slot is pure crc32
+        assert term_slot("metformin", 1000) == (
+            zlib.crc32(b"metformin") % 1000
+        )
+
+    def test_range_and_determinism(self):
+        for tok in ("mrn", "40081223", "coamoxiclav"):
+            s = term_slot(tok, 4096)
+            assert 0 <= s < 4096
+            assert s == term_slot(tok, 4096)
+
+
+# ---------------------------------------------------------------------------
+# Index correctness (single device)
+# ---------------------------------------------------------------------------
+
+DOCS = [
+    "patient okafor mrn 40081223 admitted to ward b for observation",
+    "registration patient nguyen contact phone number 514-555-0187",
+    "medication list metformin 850 mg twice daily with meals",
+    "ordonnance amoxicilline 500 mg posologie trois fois par jour",
+    "archived discharge summary uncomplicated appendectomy day two",
+]
+
+
+class TestLexicalIndexCore:
+    @pytest.fixture()
+    def idx(self):
+        idx = LexicalIndex(vocab_size=4096, tile_width=8)
+        idx.add(list(range(len(DOCS))), DOCS)
+        return idx
+
+    def test_exact_token_top1(self, idx):
+        assert _ids(idx.search(["40081223"], k=3)[0])[0] == 0
+        # dotted query vs dashed document: joined digit run matches
+        assert _ids(idx.search(["phone 514.555.0187"], k=3)[0])[0] == 1
+
+    def test_diacritic_query_matches(self, idx):
+        assert _ids(idx.search(["amoxicilline posologie"], k=3)[0])[0] == 3
+
+    def test_scores_positive_and_sorted(self, idx):
+        rows = idx.search(["metformin 850 mg"], k=5)[0]
+        scores = [s for s, _ in rows]
+        assert all(s > 0 for s in scores)
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_terms_skip_dispatch(self, idx):
+        # no query term exists in the corpus: empty result, no hit rows
+        assert idx.search(["zebra unicorn"], k=3) == [[]]
+
+    def test_empty_query_batch(self, idx):
+        assert idx.search([], k=3) == []
+
+    def test_delete_masks_row(self, idx):
+        idx.on_delete([0])
+        assert 0 not in _ids(idx.search(["40081223 okafor"], k=5)[0])
+
+    def test_compact_renumbers_like_dense_store(self, idx):
+        keep = np.array([True, False, True, True, True])
+        idx.on_delete([1])
+        idx.on_compact(keep)
+        assert idx.stats()["rows"] == 4
+        # metformin doc was row 2; after dropping row 1 it renumbers to 1
+        assert _ids(idx.search(["metformin"], k=3)[0])[0] == 1
+        # the tombstoned row's exclusive tokens are gone for good
+        assert idx.search(["nguyen"], k=3) == [[]]
+
+    def test_empty_doc_accounting(self):
+        idx = LexicalIndex(vocab_size=4096, tile_width=8)
+        idx.add([0, 1, 2], ["metformin dose", "", "   \n  "])
+        st = idx.stats()
+        assert st["empty_docs"] == 2
+        assert st["live_rows"] == 3
+        assert _ids(idx.search(["metformin"], k=3)[0]) == [0]
+
+    def test_host_reference_agrees_with_device(self, idx):
+        queries = ["40081223", "metformin 850", "amoxicilline", "phone"]
+        dev = idx.search(queries, k=3)
+        ref = idx.host_topk(queries, k=3)
+        for d, r in zip(dev, ref):
+            assert _ids(d)[0] == r[0][0]
+
+    def test_encode_queries_batch_exact_beyond_ladder(self, idx):
+        # regression: _bucket() clamps at the ladder top (16) — a batch
+        # of 20 queries must get an exact 20-row axis, not a silent
+        # 16-row truncation (mirrors engines/encoder.py marshal_texts)
+        q_terms, q_weights = idx.encode_queries(["metformin"] * 20)
+        assert q_terms.shape[0] == 20
+        assert q_weights.shape == q_terms.shape
+        assert (q_terms[19] != -2).any()  # row 19 really encoded
+        # inside the ladder, batches still bucket for program reuse
+        assert idx.encode_queries(["metformin"] * 5)[0].shape[0] == 16
+
+    def test_search_batch_beyond_ladder(self, idx):
+        # the end-to-end shape of the same regression: 20 queries
+        out = idx.search(["metformin 850"] * 20, k=3)
+        assert len(out) == 20
+        assert all(_ids(rows)[0] == 2 for rows in out)
+
+    def test_tile_truncation_accounted(self):
+        idx = LexicalIndex(vocab_size=4096, tile_width=2)
+        idx.add([0], ["alpha alpha alpha beta gamma delta epsilon"])
+        st = idx.stats()
+        assert st["truncated_terms"] == 3  # 5 distinct terms, tile of 2
+        # the top-impact term (highest tf) survived the truncation
+        assert _ids(idx.search(["alpha"], k=1)[0]) == [0]
+
+    def test_hash_collisions_accounted(self):
+        idx = LexicalIndex(vocab_size=2, tile_width=4)
+        idx.add([0], ["alpha beta gamma delta"])
+        assert idx.stats()["hash_collisions"] >= 1
+
+    def test_on_add_respects_deleted_metadata(self):
+        # snapshot restore replays tombstoned rows with ``deleted`` set;
+        # the sink must mirror the dense mask, not resurrect them
+        idx = LexicalIndex(vocab_size=4096, tile_width=8)
+        idx.on_add(
+            [0, 1],
+            [
+                {"text_content": "metformin dose"},
+                {"text_content": "warfarin dose", "deleted": True},
+            ],
+        )
+        assert _ids(idx.search(["metformin"], k=3)[0]) == [0]
+        assert idx.search(["warfarin"], k=3) == [[]]
+
+    def test_index_bytes_surface(self, idx):
+        b = idx.index_bytes()
+        assert b["storage"] == "lexical_int8"
+        assert b["shards"] == 1
+        assert b["total_bytes"] > 0
+        assert b["per_shard_bytes"] == b["total_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# Sharded == single-device
+# ---------------------------------------------------------------------------
+
+
+def _corpus_70():
+    # 70 rows (not divisible by 8 shards), graded doc lengths so shared-
+    # term scores differ by row; marker{i}/code tokens are unique per row
+    docs = []
+    for i in range(70):
+        filler = " ".join(f"note{j}" for j in range(i % 5))
+        docs.append(
+            f"patient case marker{i} code {40000000 + i} {filler}".strip()
+        )
+    return docs
+
+
+class TestShardedLexical:
+    def test_sharded_matches_single_device_nondivisible(self, mesh_tp8):
+        # prime vocab (1013) and 70 rows on 8 shards: neither axis
+        # divides evenly, so the row padding + global-id offset math in
+        # the shard_map merge is actually exercised
+        docs = _corpus_70()
+        kw = dict(vocab_size=1013, tile_width=8)
+        sharded = LexicalIndex(mesh=mesh_tp8, **kw)
+        single = LexicalIndex(mesh=None, **kw)
+        sharded.add(list(range(70)), docs)
+        single.add(list(range(70)), docs)
+        queries = ["marker7", "code 40000063", "marker69", "patient case"]
+        rs = sharded.search(queries, k=5)
+        r1 = single.search(queries, k=5)
+        for qs, q1 in zip(rs, r1):
+            assert _ids(qs) == _ids(q1)
+            np.testing.assert_allclose(
+                [s for s, _ in qs], [s for s, _ in q1], rtol=1e-5
+            )
+        # each marker's own row is retrieved (the tiny prime vocab can
+        # alias a marker into ANOTHER row too — collisions are accounted,
+        # not resolved — but the true row must be in the candidates)
+        assert 7 in _ids(rs[0])
+        assert 69 in _ids(rs[2])
+
+    def test_sharded_byte_accounting(self, mesh_tp8):
+        idx = LexicalIndex(vocab_size=1013, tile_width=8, mesh=mesh_tp8)
+        idx.add(list(range(70)), _corpus_70())
+        b = idx.index_bytes()
+        assert b["shards"] == 8
+        assert b["total_bytes"] % 8 == 0
+        assert b["per_shard_bytes"] * 8 == b["total_bytes"]
+
+    def test_sharded_delete_masks(self, mesh_tp8):
+        idx = LexicalIndex(vocab_size=1013, tile_width=8, mesh=mesh_tp8)
+        idx.add(list(range(70)), _corpus_70())
+        idx.on_delete([7])
+        assert idx.search(["marker7"], k=5) == [[]]
+
+
+# ---------------------------------------------------------------------------
+# Index-sink convergence with the dense store
+# ---------------------------------------------------------------------------
+
+
+def _dense_store(dim=16):
+    from docqa_tpu.config import StoreConfig
+    from docqa_tpu.index.store import VectorStore
+
+    cfg = StoreConfig(dim=dim, shard_capacity=64, dtype="float32")
+    return cfg, VectorStore(cfg)
+
+
+def _vecs(n, dim=16):
+    rng = np.random.default_rng(7)
+    v = rng.normal(size=(n, dim)).astype(np.float32)
+    return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+
+def _metas(docs):
+    return [
+        {"doc_id": f"d{i}", "source": f"doc{i}.txt", "text_content": t}
+        for i, t in enumerate(docs)
+    ]
+
+
+class TestIndexSinkConvergence:
+    def test_sink_rides_store_add(self):
+        _, store = _dense_store()
+        lex = LexicalIndex(vocab_size=4096, tile_width=8)
+        store.register_index_sink(lex)
+        store.add(_vecs(len(DOCS)), _metas(DOCS))
+        assert lex.stats()["rows"] == store.count
+        assert _ids(lex.search(["40081223"], k=3)[0]) == [0]
+
+    def test_late_registration_backfills(self):
+        # the runtime registers the sink before restore, but the seam
+        # must also cover sinks attached to an already-populated store
+        _, store = _dense_store()
+        store.add(_vecs(len(DOCS)), _metas(DOCS))
+        lex = LexicalIndex(vocab_size=4096, tile_width=8)
+        store.register_index_sink(lex)
+        assert lex.stats()["rows"] == store.count
+        assert _ids(lex.search(["metformin"], k=3)[0]) == [2]
+
+    def test_delete_docs_propagates(self):
+        _, store = _dense_store()
+        lex = LexicalIndex(vocab_size=4096, tile_width=8)
+        store.register_index_sink(lex)
+        store.add(_vecs(len(DOCS)), _metas(DOCS))
+        store.delete_docs(["d0"])
+        assert 0 not in _ids(lex.search(["40081223 okafor"], k=5)[0])
+        # other rows unaffected
+        assert _ids(lex.search(["nguyen"], k=3)[0]) == [1]
+
+    def test_compaction_keeps_rows_aligned(self):
+        from docqa_tpu.config import StoreConfig
+        from docqa_tpu.index.store import VectorStore
+
+        # compact_threshold=0: compaction only when explicitly invoked,
+        # so the test controls exactly when renumbering happens
+        cfg = StoreConfig(
+            dim=16, shard_capacity=64, dtype="float32",
+            compact_threshold=0.0,
+        )
+        store = VectorStore(cfg)
+        lex = LexicalIndex(vocab_size=4096, tile_width=8)
+        store.register_index_sink(lex)
+        store.add(_vecs(len(DOCS)), _metas(DOCS))
+        store.delete_docs(["d1"])
+        store.compact_deleted()
+        assert lex.stats()["rows"] == store.count == len(DOCS) - 1
+        # a lexical hit's row id must index the RENUMBERED dense rows:
+        # the metadata at that id still contains the matched token
+        for q, tok in (("metformin", "metformin"), ("40081223", "40081223")):
+            rid = _ids(lex.search([q], k=1)[0])[0]
+            assert tok in store.metadata_rows()[rid]["text_content"]
+
+    def test_crash_replay_converges_both_tiers(self, tmp_path):
+        from docqa_tpu.index.store import VectorStore
+
+        cfg, store = _dense_store()
+        lex = LexicalIndex(vocab_size=4096, tile_width=8)
+        store.register_index_sink(lex)
+        store.add(_vecs(len(DOCS)), _metas(DOCS))
+        store.delete_docs(["d4"])
+        d = str(tmp_path / "index")
+        store.snapshot(d)
+
+        # "crash": new process state — restore the dense tier, then
+        # attach a FRESH lexical tier; the registration backfill replays
+        # the restored rows (tombstones included) into it
+        restored = VectorStore.restore(d, cfg)
+        lex2 = LexicalIndex(vocab_size=4096, tile_width=8)
+        restored.register_index_sink(lex2)
+        assert lex2.stats()["rows"] == restored.count
+        for q, want in (("40081223", 0), ("metformin", 2)):
+            assert _ids(lex2.search([q], k=1)[0]) == [want]
+        # the pre-crash tombstone stayed dead through the replay
+        assert lex2.search(["appendectomy"], k=3) == [[]]
+
+    def test_runtime_restart_converges_both_tiers(self, tmp_path):
+        """Full-runtime crash-replay regression (lexroute satellite):
+        ingest through the real pipeline (broker -> deid -> index ->
+        snapshot), restart, and the restored runtime must serve the
+        SAME corpus from BOTH tiers without re-ingesting anything."""
+        from docqa_tpu.config import load_config
+        from docqa_tpu.service.app import DocQARuntime
+
+        overrides = {
+            "encoder.hidden_dim": 64,
+            "encoder.num_layers": 1,
+            "encoder.num_heads": 4,
+            "encoder.mlp_dim": 128,
+            "encoder.embed_dim": 64,
+            "store.dim": 64,
+            "store.shard_capacity": 256,
+            "ner.train_steps": 0,
+            "decoder.hidden_dim": 64,
+            "decoder.num_layers": 1,
+            "decoder.num_heads": 4,
+            "decoder.num_kv_heads": 2,
+            "decoder.head_dim": 16,
+            "decoder.mlp_dim": 128,
+            "decoder.vocab_size": 512,
+            "generate.max_new_tokens": 8,
+            "flags.use_fake_llm": True,
+            "flags.use_fake_encoder": True,
+            "data.work_dir": str(tmp_path / "work"),
+        }
+        cfg = load_config(env={}, overrides=overrides)
+        note = b"Aspirin 100 mg daily was prescribed after the event."
+        rt1 = DocQARuntime(cfg).start()
+        rec = rt1.pipeline.ingest_document("note.txt", note, patient_id="p1")
+        assert rt1.pipeline.wait_indexed(rec.doc_id, timeout=60)
+        assert rt1.lexical is not None
+        rows_before = rt1.lexical.stats()["rows"]
+        assert rows_before == rt1.store.count >= 1
+        assert rt1.lexical.search(["aspirin"], k=3)[0]
+        rt1.stop()  # final snapshot
+
+        rt2 = DocQARuntime(cfg).start()
+        try:
+            assert rt2.store.count == rows_before
+            assert rt2.lexical.stats()["rows"] == rows_before
+            hits = rt2.lexical.search(["aspirin"], k=3)[0]
+            assert hits
+            rid = hits[0][1]
+            assert "spirin" in rt2.store.metadata_rows()[rid].get(
+                "text_content", ""
+            )
+        finally:
+            rt2.stop()

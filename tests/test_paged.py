@@ -116,6 +116,9 @@ class TestPagedBatcher:
         finally:
             b.stop()
 
+    @pytest.mark.slow  # 8-request mixed-length burst (~17 s on this
+    # 1-core host); grow-past-initial / replica-kill / QoS-preemption
+    # tests keep the overcommit path in the tier-1 budget.
     def test_overcommitted_pool_mixed_lengths(self, engine):
         """A pool well under worst case still serves a burst of mixed
         lengths — blocks freed by short requests feed long ones (the

@@ -123,6 +123,9 @@ def test_stop_fails_pending(engine):
         pass  # stopped before completion is a legal outcome
 
 
+@pytest.mark.slow  # 8 staggered budgets decode ~16 s on this 1-core
+# host; cache-edge / trickle-arrival / staggered-submission tests keep
+# the overshoot + re-admission path in the tier-1 budget.
 def test_pipelined_staggered_budgets(engine, batcher):
     """Wildly different budgets retire slots at different chunks, forcing
     the pipelined loop through overshoot chunks (a retired slot decodes one

@@ -87,6 +87,9 @@ def test_graft_entry_single_chip():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # 8 virtual devices serialize on this 1-core host
+# (~44 s); the single-chip dryrun above plus the shard-audit compile
+# gates keep the graft entry covered inside the tier-1 budget.
 def test_graft_dryrun_multichip():
     from __graft_entry__ import dryrun_multichip
 
